@@ -1,0 +1,32 @@
+"""dpcorr — TPU-native (JAX/XLA) differentially-private correlation estimation.
+
+A ground-up rebuild of the capabilities of the R reference
+``abhinavc3/distributed-correlation`` (simulation code for *"When Data Can't
+Meet: Estimating Correlation Across Privacy Barriers"*): NI/INT sign-based and
+sub-Gaussian clipped DP correlation estimators with confidence intervals, the
+Monte-Carlo simulation grids, and the HRS real-data pipeline — re-designed
+TPU-first as ``jit``/``vmap``-batched kernels with replications sharded across
+device meshes via ``shard_map``.
+
+Package map (see SURVEY.md §7 for the blueprint):
+
+- ``dpcorr.ops``      — DP primitives: Laplace noise, clipping, clipping
+  thresholds (λ rules), mixture quantiles, DP standardization.
+- ``dpcorr.models``   — data-generating processes and the four estimator
+  families (NI/INT × sign/sub-Gaussian) with their CI constructors.
+- ``dpcorr.sim``      — the Monte-Carlo simulator (``run_sim_one``) as one
+  ``jit(vmap(...))`` kernel over replications.
+- ``dpcorr.parallel`` — device mesh utilities and the sharded grid backend
+  (replications across devices, XLA collectives for reductions).
+- ``dpcorr.grid``     — the design-grid driver (expand-grid → sharded
+  execution → persistence → summaries) replacing the reference's
+  ``parallel::mclapply`` fan-out (vert-cor.R:534, ver-cor-subG.R:294).
+- ``dpcorr.io``       — native RDS reader + HRS panel ingest.
+- ``dpcorr.hrs``      — HRS BMI-vs-Age DP pipeline + ε-sweep.
+- ``dpcorr.report``   — summary tables and figure families.
+- ``dpcorr.utils``    — RNG key-tree, configs, profiling, checkpointing.
+"""
+
+__version__ = "0.1.0"
+
+from dpcorr.utils.rng import MASTER_SEED  # noqa: F401

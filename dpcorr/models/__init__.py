@@ -1,0 +1,4 @@
+"""Models: data-generating processes and DP correlation estimators (layers
+L0 and L2 of the reference — SURVEY.md §1)."""
+
+from dpcorr.models import dgp  # noqa: F401

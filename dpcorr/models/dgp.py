@@ -1,0 +1,91 @@
+"""Synthetic data-generating processes (reference layer L0).
+
+Each DGP is ``f(key, n, rho, ...) -> (n, 2) array`` — pure, keyed, static-
+shaped, so one ``vmap`` over keys evaluates a whole replication batch. The
+reference's ``MASS::mvrnorm`` (LAPACK eigendecomposition) is replaced by the
+closed-form 2×2 Cholesky factor — exact for the bivariate case and MXU-
+friendly (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dpcorr.ops.noise import clip_sym
+from dpcorr.utils.rng import stream
+
+
+def _bvn(key, n, rho, mu, sigma, dtype=jnp.float32):
+    """Bivariate normal via 2×2 Cholesky: X = μ₁+σ₁Z₁,
+    Y = μ₂+σ₂(ρZ₁+√(1−ρ²)Z₂)."""
+    z = jax.random.normal(key, (n, 2), dtype)
+    rho = jnp.asarray(rho, dtype)
+    x = mu[0] + sigma[0] * z[:, 0]
+    y = mu[1] + sigma[1] * (rho * z[:, 0] + jnp.sqrt(1.0 - rho * rho) * z[:, 1])
+    return jnp.stack([x, y], axis=1)
+
+
+def gen_gaussian(key: jax.Array, n: int, rho, mu=(0.0, 0.0), sigma=(1.0, 1.0)) -> jax.Array:
+    """Bivariate Gaussian with corr ρ and per-coordinate (μ, σ).
+
+    Reference: ``gen_gaussian`` (vert-cor.R:64-73) and the general-Σ
+    ``mvrnorm`` in ``run_sim_one`` v1 (vert-cor.R:389-394).
+    """
+    return _bvn(key, n, rho, jnp.asarray(mu, jnp.float32), jnp.asarray(sigma, jnp.float32))
+
+
+def gen_bernoulli(key: jax.Array, n: int, rho) -> jax.Array:
+    """Correlated Bernoulli(0.5) pair with Corr(X,Y)=ρ via conditional
+    inversion: p11 = ¼+ρ/4, p01 = ¼−ρ/4 (vert-cor.R:78-98)."""
+    rho = jnp.asarray(rho, jnp.float32)
+    u = jax.random.uniform(stream(key, "u"), (n,), jnp.float32)
+    v = jax.random.uniform(stream(key, "v"), (n,), jnp.float32)
+    p11 = 0.25 + rho / 4.0
+    p01 = 0.25 - rho / 4.0
+    x = (u < 0.5).astype(jnp.float32)
+    # P(Y=1|X=0) = p01/0.5, P(Y=1|X=1) = p11/0.5
+    thresh = jnp.where(x == 1.0, p11 / 0.5, p01 / 0.5)
+    y = (v < thresh).astype(jnp.float32)
+    return jnp.stack([x, y], axis=1)
+
+
+def gen_mix_gaussian(key: jax.Array, n: int, rho,
+                     mu0=(0.0, 0.0), sigma0=(1.0, 1.0),
+                     mu1=(3.0, 3.0), sigma1=(2.0, 0.5),
+                     pi_mix=0.5) -> jax.Array:
+    """Two-component Gaussian mixture, rows i.i.d., output hard-clipped to
+    [−1, 1] (ver-cor-subG.R:115-136 — the clip at :135 is deliberate and
+    makes realized correlation ≠ nominal ρ, SURVEY.md Appendix A #8).
+
+    The reference stacks the two component blocks and shuffles rows; drawing
+    a per-row label is distribution-identical and stays static-shaped.
+    """
+    labels = jax.random.bernoulli(stream(key, "labels"), pi_mix, (n,))
+    out0 = _bvn(stream(key, "comp0"), n, rho, jnp.asarray(mu0, jnp.float32),
+                jnp.asarray(sigma0, jnp.float32))
+    out1 = _bvn(stream(key, "comp1"), n, rho, jnp.asarray(mu1, jnp.float32),
+                jnp.asarray(sigma1, jnp.float32))
+    out = jnp.where(labels[:, None], out1, out0)
+    return clip_sym(out, 1.0)
+
+
+def gen_bounded_factor(key: jax.Array, n: int, rho) -> jax.Array:
+    """Bounded common-factor DGP: X = U+E₁, Y = U+E₂ with
+    U ~ Unif[±√(3ρ)], Eᵢ ~ Unif[±√(3(1−ρ))] ⇒ mean 0, var 1, corr ρ
+    (ver-cor-subG.R:141-154)."""
+    rho = jnp.asarray(rho, jnp.float32)
+    c_u = jnp.sqrt(3.0 * rho)
+    c_e = jnp.sqrt(3.0 * (1.0 - rho))
+    u = jax.random.uniform(stream(key, "U"), (n,), jnp.float32, -1.0, 1.0) * c_u
+    e1 = jax.random.uniform(stream(key, "E1"), (n,), jnp.float32, -1.0, 1.0) * c_e
+    e2 = jax.random.uniform(stream(key, "E2"), (n,), jnp.float32, -1.0, 1.0) * c_e
+    return jnp.stack([u + e1, u + e2], axis=1)
+
+
+DGPS = {
+    "gaussian": gen_gaussian,
+    "bernoulli": gen_bernoulli,
+    "mix_gaussian": gen_mix_gaussian,
+    "bounded_factor": gen_bounded_factor,
+}

@@ -1,0 +1,21 @@
+"""DP primitives (reference layer L1 — SURVEY.md §1).
+
+Laplace noise, clipping, clipping-threshold (λ) rules, mixture quantiles and
+DP standardization, each as pure vmap-able JAX functions.
+"""
+
+from dpcorr.ops.noise import laplace, clip, clip_sym  # noqa: F401
+from dpcorr.ops.lambdas import (  # noqa: F401
+    lambda_n,
+    lambda_int_n,
+    lambda_from_priv,
+    lambda_receiver_from_noise,
+)
+from dpcorr.ops.mixquant import mixquant, mixquant_mc  # noqa: F401
+from dpcorr.ops.standardize import (  # noqa: F401
+    priv_standardize,
+    dp_mean,
+    dp_second_moment,
+    dp_sd,
+    standardize_dp,
+)

@@ -1,0 +1,55 @@
+"""Clipping-threshold (λ) rules.
+
+One definition per rule (the reference duplicates them across files —
+SURVEY.md Appendix A #6). All are cheap scalar formulas evaluated at trace
+time or inside kernels; they accept Python floats or JAX scalars.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def lambda_n(n, eta=1.0):
+    """NI clip threshold ``min(2η√log n, 2√3)``.
+
+    Reference: ver-cor-subG.R:1 (duplicate real-data-sims.R:109).
+    """
+    return jnp.minimum(2.0 * eta * jnp.sqrt(jnp.log(n * 1.0)), 2.0 * jnp.sqrt(3.0))
+
+
+def lambda_int_n(n, eta_s=1.0, eta_r=1.0, eps_s=1.0):
+    """INT clip pair ``(λ_s, λ_r)``.
+
+    λ_s as :func:`lambda_n`; λ_r = 5·max(η_r,1)·min(log n, 6)/min(ε_s, 1).
+    The reference flags λ_r as a deliberate deviation from the paper
+    (ver-cor-subG.R:3-7, real-data-sims.R:154-158).
+    """
+    lam_s = lambda_n(n, eta_s)
+    lam_r = 5.0 * jnp.maximum(eta_r, 1.0) * jnp.minimum(jnp.log(n * 1.0), 6.0) / jnp.minimum(eps_s, 1.0)
+    return lam_s, lam_r
+
+
+def lambda_from_priv(lo, hi, priv_mean, priv_sd, eps_sd=1e-8):
+    """Symmetric bound for a standardized variable from known raw bounds and
+    its private mean/sd: ``max(|lo−μ|, |hi−μ|)/max(sd, eps)``.
+
+    Reference: real-data-sims.R:103-106.
+    """
+    sig = jnp.maximum(priv_sd, eps_sd)
+    return jnp.maximum(jnp.abs((lo - priv_mean) / sig), jnp.abs((hi - priv_mean) / sig))
+
+
+def lambda_receiver_from_noise(lambda_sender, lambda_other, eps_sender,
+                               delta_per_sample):
+    """Receiver product bound accounting for the sender's local-DP noise.
+
+    If the sender releases ``clip(X, ±λ_s) + Lap(0, b_s)`` with
+    ``b_s = 2λ_s/ε_s`` and the receiver multiplies by its variable clipped to
+    ±λ_o, then with probability ≥ 1−δ per sample
+    ``|U| ≤ (λ_s + b_s·log(1/δ))·λ_o``.
+
+    Reference: real-data-sims.R:170-174.
+    """
+    b_s = 2.0 * lambda_sender / eps_sender
+    return (lambda_sender + b_s * jnp.log(1.0 / delta_per_sample)) * lambda_other

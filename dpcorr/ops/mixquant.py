@@ -1,0 +1,90 @@
+"""Quantiles of the Gaussian + scaled-Laplace mixture  X = Z + c·L.
+
+Z ~ N(0,1), L ~ standard (symmetric) Laplace. Used by the INT CI
+constructors: the reference draws a *fresh 1000-sample Monte-Carlo per CI*
+and takes an order statistic (``mixquant``, vert-cor.R:44-56,
+ver-cor-subG.R:8-20; nsim=2000 in real-data-sims.R:161-164) — noisy by
+design (SURVEY.md Appendix A #4). Under ``vmap`` over 10^6 replications that
+would be 10^9 wasted draws per CI batch, so the default here is a
+**deterministic closed-form inversion** (the reference itself sketches a
+deterministic numerical variant in comments, vert-cor.R:50-55):
+
+The CDF of X = Z + c·L has the closed form (derived by conditioning on L and
+integrating by parts; b ≡ c):
+
+    F(x) = Φ(x) + ½·[ e^{1/(2b²) + x/b}·Φ(−x − 1/b)
+                    − e^{1/(2b²) − x/b}·Φ( x − 1/b) ]
+
+which we evaluate in log-space via ``log_ndtr`` for stability at small b
+(where 1/(2b²) alone overflows) and invert by bisection — branch-free,
+fixed trip count, fully ``vmap``/TPU friendly.
+
+``mixquant_mc`` reproduces the reference's MC order-statistic estimator
+exactly in distribution, for fidelity tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import log_ndtr, ndtri
+
+
+def mix_cdf(x, c):
+    """P(Z + c·L ≤ x), elementwise; c ≥ 0.
+
+    Below c=0.01 the exponent ``1/(2b²) ± x/b + logΦ(...)`` cancels
+    catastrophically in float32, and the Laplace component (var 2c² ≤ 2e-4)
+    is negligible anyway, so we fall back to Φ(x) there.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    c = jnp.abs(jnp.asarray(c, jnp.float32))  # Z + cL ≡ Z + |c|L
+    b = jnp.maximum(c, 0.01)
+    inv_b = 1.0 / b
+    base = 0.5 * inv_b * inv_b  # 1/(2b²)
+    # log-space terms: exp(base ± x/b + logΦ(∓x − 1/b))
+    t_plus = jnp.exp(base + x * inv_b + log_ndtr(-x - inv_b))
+    t_minus = jnp.exp(base - x * inv_b + log_ndtr(x - inv_b))
+    mix = jax.scipy.stats.norm.cdf(x) + 0.5 * (t_plus - t_minus)
+    cdf = jnp.where(c < 0.01, jax.scipy.stats.norm.cdf(x), mix)
+    return jnp.clip(cdf, 0.0, 1.0)
+
+
+def mixquant(c, p, n_iter: int = 32):
+    """Deterministic p-quantile of Z + c·L by bisection on :func:`mix_cdf`.
+
+    Drop-in for the reference's ``mixquant(c, p)`` modulo its Monte-Carlo
+    noise (vert-cor.R:44-56). Broadcasts over ``c`` and ``p``.
+    """
+    c = jnp.abs(jnp.asarray(c, jnp.float32))
+    p = jnp.asarray(p, jnp.float32)
+    c, p = jnp.broadcast_arrays(c, p)
+    # Bracket: |quantile| ≤ |z_p| + c·|Laplace quantile_p| + slack.
+    zq = jnp.abs(ndtri(jnp.clip(p, 1e-7, 1.0 - 1e-7)))
+    lapq = 16.2  # |Laplace(1) quantile| at p = 1e-7
+    hi0 = zq + jnp.maximum(c, 0.0) * lapq + 1.0
+    lo0 = -hi0
+
+    def body(_, state):
+        lo, hi = state
+        mid = 0.5 * (lo + hi)
+        below = mix_cdf(mid, c) < p
+        return jnp.where(below, mid, lo), jnp.where(below, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, n_iter, body, (lo0, hi0))
+    return 0.5 * (lo + hi)
+
+
+def mixquant_mc(key: jax.Array, c, p, nsim: int = 1000):
+    """The reference's MC order-statistic estimator, faithfully.
+
+    ``sort(Z + c·E·S)[ceil(p·nsim)]`` with Z~N(0,1), E~Exp(1), S~±1
+    (vert-cor.R:45-48; nsim=2000 variant real-data-sims.R:161-164).
+    """
+    kz, ke, ks = jax.random.split(key, 3)
+    z = jax.random.normal(kz, (nsim,), jnp.float32)
+    e = jax.random.exponential(ke, (nsim,), jnp.float32)
+    s = 2.0 * jax.random.bernoulli(ks, 0.5, (nsim,)).astype(jnp.float32) - 1.0
+    x = z + jnp.asarray(c, jnp.float32) * e * s
+    idx = jnp.int32(jnp.ceil(jnp.asarray(p) * nsim)) - 1  # R is 1-indexed
+    return jnp.sort(x)[idx]

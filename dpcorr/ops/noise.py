@@ -1,0 +1,38 @@
+"""Laplace noise and clipping.
+
+The reference has two Laplace samplers — ``extraDistr::rlaplace`` wrapped as
+``rLap`` (vert-cor.R:106) and a hand-rolled inverse-CDF version
+(real-data-sims.R:58-61: ``-scale*sign(u)*log(1-2|u|)`` for u~U(-.5,.5)).
+Both are Laplace(0, scale); here there is exactly one implementation on top
+of JAX's counter-based PRNG, usable under ``jit``/``vmap`` and on TPU.
+
+Clipping is the reference's ubiquitous ``pmax(pmin(x, λ), -λ)``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def laplace(key: jax.Array, shape: Sequence[int] | tuple = (),
+            scale: jax.Array | float = 1.0,
+            dtype=jnp.float32) -> jax.Array:
+    """Laplace(0, scale) draws. ``scale`` may be a scalar or broadcastable.
+
+    Equivalent in distribution to ``rLap(n, scale)`` (vert-cor.R:106,
+    real-data-sims.R:58-61).
+    """
+    return jax.random.laplace(key, shape=tuple(shape), dtype=dtype) * scale
+
+
+def clip(x: jax.Array, lo, hi) -> jax.Array:
+    """``pmin(pmax(x, lo), hi)`` (e.g. real-data-sims.R:67)."""
+    return jnp.clip(x, lo, hi)
+
+
+def clip_sym(x: jax.Array, lam) -> jax.Array:
+    """Symmetric clip to [-λ, λ] (e.g. ver-cor-subG.R:33-34)."""
+    return jnp.clip(x, -lam, lam)

@@ -1,0 +1,82 @@
+"""DP standardization primitives (reference layer L1).
+
+Two families in the reference:
+
+- the simulation-side ``priv_standardize`` with a symmetric clip and an ε
+  split in half between DP mean and DP second moment (vert-cor.R:322-348);
+- the real-data building blocks ``dp_mean`` / ``dp_sd`` /
+  ``standardize_dp`` with asymmetric [lo, hi] bounds
+  (real-data-sims.R:64-100).
+
+All are pure functions of (key, data, bounds, ε); NA handling is done
+host-side before entering kernels (the reference's ``x[!is.na(x)]`` /
+pairwise-complete filters, real-data-sims.R:65, 119-120, 187-188).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dpcorr.ops.noise import clip, clip_sym, laplace
+from dpcorr.utils.rng import stream
+
+
+def priv_standardize(key: jax.Array, vec: jax.Array, eps_norm, l_raw=6.0,
+                     var_floor=1e-12) -> jax.Array:
+    """DP center–scale with a single pre-clip (vert-cor.R:322-348).
+
+    Clip at ±l_raw; split ε in half; DP mean (sensitivity 2L/n) and DP
+    second moment (sensitivity 2L²/n) via one Laplace draw each; variance
+    floored at ``var_floor`` (vert-cor.R:343); standardize without further
+    clipping.
+    """
+    n = vec.shape[0]
+    x = clip_sym(vec, l_raw)
+    eps_half = eps_norm / 2.0
+    mu_priv = jnp.mean(x) + laplace(stream(key, "mu"), (), 2.0 * l_raw / (n * eps_half))
+    m2_priv = jnp.mean(x * x) + laplace(stream(key, "m2"), (), 2.0 * l_raw * l_raw / (n * eps_half))
+    var_priv = jnp.maximum(m2_priv - mu_priv * mu_priv, var_floor)
+    return (x - mu_priv) / jnp.sqrt(var_priv)
+
+
+def dp_mean(key: jax.Array, x: jax.Array, lo, hi, eps) -> jax.Array:
+    """Clipped DP mean, sensitivity (hi−lo)/n (real-data-sims.R:64-70)."""
+    n = x.shape[0]
+    return jnp.mean(clip(x, lo, hi)) + laplace(key, (), (hi - lo) / (n * eps))
+
+
+def dp_second_moment(key: jax.Array, x: jax.Array, lo, hi, eps) -> jax.Array:
+    """Clipped DP E[x²].
+
+    The reference uses sensitivity (hi²−lo²)/n (real-data-sims.R:80), valid
+    for its use sites where 0 ≤ lo < hi (age [45,90], BMI [15,35]). As a
+    generic primitive that formula degenerates to zero noise for symmetric
+    bounds, so we use the correct range of x² over [lo, hi]: when the bounds
+    straddle 0, x² ∈ [0, max(lo², hi²)]; otherwise |hi²−lo²|. Reduces to the
+    reference's exactly on its domain.
+    """
+    n = x.shape[0]
+    xc = clip(x, lo, hi)
+    lo2, hi2 = lo * lo, hi * hi
+    straddles = (lo < 0.0) & (hi > 0.0)
+    sens_range = jnp.where(straddles, jnp.maximum(lo2, hi2), jnp.abs(hi2 - lo2))
+    return jnp.mean(xc * xc) + laplace(key, (), sens_range / (n * eps))
+
+
+def dp_sd(key: jax.Array, x: jax.Array, lo, hi, eps1, eps2):
+    """Private (mean, sd) via clipped 2nd moment (real-data-sims.R:73-84).
+
+    sd = √max(m2 − μ², 0) — floored at exactly 0 as in the reference (:82),
+    unlike :func:`priv_standardize`'s 1e-12 floor.
+    """
+    mu = dp_mean(stream(key, "mean"), x, lo, hi, eps1)
+    m2 = dp_second_moment(stream(key, "m2"), x, lo, hi, eps2)
+    sd = jnp.sqrt(jnp.maximum(m2 - mu * mu, 0.0))
+    return mu, sd
+
+
+def standardize_dp(x: jax.Array, priv_mean, priv_sd, lo, hi, eps=1e-8) -> jax.Array:
+    """Clip to [lo, hi] then standardize by private moments with an sd floor
+    (real-data-sims.R:87-100)."""
+    return (clip(x, lo, hi) - priv_mean) / jnp.maximum(priv_sd, eps)

@@ -1,0 +1,1 @@
+"""Utilities: RNG key-tree, configuration, profiling, checkpointing."""

@@ -1,0 +1,30 @@
+"""Test configuration: run on CPU with 8 virtual devices.
+
+Multi-device sharding paths (SURVEY.md §4) are exercised without TPU
+hardware. Note: this environment preloads JAX at interpreter startup (axon
+site hook), so setting JAX_PLATFORMS via os.environ here is too late — the
+config values are already captured. ``jax.config.update`` works after
+import, as long as no backend has been initialized yet.
+"""
+
+import os
+
+# Still set env for any subprocesses tests may spawn.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

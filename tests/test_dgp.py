@@ -1,0 +1,69 @@
+"""DGP tests: known-truth moments/correlations (the reference's oracle —
+SURVEY.md §4 item 3)."""
+
+import jax
+import numpy as np
+import pytest
+
+from dpcorr.models.dgp import (
+    gen_bernoulli,
+    gen_bounded_factor,
+    gen_gaussian,
+    gen_mix_gaussian,
+)
+from dpcorr.utils import rng
+
+KEY = rng.master_key(11)
+N = 60_000
+
+
+def _corr(xy):
+    xy = np.asarray(xy)
+    return np.corrcoef(xy[:, 0], xy[:, 1])[0, 1]
+
+
+@pytest.mark.parametrize("rho", [-0.95, -0.3, 0.0, 0.5, 0.9])
+def test_gaussian_corr(rho):
+    xy = gen_gaussian(KEY, N, rho)
+    assert abs(_corr(xy) - rho) < 0.02
+    assert abs(np.asarray(xy).mean()) < 0.02
+
+
+def test_gaussian_mu_sigma():
+    xy = np.asarray(gen_gaussian(KEY, N, 0.4, mu=(2.0, 2.0), sigma=(2.0, 0.1)))
+    np.testing.assert_allclose(xy.mean(axis=0), [2.0, 2.0], atol=0.05)
+    np.testing.assert_allclose(xy.std(axis=0), [2.0, 0.1], rtol=0.03)
+    assert abs(_corr(xy) - 0.4) < 0.02
+
+
+@pytest.mark.parametrize("rho", [0.0, 0.3, 0.8])
+def test_bernoulli(rho):
+    xy = np.asarray(gen_bernoulli(KEY, N, rho))
+    assert set(np.unique(xy)) <= {0.0, 1.0}
+    np.testing.assert_allclose(xy.mean(axis=0), [0.5, 0.5], atol=0.02)
+    assert abs(_corr(xy) - rho) < 0.02
+
+
+@pytest.mark.parametrize("rho", [0.0, 0.5, 0.9])
+def test_bounded_factor(rho):
+    xy = np.asarray(gen_bounded_factor(KEY, N, rho))
+    np.testing.assert_allclose(xy.mean(axis=0), [0.0, 0.0], atol=0.03)
+    np.testing.assert_allclose(xy.var(axis=0), [1.0, 1.0], rtol=0.03)
+    assert abs(_corr(xy) - rho) < 0.02
+    bound = np.sqrt(3 * rho) + np.sqrt(3 * (1 - rho))
+    assert np.abs(xy).max() <= bound + 1e-5
+
+
+def test_mix_gaussian_clipped():
+    xy = np.asarray(gen_mix_gaussian(KEY, N, 0.5))
+    assert np.abs(xy).max() <= 1.0  # hard clip, ver-cor-subG.R:135
+    # both components present (pi=0.5): clip means many values pinned at ±1
+    assert (xy == 1.0).mean() > 0.05
+
+
+def test_vmap_over_keys():
+    keys = rng.rep_keys(KEY, 8)
+    batch = jax.vmap(lambda k: gen_gaussian(k, 100, 0.5))(keys)
+    assert batch.shape == (8, 100, 2)
+    flat = np.asarray(batch).reshape(8, -1)
+    assert len(np.unique(flat[:, 0])) == 8  # distinct draws per rep

@@ -1,0 +1,10 @@
+"""Environment invariants: the virtual 8-device CPU mesh must be live so
+sharding paths are actually exercised (SURVEY.md §4)."""
+
+import jax
+
+
+def test_virtual_mesh_is_live(devices):
+    assert len(devices) == 8
+    assert all(d.platform == "cpu" for d in devices)
+    assert jax.device_count() == 8

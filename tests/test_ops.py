@@ -1,0 +1,172 @@
+"""Unit tests for DP primitives: noise moments, clipping, λ rules, mixquant,
+standardization — the closed-form checks SURVEY.md §4 mandates."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.integrate
+import scipy.stats
+
+from dpcorr.ops import (
+    clip,
+    clip_sym,
+    dp_mean,
+    dp_sd,
+    lambda_from_priv,
+    lambda_int_n,
+    lambda_n,
+    lambda_receiver_from_noise,
+    laplace,
+    mixquant,
+    mixquant_mc,
+    priv_standardize,
+    standardize_dp,
+)
+from dpcorr.ops.mixquant import mix_cdf
+from dpcorr.utils import rng
+
+
+KEY = rng.master_key()
+
+
+class TestRng:
+    def test_deterministic(self):
+        a = laplace(rng.master_key(7), (5,), 1.0)
+        b = laplace(rng.master_key(7), (5,), 1.0)
+        np.testing.assert_array_equal(a, b)
+
+    def test_streams_differ(self):
+        k = rng.master_key()
+        a = laplace(rng.stream(k, "x"), (5,), 1.0)
+        b = laplace(rng.stream(k, "y"), (5,), 1.0)
+        assert not np.allclose(a, b)
+
+    def test_rep_keys_distinct(self):
+        keys = rng.rep_keys(KEY, 100)
+        data = jax.vmap(lambda k: jax.random.normal(k, ()))(keys)
+        assert len(np.unique(np.asarray(data))) == 100
+
+    def test_design_key_folding(self):
+        k1 = rng.design_key(KEY, 1)
+        k2 = rng.design_key(KEY, 2)
+        assert not np.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+
+
+class TestLaplace:
+    def test_moments(self):
+        x = np.asarray(laplace(KEY, (200_000,), 3.0))
+        # mean 0, var = 2·scale²
+        assert abs(x.mean()) < 0.05
+        np.testing.assert_allclose(x.var(), 2 * 3.0**2, rtol=0.02)
+
+    def test_scale_broadcast(self):
+        scales = jnp.array([1.0, 2.0, 4.0])
+        x = laplace(KEY, (50_000, 3), scales)
+        v = np.asarray(x).var(axis=0)
+        np.testing.assert_allclose(v, 2 * np.asarray(scales) ** 2, rtol=0.05)
+
+
+class TestClip:
+    def test_clip(self):
+        x = jnp.array([-5.0, 0.0, 5.0])
+        np.testing.assert_array_equal(clip(x, -1.0, 2.0), [-1.0, 0.0, 2.0])
+        np.testing.assert_array_equal(clip_sym(x, 1.5), [-1.5, 0.0, 1.5])
+
+    def test_idempotent(self):
+        x = jax.random.normal(KEY, (100,))
+        once = clip_sym(x, 0.7)
+        np.testing.assert_array_equal(once, clip_sym(once, 0.7))
+
+
+class TestLambdas:
+    def test_lambda_n(self):
+        # min(2η√log n, 2√3) — ver-cor-subG.R:1
+        for n, eta in [(100, 1.0), (10_000, 0.5), (50, 2.0)]:
+            expected = min(2 * eta * np.sqrt(np.log(n)), 2 * np.sqrt(3))
+            np.testing.assert_allclose(float(lambda_n(n, eta)), expected, rtol=1e-6)
+
+    def test_lambda_int_n(self):
+        lam_s, lam_r = lambda_int_n(5000, eta_s=1.0, eta_r=2.0, eps_s=0.5)
+        np.testing.assert_allclose(
+            float(lam_s), min(2 * np.sqrt(np.log(5000)), 2 * np.sqrt(3)), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            float(lam_r), 5 * 2.0 * min(np.log(5000), 6) / 0.5, rtol=1e-6
+        )
+
+    def test_lambda_from_priv(self):
+        val = float(lambda_from_priv(45.0, 90.0, 70.0, 10.0))
+        np.testing.assert_allclose(val, max(abs(45 - 70), abs(90 - 70)) / 10.0, rtol=1e-6)
+
+    def test_lambda_receiver(self):
+        lam = float(lambda_receiver_from_noise(2.0, 3.0, 0.5, 0.01))
+        b_s = 2 * 2.0 / 0.5
+        np.testing.assert_allclose(lam, (2.0 + b_s * np.log(100)) * 3.0, rtol=1e-4)
+
+
+class TestMixquant:
+    @pytest.mark.parametrize("c", [0.01, 0.1, 0.5, 1.0, 3.0, 10.0])
+    def test_cdf_against_numeric_convolution(self, c):
+        xs = np.linspace(-4 - 4 * c, 4 + 4 * c, 9)
+        for x in xs:
+            num, _ = scipy.integrate.quad(
+                lambda l: 0.5 * np.exp(-abs(l)) * scipy.stats.norm.cdf(x - c * l),
+                -60, 60, limit=400,
+            )
+            got = float(mix_cdf(x, c))
+            assert abs(got - num) < 2e-5, (x, c, got, num)
+
+    def test_quantile_inverts_cdf(self):
+        for c in [0.05, 0.3, 1.0, 5.0]:
+            for p in [0.6, 0.9, 0.975, 0.999]:
+                q = float(mixquant(c, p))
+                np.testing.assert_allclose(float(mix_cdf(q, c)), p, atol=2e-5)
+
+    def test_c_zero_limit_is_normal_quantile(self):
+        np.testing.assert_allclose(
+            float(mixquant(1e-6, 0.975)), scipy.stats.norm.ppf(0.975), atol=1e-3
+        )
+
+    def test_mc_matches_deterministic(self):
+        # Mean of the reference's noisy MC order statistic should approach the
+        # deterministic quantile (Appendix A #4 substitution check).
+        c, p = 0.8, 0.975
+        keys = jax.random.split(rng.master_key(3), 400)
+        qs = jax.vmap(lambda k: mixquant_mc(k, c, p, nsim=1000))(keys)
+        det = float(mixquant(c, p))
+        assert abs(float(jnp.mean(qs)) - det) < 0.05
+
+    def test_symmetry(self):
+        # median is 0 for the symmetric mixture
+        assert abs(float(mixquant(1.3, 0.5))) < 1e-4
+
+
+class TestStandardize:
+    def test_priv_standardize_low_noise(self):
+        x = jax.random.normal(KEY, (20_000,)) * 2.0 + 5.0
+        z = np.asarray(priv_standardize(rng.stream(KEY, "ps"), x, eps_norm=1e6, l_raw=20.0))
+        assert abs(z.mean()) < 0.02
+        np.testing.assert_allclose(z.std(), 1.0, atol=0.02)
+
+    def test_dp_mean_clips(self):
+        # with huge eps (no noise), dp_mean == mean of clipped values
+        x = jnp.array([-100.0, 0.0, 100.0])
+        m = float(dp_mean(KEY, x, -1.0, 1.0, 1e9))
+        np.testing.assert_allclose(m, 0.0, atol=1e-5)
+
+    def test_dp_sd_floor_at_zero(self):
+        # constant data with moderate noise can drive var negative; sd must be >= 0
+        x = jnp.ones((50,))
+        for s in range(20):
+            _, sd = dp_sd(rng.master_key(s), x, 0.0, 2.0, 0.5, 0.5)
+            assert float(sd) >= 0.0
+
+    def test_standardize_dp(self):
+        x = jnp.array([0.0, 5.0, 10.0])
+        z = np.asarray(standardize_dp(x, 5.0, 2.0, 0.0, 10.0))
+        np.testing.assert_allclose(z, [-2.5, 0.0, 2.5], atol=1e-6)
+
+    def test_standardize_dp_sd_floor(self):
+        z = standardize_dp(jnp.array([1.0]), 0.0, 0.0, -5.0, 5.0)
+        assert np.isfinite(float(z[0]))

@@ -39,8 +39,8 @@ def gen_bernoulli(key: jax.Array, n: int, rho) -> jax.Array:
     """Correlated Bernoulli(0.5) pair with Corr(X,Y)=ρ via conditional
     inversion: p11 = ¼+ρ/4, p01 = ¼−ρ/4 (vert-cor.R:78-98)."""
     rho = jnp.asarray(rho, jnp.float32)
-    u = jax.random.uniform(stream(key, "u"), (n,), jnp.float32)
-    v = jax.random.uniform(stream(key, "v"), (n,), jnp.float32)
+    u = jax.random.uniform(stream(key, "bernoulli/u"), (n,), jnp.float32)
+    v = jax.random.uniform(stream(key, "bernoulli/v"), (n,), jnp.float32)
     p11 = 0.25 + rho / 4.0
     p01 = 0.25 - rho / 4.0
     x = (u < 0.5).astype(jnp.float32)
@@ -61,10 +61,10 @@ def gen_mix_gaussian(key: jax.Array, n: int, rho,
     The reference stacks the two component blocks and shuffles rows; drawing
     a per-row label is distribution-identical and stays static-shaped.
     """
-    labels = jax.random.bernoulli(stream(key, "labels"), pi_mix, (n,))
-    out0 = _bvn(stream(key, "comp0"), n, rho, jnp.asarray(mu0, jnp.float32),
+    labels = jax.random.bernoulli(stream(key, "mix_gaussian/labels"), pi_mix, (n,))
+    out0 = _bvn(stream(key, "mix_gaussian/comp0"), n, rho, jnp.asarray(mu0, jnp.float32),
                 jnp.asarray(sigma0, jnp.float32))
-    out1 = _bvn(stream(key, "comp1"), n, rho, jnp.asarray(mu1, jnp.float32),
+    out1 = _bvn(stream(key, "mix_gaussian/comp1"), n, rho, jnp.asarray(mu1, jnp.float32),
                 jnp.asarray(sigma1, jnp.float32))
     out = jnp.where(labels[:, None], out1, out0)
     return clip_sym(out, 1.0)
@@ -77,9 +77,9 @@ def gen_bounded_factor(key: jax.Array, n: int, rho) -> jax.Array:
     rho = jnp.asarray(rho, jnp.float32)
     c_u = jnp.sqrt(3.0 * rho)
     c_e = jnp.sqrt(3.0 * (1.0 - rho))
-    u = jax.random.uniform(stream(key, "U"), (n,), jnp.float32, -1.0, 1.0) * c_u
-    e1 = jax.random.uniform(stream(key, "E1"), (n,), jnp.float32, -1.0, 1.0) * c_e
-    e2 = jax.random.uniform(stream(key, "E2"), (n,), jnp.float32, -1.0, 1.0) * c_e
+    u = jax.random.uniform(stream(key, "bounded_factor/U"), (n,), jnp.float32, -1.0, 1.0) * c_u
+    e1 = jax.random.uniform(stream(key, "bounded_factor/E1"), (n,), jnp.float32, -1.0, 1.0) * c_e
+    e2 = jax.random.uniform(stream(key, "bounded_factor/E2"), (n,), jnp.float32, -1.0, 1.0) * c_e
     return jnp.stack([u + e1, u + e2], axis=1)
 
 

@@ -1,0 +1,30 @@
+"""DP correlation estimators and CI constructors (reference layer L2).
+
+Four families (SURVEY.md §2.2):
+
+- A. :mod:`ni_sign`  — non-interactive sign-batch (Gaussian), sine link.
+- B. :mod:`int_sign` — one-round interactive randomized-response, sine link.
+- C. :mod:`ni_subg`  — non-interactive clipped-batch (sub-Gaussian), no link.
+- D. :mod:`int_subg` — interactive clipped (local-DP sender + central-DP
+  receiver), with the grid (v1) and real-data (v2) variants exposed as
+  explicit parameters per the duplication ledger (SURVEY.md Appendix A).
+
+Every estimator is a pure function ``f(key, x, y, eps1, eps2, ...) ->
+result`` with static batch geometry, so ``jax.vmap`` over keys evaluates a
+full Monte-Carlo replication batch as one fused kernel.
+"""
+
+from dpcorr.models.estimators.common import (  # noqa: F401
+    batch_geometry,
+    CorrResult,
+)
+from dpcorr.models.estimators.ni_sign import (  # noqa: F401
+    correlation_ni_signbatch,
+    ci_ni_signbatch,
+)
+from dpcorr.models.estimators.int_sign import (  # noqa: F401
+    correlation_int_signflip,
+    ci_int_signflip,
+)
+from dpcorr.models.estimators.ni_subg import correlation_ni_subg  # noqa: F401
+from dpcorr.models.estimators.int_subg import ci_int_subg  # noqa: F401

@@ -1,0 +1,52 @@
+"""Shared estimator plumbing: batch geometry, result container, R-compatible
+sample sd."""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CorrResult(NamedTuple):
+    """Point estimate + CI. Extra per-variant fields ride in ``aux``."""
+
+    rho_hat: jax.Array
+    ci_low: jax.Array
+    ci_high: jax.Array
+
+
+def batch_geometry(n: int, eps1: float, eps2: float,
+                   enforce_min_k: bool = False) -> tuple[int, int]:
+    """(m, k): batch size m = ⌈8/(ε₁ε₂)⌉ capped at n, k = ⌊n/m⌋ full batches.
+
+    The paper's optimal batch design (vert-cor.R:124-126, ver-cor-subG.R:37-38).
+    ``enforce_min_k`` adds the real-data fallback: if k < 2 then k = 2,
+    m = ⌊n/2⌋ (real-data-sims.R:130). Static per design point — shapes are
+    known at trace time, which is what keeps the kernels jit-compilable.
+    """
+    if n < 1:
+        raise ValueError(f"Need at least one observation, got n={n}")
+    m = math.ceil(8.0 / (eps1 * eps2))
+    m = min(m, n)
+    k = n // m
+    if enforce_min_k and k < 2:
+        k, m = 2, n // 2
+    if k < 1:
+        raise ValueError(
+            f"Need at least one full batch: n={n}, m={m} (vert-cor.R:127)")
+    return m, k
+
+
+def sample_sd(x: jax.Array) -> jax.Array:
+    """R's ``sd``: denominator n−1."""
+    return jnp.std(x, ddof=1)
+
+
+def batch_means(v: jax.Array, k: int, m: int) -> jax.Array:
+    """Means of k consecutive batches of size m over the first k·m entries
+    (vert-cor.R:131-140; the ``matrix(..., byrow=TRUE)`` + ``rowMeans`` form
+    at ver-cor-subG.R:41-45)."""
+    return v[: k * m].reshape(k, m).mean(axis=1)

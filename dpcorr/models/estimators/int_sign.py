@@ -1,0 +1,98 @@
+"""B. One-round interactive sign-flip (randomized response) estimator + CI.
+
+Reference: ``correlation_INT_signflip`` (vert-cor.R:164-195) and
+``ci_INT_signflip`` (vert-cor.R:260-317). Math (SURVEY.md §2.2-B):
+
+1. Sender = side with the larger ε (static at trace time).
+2. Randomized response on the sender's signs with keep-prob
+   p = e^{ε_s}/(e^{ε_s}+1); core_i = (2S_i−1)·sign(X_i)·sign(Y_i).
+3. Receiver debiases and adds one Laplace draw:
+   η̂ = (e^{ε_s}+1)/(n(e^{ε_s}−1))·Σcore + Lap(2(e^{ε_s}+1)/(n(e^{ε_s}−1)ε_r)).
+4. ρ̂ = sin(π·η̂/2).
+5. CI: η̂ recovered via (2/π)·asin(ρ̂); σ²_η = 1 − ((e^{ε_s}−1)/(e^{ε_s}+1))²η̂²;
+   regime switch at √n·ε_r > 0.5 — the normal regime widths use the
+   Gaussian+Laplace mixture quantile, the Laplace regime a pure-Laplace tail
+   bound; both act in η-space, clamped there, then sine-mapped.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from dpcorr.models.estimators.common import CorrResult
+from dpcorr.ops.mixquant import mixquant
+from dpcorr.ops.noise import laplace
+from dpcorr.ops.standardize import priv_standardize
+from dpcorr.utils.rng import stream
+
+
+def correlation_int_signflip(key: jax.Array, x: jax.Array, y: jax.Array,
+                             eps1: float, eps2: float) -> jax.Array:
+    """Point estimator ρ̂ (vert-cor.R:164-195). Inputs pre-standardized.
+
+    The flipped product (2S−1)·sign(X)·sign(Y) is symmetric in the roles, so
+    only the (ε_s, ε_r) assignment depends on the sender choice
+    (vert-cor.R:178-183).
+    """
+    n = x.shape[0]
+    eps_s, eps_r = max(eps1, eps2), min(eps1, eps2)  # vert-cor.R:170-172
+    e_s = math.exp(eps_s)
+    p_keep = e_s / (e_s + 1.0)
+    s = jax.random.bernoulli(stream(key, "int_sign/flips"), p_keep, (n,))
+    core = (2.0 * s.astype(jnp.float32) - 1.0) * jnp.sign(x) * jnp.sign(y)
+    scale_z = 2.0 * (e_s + 1.0) / (n * (e_s - 1.0) * eps_r)
+    z = laplace(stream(key, "int_sign/lap_z"), (), scale_z)
+    eta_hat = (e_s + 1.0) / (n * (e_s - 1.0)) * jnp.sum(core) + z
+    return jnp.sin(jnp.pi * eta_hat / 2.0)
+
+
+def ci_int_signflip(key: jax.Array, x: jax.Array, y: jax.Array,
+                    eps1: float, eps2: float, alpha: float = 0.05,
+                    mode: str = "auto", normalise: bool = True,
+                    mixquant_mode: str = "det") -> CorrResult:
+    """Estimate + CI (vert-cor.R:260-317).
+
+    ``mode``: "auto" switches normal/laplace at √n·ε_r > 0.5
+    (vert-cor.R:294-296) — static per design point. ``mixquant_mode``:
+    "det" uses the closed-form quantile; "mc" reproduces the reference's
+    per-CI 1000-draw order statistic (vert-cor.R:302).
+    """
+    n = x.shape[0]
+    if normalise:
+        l_clip = jnp.sqrt(2.0 * jnp.log(float(n)))
+        x = priv_standardize(stream(key, "int_sign/std_x"), x, eps1, l_clip)
+        y = priv_standardize(stream(key, "int_sign/std_y"), y, eps2, l_clip)
+
+    eps_s, eps_r = max(eps1, eps2), min(eps1, eps2)
+    e_s = math.exp(eps_s)
+    ratio = (e_s + 1.0) / (e_s - 1.0)
+
+    rho_hat = correlation_int_signflip(stream(key, "int_sign/est"), x, y, eps1, eps2)
+    # η̂ back out of ρ̂: 1 − (2/π)·acos(ρ̂) ≡ (2/π)·asin(ρ̂) (vert-cor.R:281)
+    eta_hat = 1.0 - jnp.arccos(rho_hat) * 2.0 / jnp.pi
+    sigma_eta2 = 1.0 - (1.0 / ratio) ** 2 * eta_hat**2  # vert-cor.R:284
+    se_norm_eta = jnp.sqrt(sigma_eta2) * ratio / jnp.sqrt(float(n))
+
+    if mode == "auto":  # static switch (vert-cor.R:294-296)
+        mode = "normal" if math.sqrt(n) * eps_r > 0.5 else "laplace"
+
+    if mode == "normal":  # Case 1 in §4.1.1 (vert-cor.R:298-302)
+        cstar = 2.0 / (jnp.sqrt(n * sigma_eta2) * eps_r)
+        if mixquant_mode == "mc":
+            from dpcorr.ops.mixquant import mixquant_mc
+
+            q = mixquant_mc(stream(key, "int_sign/mixquant"), cstar, 1.0 - alpha / 2.0)
+        else:
+            q = mixquant(cstar, 1.0 - alpha / 2.0)
+        width_eta = q * se_norm_eta
+    elif mode == "laplace":  # Case 2 (vert-cor.R:303-308)
+        width_eta = (2.0 / (n * eps_r)) * ratio * math.log(1.0 / alpha)
+    else:
+        raise ValueError(f"mode must be auto|normal|laplace, got {mode!r}")
+
+    lo = jnp.sin(jnp.pi / 2.0 * jnp.maximum(eta_hat - width_eta, -1.0))
+    hi = jnp.sin(jnp.pi / 2.0 * jnp.minimum(eta_hat + width_eta, 1.0))
+    return CorrResult(rho_hat, lo, hi)

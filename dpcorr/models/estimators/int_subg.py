@@ -1,0 +1,112 @@
+"""D. Interactive clipped estimator + CI (sub-Gaussian).
+
+Reference: ``ci_INT_subG`` — grid variant ver-cor-subG.R:67-108, real-data
+variant real-data-sims.R:176-252. Math (SURVEY.md §2.2-D):
+
+Sender clips at λ_s and releases ``clip(X) + Lap(2λ_s/ε_s)`` *per sample*
+(local DP); the receiver multiplies by its own variable, clips the product
+at λ_r, then takes the mean plus one central-DP Laplace draw
+``Lap(2λ_r/(n·ε_r))``.
+
+The variants differ in documented ways (SURVEY.md Appendix A #3), selected
+via ``variant``:
+
+- ``"grid"`` (v1): λ pair from ``lambda_INT_n``; the receiver's own variable
+  is **not** clipped before the product; CI se includes the Laplace noise
+  term ``√(sd(Uc)² + 2(2λ_r/(nε_r))²)``; c* = 2/(√n·sd(Uc)·ε_r).
+- ``"real"`` (v2): λ_sender/λ_other/λ_receiver overrides with
+  ``lambda_receiver_from_noise`` default and per-sample tail δ (default 1/n);
+  the other variable **is** clipped to ±λ_other; sampling-only se =
+  sd(Uc)/√n; c* = 2λ_r/(√n·sd(Uc)·ε_r); degenerate sd(Uc)=0 branch
+  (real-data-sims.R:237-238) handled branch-free with ``where``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import ndtri
+
+from dpcorr.models.estimators.common import CorrResult, sample_sd
+from dpcorr.ops.lambdas import lambda_int_n, lambda_n, lambda_receiver_from_noise
+from dpcorr.ops.mixquant import mixquant, mixquant_mc
+from dpcorr.ops.noise import clip_sym, laplace
+from dpcorr.utils.rng import stream
+
+_CSTAR_MAX = 1e6  # sd(Uc)→0 sends c*→∞; a huge finite c* yields width → ±1 CI
+
+
+def ci_int_subg(key: jax.Array, x: jax.Array, y: jax.Array,
+                eps1: float, eps2: float,
+                eta1: float = 1.0, eta2: float = 1.0,
+                alpha: float = 0.05,
+                variant: str = "grid",
+                lambda_sender=None, lambda_other=None, lambda_receiver=None,
+                delta_clip: float | None = None,
+                mixquant_mode: str = "det") -> CorrResult:
+    """One-round interactive clipped DP correlation estimate + mixture CI."""
+    if variant not in ("grid", "real"):
+        raise ValueError(f"variant must be 'grid' or 'real', got {variant!r}")
+    n = x.shape[0]
+
+    # Roles: larger ε sends (ver-cor-subG.R:76-81) — static.
+    sender_is_x = eps1 >= eps2
+    eps_s, eps_r = (eps1, eps2) if sender_is_x else (eps2, eps1)
+    eta_s, eta_r = (eta1, eta2) if sender_is_x else (eta2, eta1)
+    xs, xo = (x, y) if sender_is_x else (y, x)  # sender var, other var
+
+    if variant == "grid":
+        lam_s, lam_r = lambda_int_n(n, eta_s=eta_s, eta_r=eta_r, eps_s=eps_s)
+        if lambda_sender is not None:
+            lam_s = lambda_sender
+        if lambda_receiver is not None:
+            lam_r = lambda_receiver
+        other = xo  # v1 does NOT clip the receiver's own variable
+    else:
+        if delta_clip is None:
+            delta_clip = 1.0 / n  # real-data-sims.R:199
+        lam_s = lambda_sender
+        lam_o = lambda_other
+        if lam_s is None or lam_o is None:
+            lam_pair = lambda_int_n(n, eta_s=eta_s, eta_r=eta_r, eps_s=eps_s)
+            if lam_s is None:
+                lam_s = lam_pair[0]
+            if lam_o is None:
+                lam_o = lambda_n(n, eta2 if sender_is_x else eta1)
+        lam_r = lambda_receiver
+        if lam_r is None:
+            lam_r = lambda_receiver_from_noise(lam_s, lam_o, eps_s, delta_clip)
+        other = clip_sym(xo, lam_o)
+
+    # Sender local-DP release, receiver product + clip + one central draw
+    # (ver-cor-subG.R:87-97 / real-data-sims.R:221-233).
+    sc = clip_sym(xs, lam_s)
+    u = (sc + laplace(stream(key, "int_subg/lap_sender"), (n,), 2.0 * lam_s / eps_s)) * other
+    uc = clip_sym(u, lam_r)
+    central_scale = 2.0 * lam_r / (n * eps_r)
+    rho_hat = jnp.mean(uc) + laplace(stream(key, "int_subg/lap_recv"), (), central_scale)
+
+    sd_uc = sample_sd(uc)
+    sd_safe = jnp.maximum(sd_uc, 1e-30)
+    p = 1.0 - alpha / 2.0
+    if variant == "grid":
+        # se includes the central-noise variance term (ver-cor-subG.R:99-101)
+        se_norm = jnp.sqrt(sd_uc**2 + 2.0 * central_scale**2)
+        cstar = jnp.minimum(2.0 / (jnp.sqrt(float(n)) * sd_safe * eps_r), _CSTAR_MAX)
+        q = (mixquant_mc(stream(key, "int_subg/mixquant"), cstar, p) if mixquant_mode == "mc"
+             else mixquant(cstar, p))
+        width = q * se_norm / jnp.sqrt(float(n))
+    else:
+        # sampling-only se + explicit sd==0 degenerate branch
+        # (real-data-sims.R:237-242)
+        cstar = jnp.minimum(2.0 * lam_r / (jnp.sqrt(float(n)) * sd_safe * eps_r),
+                            _CSTAR_MAX)
+        q = (mixquant_mc(stream(key, "int_subg/mixquant"), cstar, p) if mixquant_mode == "mc"
+             else mixquant(cstar, p))
+        width_mix = q * sd_uc / jnp.sqrt(float(n))
+        width_deg = ndtri(p) * jnp.sqrt(2.0) * central_scale
+        width = jnp.where(sd_uc == 0.0, width_deg, width_mix)
+
+    lo = jnp.maximum(rho_hat - width, -1.0)  # ρ-space clamp
+    hi = jnp.minimum(rho_hat + width, 1.0)
+    return CorrResult(rho_hat, lo, hi)

@@ -1,0 +1,78 @@
+"""A. Non-interactive sign-batch estimator + CI (Gaussian).
+
+Reference: ``correlation_NI_signbatch`` (vert-cor.R:118-156) and
+``ci_NI_signbatch`` (vert-cor.R:204-255). Math (SURVEY.md §2.2-A):
+
+1. m = ⌈8/(ε₁ε₂)⌉ capped at n; k = ⌊n/m⌋ batches.
+2. Per batch j: means of signs X̄_j, Ȳ_j over m consecutive points.
+3. X̃_j = X̄_j + Lap(2/(m·ε₁)) — the sensitivity of a sign-mean is 2/m.
+4. η̂ = (m/k)·Σ_j X̃_j Ỹ_j; ρ̂ = sin(π·η̂/2) (Grothendieck/arcsine identity).
+5. CI built in η-space from T_j = m·X̃_j Ỹ_j: η̂ ± z·sd(T_j)/√k, **clamped
+   in η-space to [−1,1] and then sine-mapped** — the clamp order matters
+   for coverage (vert-cor.R:249-254, SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import ndtri
+
+from dpcorr.models.estimators.common import (
+    CorrResult,
+    batch_geometry,
+    batch_means,
+    sample_sd,
+)
+from dpcorr.ops.noise import laplace
+from dpcorr.ops.standardize import priv_standardize
+from dpcorr.utils.rng import stream
+
+
+def _noisy_batch_products(key, x, y, eps1, eps2, m, k):
+    """Steps 2-3: sign batch means + Laplace, returning X̃, Ỹ."""
+    xbar = batch_means(jnp.sign(x), k, m)
+    ybar = batch_means(jnp.sign(y), k, m)
+    xt = xbar + laplace(stream(key, "ni_sign/lap_x"), (k,), 2.0 / (m * eps1))
+    yt = ybar + laplace(stream(key, "ni_sign/lap_y"), (k,), 2.0 / (m * eps2))
+    return xt, yt
+
+
+def correlation_ni_signbatch(key: jax.Array, x: jax.Array, y: jax.Array,
+                             eps1: float, eps2: float) -> jax.Array:
+    """Point estimator ρ̂ (vert-cor.R:118-156). Inputs pre-standardized."""
+    n = x.shape[0]
+    m, k = batch_geometry(n, eps1, eps2)
+    xt, yt = _noisy_batch_products(key, x, y, eps1, eps2, m, k)
+    eta_hat = (m / k) * jnp.sum(xt * yt)
+    return jnp.sin(jnp.pi * eta_hat / 2.0)
+
+
+def ci_ni_signbatch(key: jax.Array, x: jax.Array, y: jax.Array,
+                    eps1: float, eps2: float, alpha: float = 0.05,
+                    normalise: bool = True) -> CorrResult:
+    """Estimate + CI (vert-cor.R:204-255).
+
+    With ``normalise``, the *raw* values (not the signs) are privately
+    standardized first with clip L = √(2·log n), spending ε₁/ε₂ again —
+    faithful to the reference's budget accounting (vert-cor.R:211-215).
+    """
+    n = x.shape[0]
+    m, k = batch_geometry(n, eps1, eps2)
+    if normalise:
+        l_clip = jnp.sqrt(2.0 * jnp.log(float(n)))
+        x = priv_standardize(stream(key, "ni_sign/std_x"), x, eps1, l_clip)
+        y = priv_standardize(stream(key, "ni_sign/std_y"), y, eps2, l_clip)
+
+    xt, yt = _noisy_batch_products(key, x, y, eps1, eps2, m, k)
+    tj = m * xt * yt  # Sec 3.1 eq. (2) components (vert-cor.R:233)
+    eta_hat = jnp.sum(tj) / k
+    rho_hat = jnp.sin(jnp.pi * eta_hat / 2.0)
+
+    s_eta = sample_sd(tj)
+    crit = ndtri(1.0 - alpha / 2.0)
+    half = crit * s_eta / jnp.sqrt(float(k))
+    # η-space clamp THEN sine map (vert-cor.R:249-254).
+    lo = jnp.sin(jnp.pi / 2.0 * jnp.maximum(eta_hat - half, -1.0))
+    hi = jnp.sin(jnp.pi / 2.0 * jnp.minimum(eta_hat + half, 1.0))
+    return CorrResult(rho_hat, lo, hi)

@@ -1,0 +1,72 @@
+"""C. Non-interactive clipped-batch estimator + CI (sub-Gaussian).
+
+Reference: ``correlation_NI_subG`` — grid variant ver-cor-subG.R:25-62,
+real-data variant real-data-sims.R:115-147. Math (SURVEY.md §2.2-C):
+
+Clip X at ±λ₁ = λ_n(n, η₁), Y at ±λ₂; same (m, k) batch design as the
+sign estimator; Laplace scale 2λ/(m·ε) per batch mean; ρ̂ = η̂ =
+(m/k)·Σ X̃Ỹ — **no sine link**; normal CI from sd(T_j)/√k clamped in
+ρ-space to [−1, 1].
+
+The two reference variants are one function here, parameterized (SURVEY.md
+Appendix A #2):
+
+- grid (v1): sequential batches, λ from :func:`~dpcorr.ops.lambdas.lambda_n`.
+- real-data (v2): ``lambda_x``/``lambda_y`` overrides, ``randomize_batches``
+  (``sample.int`` randomized assignment, real-data-sims.R:132),
+  ``enforce_min_k`` (k≥2 fallback, real-data-sims.R:130). NA-pair removal is
+  host-side, before the kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import ndtri
+
+from dpcorr.models.estimators.common import (
+    CorrResult,
+    batch_geometry,
+    batch_means,
+    sample_sd,
+)
+from dpcorr.ops.lambdas import lambda_n
+from dpcorr.ops.noise import clip_sym, laplace
+from dpcorr.utils.rng import stream
+
+
+def correlation_ni_subg(key: jax.Array, x: jax.Array, y: jax.Array,
+                        eps1: float, eps2: float,
+                        eta1: float = 1.0, eta2: float = 1.0,
+                        alpha: float = 0.05,
+                        lambda_x=None, lambda_y=None,
+                        randomize_batches: bool = False,
+                        enforce_min_k: bool = False) -> CorrResult:
+    """Clipped-batch DP correlation estimate + normal CI."""
+    n = x.shape[0]
+    lam1 = lambda_n(n, eta1) if lambda_x is None else lambda_x
+    lam2 = lambda_n(n, eta2) if lambda_y is None else lambda_y
+
+    xc = clip_sym(x, lam1)  # ver-cor-subG.R:33-34
+    yc = clip_sym(y, lam2)
+
+    m, k = batch_geometry(n, eps1, eps2, enforce_min_k=enforce_min_k)
+    if randomize_batches:
+        # sample.int(n, k*m): k·m draws without replacement
+        # (real-data-sims.R:132)
+        idx = jax.random.permutation(stream(key, "ni_subg/perm"), n)[: k * m]
+        xc, yc = xc[idx], yc[idx]
+
+    xbar = batch_means(xc, k, m)
+    ybar = batch_means(yc, k, m)
+    xt = xbar + laplace(stream(key, "ni_subg/lap_x"), (k,), 2.0 * lam1 / (m * eps1))
+    yt = ybar + laplace(stream(key, "ni_subg/lap_y"), (k,), 2.0 * lam2 / (m * eps2))
+
+    rho_hat = (m / k) * jnp.sum(xt * yt)  # η̂ = ρ̂, no sine link (:51-52)
+
+    tj = m * xt * yt
+    se = sample_sd(tj) / jnp.sqrt(float(k))
+    crit = ndtri(1.0 - alpha / 2.0)
+    lo = jnp.maximum(rho_hat - crit * se, -1.0)  # ρ-space clamp (:58-59)
+    hi = jnp.minimum(rho_hat + crit * se, 1.0)
+    return CorrResult(rho_hat, lo, hi)

@@ -80,11 +80,18 @@ def mixquant_mc(key: jax.Array, c, p, nsim: int = 1000):
 
     ``sort(Z + c·E·S)[ceil(p·nsim)]`` with Z~N(0,1), E~Exp(1), S~±1
     (vert-cor.R:45-48; nsim=2000 variant real-data-sims.R:161-164).
+
+    ``p`` must be a concrete Python float (it always is — 1−α/2 with a
+    static α): the order-statistic index is computed host-side in float64,
+    matching R's arithmetic; float32 ``ceil(p·nsim)`` picks the wrong order
+    statistic for ~1% of p values.
     """
+    import math
+
     kz, ke, ks = jax.random.split(key, 3)
     z = jax.random.normal(kz, (nsim,), jnp.float32)
     e = jax.random.exponential(ke, (nsim,), jnp.float32)
     s = 2.0 * jax.random.bernoulli(ks, 0.5, (nsim,)).astype(jnp.float32) - 1.0
     x = z + jnp.asarray(c, jnp.float32) * e * s
-    idx = jnp.int32(jnp.ceil(jnp.asarray(p) * nsim)) - 1  # R is 1-indexed
+    idx = min(max(math.ceil(float(p) * nsim) - 1, 0), nsim - 1)  # R 1-indexed
     return jnp.sort(x)[idx]

@@ -34,8 +34,12 @@ def priv_standardize(key: jax.Array, vec: jax.Array, eps_norm, l_raw=6.0,
     n = vec.shape[0]
     x = clip_sym(vec, l_raw)
     eps_half = eps_norm / 2.0
-    mu_priv = jnp.mean(x) + laplace(stream(key, "mu"), (), 2.0 * l_raw / (n * eps_half))
-    m2_priv = jnp.mean(x * x) + laplace(stream(key, "m2"), (), 2.0 * l_raw * l_raw / (n * eps_half))
+    # streams are namespaced per primitive so two different primitives
+    # handed the same key never draw correlated noise
+    mu_priv = jnp.mean(x) + laplace(stream(key, "priv_standardize/mu"), (),
+                                    2.0 * l_raw / (n * eps_half))
+    m2_priv = jnp.mean(x * x) + laplace(stream(key, "priv_standardize/m2"), (),
+                                        2.0 * l_raw * l_raw / (n * eps_half))
     var_priv = jnp.maximum(m2_priv - mu_priv * mu_priv, var_floor)
     return (x - mu_priv) / jnp.sqrt(var_priv)
 
@@ -70,8 +74,8 @@ def dp_sd(key: jax.Array, x: jax.Array, lo, hi, eps1, eps2):
     sd = √max(m2 − μ², 0) — floored at exactly 0 as in the reference (:82),
     unlike :func:`priv_standardize`'s 1e-12 floor.
     """
-    mu = dp_mean(stream(key, "mean"), x, lo, hi, eps1)
-    m2 = dp_second_moment(stream(key, "m2"), x, lo, hi, eps2)
+    mu = dp_mean(stream(key, "dp_sd/mean"), x, lo, hi, eps1)
+    m2 = dp_second_moment(stream(key, "dp_sd/m2"), x, lo, hi, eps2)
     sd = jnp.sqrt(jnp.maximum(m2 - mu * mu, 0.0))
     return mu, sd
 

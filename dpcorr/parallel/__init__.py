@@ -1,0 +1,19 @@
+"""Device-mesh parallelism (replaces the reference's ``mclapply`` layer L4).
+
+The reference's only parallelism is fork-based multicore over design points
+(vert-cor.R:534-554); replications within a task are serial. Here the axes
+invert, TPU-style (SURVEY.md §2.3):
+
+- **replications** → ``vmap`` (batched kernel) and ``shard_map`` over the
+  device mesh's ``rep`` axis (ICI);
+- **metric reductions** → XLA collectives (``psum``) instead of fork/pipe
+  joins;
+- **design grid** → host-level loop over compiled kernels (DCN fan-out for
+  multi-host is a straight extension of the same mesh spec).
+"""
+
+from dpcorr.parallel.mesh import rep_mesh, local_device_count  # noqa: F401
+from dpcorr.parallel.backend import (  # noqa: F401
+    run_detail_sharded,
+    run_summary_sharded,
+)

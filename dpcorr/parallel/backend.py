@@ -1,0 +1,120 @@
+"""Sharded execution of one design point: replications across the mesh.
+
+This is the TPU replacement for the reference's process fan-out
+(vert-cor.R:534-554): instead of forking one R process per design point and
+running B replications serially inside it, the B replications of a single
+design point are sharded across the ``rep`` mesh axis, each device running a
+chunked ``vmap`` over its slice, with metric summaries reduced on-device by
+``psum`` — the lone communication the problem actually has (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from dpcorr import sim as sim_mod
+from dpcorr.parallel.mesh import rep_mesh
+from dpcorr.sim import SimConfig, chunked_vmap
+from dpcorr.utils import rng
+
+
+def _padded_b(b: int, n_shards: int) -> int:
+    return -(-b // n_shards) * n_shards
+
+
+@lru_cache(maxsize=128)
+def _detail_fn(cfg_norho: SimConfig, mesh: Mesh):
+    """Compiled shard_map kernel: (padded keys, rho) -> detail tuple."""
+
+    def local(keys, rho):
+        return chunked_vmap(lambda k: sim_mod._one_rep(k, rho, cfg_norho),
+                            keys, cfg_norho.chunk_size)
+
+    sharded = shard_map(local, mesh=mesh,
+                        in_specs=(P("rep"), P()), out_specs=P("rep"))
+    return jax.jit(sharded)
+
+
+@lru_cache(maxsize=128)
+def _summary_fn(cfg_norho: SimConfig, mesh: Mesh):
+    """Compiled shard_map kernel: (padded keys, rho, b) -> summary sums.
+
+    Computes per-shard partial sums and ``psum``s them over the ``rep``
+    axis, so only a handful of scalars ever leave the devices — the path the
+    1M-rep benchmarks use.
+    """
+
+    def local(keys, rho, b_real):
+        detail = chunked_vmap(lambda k: sim_mod._one_rep(k, rho, cfg_norho),
+                              keys, cfg_norho.chunk_size)
+        named = dict(zip(sim_mod.DETAIL_FIELDS, detail, strict=True))
+        # padding mask: global rep index < b_real
+        idx = jax.lax.axis_index("rep") * keys.shape[0] + jnp.arange(keys.shape[0])
+        w = (idx < b_real).astype(jnp.float32)
+        sums = {}
+        for meth in ("ni", "int"):
+            est = named[f"{meth}_hat"]
+            sums[meth] = {
+                "sum_hat": jnp.sum(w * est),
+                "sum_hat2": jnp.sum(w * est * est),
+                "sum_se2": jnp.sum(w * named[f"{meth}_se2"]),
+                "sum_cover": jnp.sum(w * named[f"{meth}_cover"]),
+                "sum_len": jnp.sum(w * named[f"{meth}_ci_len"]),
+            }
+        return jax.lax.psum(sums, "rep")
+
+    sharded = shard_map(local, mesh=mesh,
+                        in_specs=(P("rep"), P(), P()), out_specs=P())
+    return jax.jit(sharded)
+
+
+def _prep(cfg: SimConfig, key, mesh: Mesh):
+    n_shards = mesh.devices.size
+    b_pad = _padded_b(cfg.b, n_shards)
+    if key is None:
+        key = rng.master_key(cfg.seed)
+    keys = rng.rep_keys(key, b_pad)
+    cfg_norho = dataclasses.replace(cfg, rho=0.0)
+    return cfg_norho, keys, b_pad
+
+
+def run_detail_sharded(cfg: SimConfig, key=None, mesh: Mesh | None = None):
+    """Full (B, ·) detail table, replications sharded over the mesh."""
+    mesh = mesh or rep_mesh()
+    cfg_norho, keys, _ = _prep(cfg, key, mesh)
+    out = _detail_fn(cfg_norho, mesh)(keys, jnp.float32(cfg.rho))
+    detail = dict(zip(sim_mod.DETAIL_FIELDS,
+                      (a[: cfg.b] for a in out), strict=True))
+    return sim_mod.SimResult(detail, sim_mod.summarize(detail, cfg.rho), cfg)
+
+
+def run_summary_sharded(cfg: SimConfig, key=None, mesh: Mesh | None = None):
+    """Summary-only sharded run: nothing but ~10 scalars leaves the mesh.
+
+    Returns the reference's 2-row summary (mse, bias, var, coverage,
+    ci_length — vert-cor.R:421-443) computed from psum'd partial sums.
+    """
+    mesh = mesh or rep_mesh()
+    cfg_norho, keys, _ = _prep(cfg, key, mesh)
+    sums = _summary_fn(cfg_norho, mesh)(
+        keys, jnp.float32(cfg.rho), jnp.float32(cfg.b))
+    b = float(cfg.b)
+    out = {}
+    for meth in ("ni", "int"):
+        s = {k: float(v) for k, v in sums[meth].items()}
+        mean_hat = s["sum_hat"] / b
+        out[meth.upper()] = {
+            "mse": s["sum_se2"] / b,
+            "bias": mean_hat - cfg.rho,
+            # R var(): sample variance, denominator B-1
+            "var": (s["sum_hat2"] - b * mean_hat**2) / (b - 1.0),
+            "coverage": s["sum_cover"] / b,
+            "ci_length": s["sum_len"] / b,
+        }
+    return out

@@ -1,0 +1,25 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def rep_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh with a ``rep`` axis over the first ``n_devices`` devices.
+
+    Monte-Carlo replications are i.i.d., so a single mesh axis suffices; the
+    only cross-device traffic is the final metric reduction (SURVEY.md §2.5).
+    On a TPU slice the axis rides ICI; under
+    ``xla_force_host_platform_device_count`` it maps to virtual CPU devices
+    for testing.
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(devs, axis_names=("rep",))

@@ -1,0 +1,185 @@
+"""Monte-Carlo simulator (reference layer L3).
+
+``run_sim_one`` replaces both reference versions (SURVEY.md Appendix A #1):
+the Gaussian-only v1 (vert-cor.R:356-444, ``mu``/``sigma`` args, sign
+estimators) and the pluggable v2 (ver-cor-subG.R:159-222, ``dgp``/
+``use_subg``). The B-replication loop — the reference's hot loop
+(vert-cor.R:392-419) — becomes one ``jit(vmap(one_rep))`` kernel: every
+replication generates its own data in-kernel from a folded key, runs the NI
+and INT estimators, and emits per-rep metrics; nothing but the (B, ·) metric
+table ever leaves the device.
+
+For large B the replication axis is blocked with ``lax.map`` over chunks so
+B × n never has to fit in HBM at once (SURVEY.md §5 long-context analogue).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from dpcorr.models import dgp as dgp_mod
+from dpcorr.models.estimators import (
+    ci_int_signflip,
+    ci_int_subg,
+    ci_ni_signbatch,
+    correlation_ni_subg,
+)
+from dpcorr.utils import rng
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """One design point. Replaces the reference's script-global knobs
+    (SURVEY.md §5 config) with a typed object.
+
+    ``dgp`` is a name from :data:`dpcorr.models.dgp.DGPS` or a callable
+    ``f(key, n, rho, **dgp_args)``. v1 semantics: ``dgp="gaussian"`` with
+    ``dgp_args={"mu": .., "sigma": ..}``, ``use_subg=False``. v2 semantics:
+    ``dgp="bounded_factor"``, ``use_subg=True``.
+    """
+
+    n: int
+    rho: float
+    eps1: float
+    eps2: float
+    b: int = 1000
+    alpha: float = 0.05
+    dgp: str | Callable = "gaussian"
+    dgp_args: Any = ()
+    use_subg: bool = False
+    ci_mode: str = "auto"
+    normalise: bool = True
+    mixquant_mode: str = "det"
+    seed: int = rng.MASTER_SEED
+    chunk_size: int = 4096  # max replications resident in HBM at once
+
+    def __post_init__(self):
+        # The config is a static jit argument, so it must be hashable:
+        # normalize dgp_args (dict or items) to a sorted items tuple.
+        args = self.dgp_args
+        if isinstance(args, Mapping):
+            args = tuple(sorted(args.items()))
+        object.__setattr__(self, "dgp_args", tuple(args))
+
+    def dgp_fn(self) -> Callable:
+        fn = dgp_mod.DGPS[self.dgp] if isinstance(self.dgp, str) else self.dgp
+        return partial(fn, **dict(self.dgp_args))
+
+
+#: detail-table columns, in the reference's order (vert-cor.R:367-385)
+DETAIL_FIELDS = (
+    "ni_hat", "int_hat", "ni_se2", "int_se2",
+    "ni_low", "ni_up", "int_low", "int_up",
+    "ni_cover", "int_cover", "ni_ci_len", "int_ci_len",
+)
+
+
+def _one_rep(key: jax.Array, rho: jax.Array, cfg: SimConfig) -> tuple:
+    """One Monte-Carlo replication: generate → estimate → metrics.
+
+    The body of the reference's hot loop (vert-cor.R:392-419,
+    ver-cor-subG.R:174-198), as a pure function of the rep key. ``rho`` is
+    traced (not baked into the compilation cache) so one compiled kernel
+    serves a whole ρ-sweep at fixed (n, ε) — the grid's shape bucket.
+    """
+    xy = cfg.dgp_fn()(rng.stream(key, "dgp"), cfg.n, rho)
+    x, y = xy[:, 0], xy[:, 1]
+
+    if cfg.use_subg:
+        ni = correlation_ni_subg(rng.stream(key, "ni"), x, y, cfg.eps1,
+                                 cfg.eps2, alpha=cfg.alpha)
+        it = ci_int_subg(rng.stream(key, "int"), x, y, cfg.eps1, cfg.eps2,
+                         alpha=cfg.alpha, variant="grid",
+                         mixquant_mode=cfg.mixquant_mode)
+    else:
+        ni = ci_ni_signbatch(rng.stream(key, "ni"), x, y, cfg.eps1, cfg.eps2,
+                             alpha=cfg.alpha, normalise=cfg.normalise)
+        it = ci_int_signflip(rng.stream(key, "int"), x, y, cfg.eps1, cfg.eps2,
+                             alpha=cfg.alpha, mode=cfg.ci_mode,
+                             normalise=cfg.normalise,
+                             mixquant_mode=cfg.mixquant_mode)
+
+    def metrics(r):
+        cover = ((rho >= r.ci_low) & (rho <= r.ci_high)).astype(jnp.float32)
+        return (r.rho_hat - rho) ** 2, cover, r.ci_high - r.ci_low
+
+    ni_se2, ni_cover, ni_len = metrics(ni)
+    int_se2, int_cover, int_len = metrics(it)
+    return (ni.rho_hat, it.rho_hat, ni_se2, int_se2,
+            ni.ci_low, ni.ci_high, it.ci_low, it.ci_high,
+            ni_cover, int_cover, ni_len, int_len)
+
+
+def chunked_vmap(fn: Callable, keys: jax.Array, chunk_size: int):
+    """``vmap(fn)`` over a key vector, blocked into ``lax.map`` chunks.
+
+    Keeps at most ``chunk_size`` replications' intermediates live in HBM.
+    The key count is padded up to a chunk multiple; outputs are truncated.
+    """
+    b = keys.shape[0]
+    chunk = min(chunk_size, b)
+    n_chunks = -(-b // chunk)
+    pad = n_chunks * chunk - b
+    if pad:
+        keys = jnp.concatenate([keys, keys[:pad]])
+    blocked = keys.reshape(n_chunks, chunk)
+    out = jax.lax.map(jax.vmap(fn), blocked)
+    return jax.tree.map(lambda a: a.reshape(n_chunks * chunk, *a.shape[2:])[:b], out)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _run_detail_core(cfg: SimConfig, key: jax.Array, rho: jax.Array):
+    keys = rng.rep_keys(key, cfg.b)
+    return chunked_vmap(lambda k: _one_rep(k, rho, cfg), keys, cfg.chunk_size)
+
+
+def _run_detail(cfg: SimConfig, key: jax.Array):
+    # Normalize rho out of the static cache key; pass it traced.
+    cfg_norho = dataclasses.replace(cfg, rho=0.0)
+    return _run_detail_core(cfg_norho, key, jnp.float32(cfg.rho))
+
+
+def summarize(detail: Mapping[str, jax.Array], rho: float):
+    """Reference summary rows (vert-cor.R:421-443): per method
+    mse, bias, var, coverage, ci_length."""
+    out = {}
+    for meth in ("ni", "int"):
+        est = detail[f"{meth}_hat"]
+        out[meth.upper()] = {
+            "mse": float(jnp.mean(detail[f"{meth}_se2"])),
+            "bias": float(jnp.mean(est) - rho),
+            "var": float(jnp.var(est, ddof=1)),
+            "coverage": float(jnp.mean(detail[f"{meth}_cover"])),
+            "ci_length": float(jnp.mean(detail[f"{meth}_ci_len"])),
+        }
+    return out
+
+
+@dataclasses.dataclass
+class SimResult:
+    """``detail``: dict of (B,) arrays (reference's replicate data.frame);
+    ``summary``: {"NI": {...}, "INT": {...}} (reference's 2-row summary)."""
+
+    detail: dict
+    summary: dict
+    config: SimConfig
+
+    def summary_rows(self):
+        """Summary as a list of flat dicts, one per method — the shape the
+        aggregation layer (grid driver / pandas) consumes."""
+        return [{"method": m, **v} for m, v in self.summary.items()]
+
+
+def run_sim_one(cfg: SimConfig, key: jax.Array | None = None) -> SimResult:
+    """Run one design point: B replications of (generate → NI + INT →
+    metrics) as a single compiled kernel."""
+    if key is None:
+        key = rng.master_key(cfg.seed)
+    raw = _run_detail(cfg, key)
+    detail = dict(zip(DETAIL_FIELDS, raw, strict=True))
+    return SimResult(detail, summarize(detail, cfg.rho), cfg)

@@ -1,0 +1,78 @@
+"""Grid-driver tests: design expansion, persistence/resume, aggregation,
+fail-loud semantics."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dpcorr.grid import GridConfig, run_grid, summarize_grid
+
+
+SMALL = dict(n_grid=(400, 800), rho_grid=(0.0, 0.5), eps_pairs=((1.0, 1.0),),
+             b=24, seed=9)
+
+
+def test_design_points_order_and_count():
+    gc = GridConfig(**SMALL)
+    d = gc.design_points()
+    assert len(d) == 4
+    # n varies fastest (reference expand.grid order, vert-cor.R:507-511)
+    assert list(d["n"]) == [400, 800, 400, 800]
+    assert list(d["i"]) == [0, 1, 2, 3]
+
+
+def test_run_grid_local_shapes():
+    res = run_grid(GridConfig(**SMALL))
+    assert len(res.detail_all) == 4 * 24
+    assert {"repl", "ni_hat", "int_cover", "n", "rho_true", "eps1", "eps2"} <= set(
+        res.detail_all.columns)
+    assert len(res.summ_all) == 8  # 4 design points x 2 methods
+    assert set(res.summ_all["method"]) == {"NI", "INT"}
+    assert len(res.timings) == 4
+
+
+def test_grid_summaries_match_manual_groupby():
+    res = run_grid(GridConfig(**SMALL))
+    row = res.summ_all[(res.summ_all["method"] == "NI")
+                       & (res.summ_all["n"] == 400)
+                       & (res.summ_all["rho_true"] == 0.5)].iloc[0]
+    sl = res.detail_all[(res.detail_all["n"] == 400)
+                        & (res.detail_all["rho_true"] == 0.5)]
+    np.testing.assert_allclose(row["mse"], sl["ni_se2"].mean(), rtol=1e-6)
+    np.testing.assert_allclose(row["coverage"], sl["ni_cover"].mean(), rtol=1e-6)
+
+
+def test_persistence_and_resume(tmp_path):
+    gc = GridConfig(**SMALL, out_dir=str(tmp_path))
+    res1 = run_grid(gc)
+    assert len(list(tmp_path.glob("design_*.npz"))) == 4
+    assert (tmp_path / "detail_all.parquet").exists()
+    # resume: reruns load identical numbers from disk
+    res2 = run_grid(gc)
+    assert res2.timings["cached"].all()
+    pd.testing.assert_frame_equal(res1.detail_all, res2.detail_all)
+
+
+def test_sharded_backend_grid(devices):
+    res = run_grid(GridConfig(**SMALL, backend="sharded"))
+    assert len(res.detail_all) == 4 * 24
+
+
+def test_unknown_backend_fails_loudly():
+    with pytest.raises(RuntimeError, match="design points failed"):
+        run_grid(GridConfig(**SMALL, backend="nope"))
+
+
+def test_summarize_grid_pure_function():
+    df = pd.DataFrame({
+        "n": [100] * 4, "rho_true": [0.5] * 4, "eps1": [1.0] * 4,
+        "eps2": [1.0] * 4,
+        "ni_hat": [0.4, 0.6, 0.5, 0.5], "ni_se2": [0.01, 0.01, 0.0, 0.0],
+        "ni_cover": [1, 1, 0, 1], "ni_ci_len": [0.2] * 4,
+        "int_hat": [0.5] * 4, "int_se2": [0.0] * 4,
+        "int_cover": [1] * 4, "int_ci_len": [0.1] * 4,
+    })
+    s = summarize_grid(df)
+    ni = s[s["method"] == "NI"].iloc[0]
+    assert ni["coverage"] == 0.75
+    np.testing.assert_allclose(ni["bias"], 0.0, atol=1e-12)

@@ -1,0 +1,54 @@
+"""Sharded-backend tests on the virtual 8-device mesh (SURVEY.md §4:
+multi-device paths testable on CPU)."""
+
+import numpy as np
+
+from dpcorr.parallel import rep_mesh, run_detail_sharded, run_summary_sharded
+from dpcorr.sim import SimConfig, run_sim_one
+
+
+CFG = SimConfig(n=500, rho=0.3, eps1=1.0, eps2=1.0, b=40, seed=5)
+
+
+def test_mesh_spans_devices(devices):
+    mesh = rep_mesh()
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("rep",)
+
+
+def test_sharded_detail_matches_local(devices):
+    # identical keys per replication -> identical detail, independent of
+    # the device layout (b=40 pads to 40, 5 reps/device)
+    local = run_sim_one(CFG)
+    sharded = run_detail_sharded(CFG, mesh=rep_mesh())
+    for f in ("ni_hat", "int_hat", "ni_cover", "int_ci_len"):
+        np.testing.assert_allclose(
+            np.asarray(local.detail[f]), np.asarray(sharded.detail[f]),
+            rtol=2e-5, atol=1e-7)
+
+
+def test_sharded_detail_pads_nondivisible(devices):
+    cfg = SimConfig(n=500, rho=0.3, eps1=1.0, eps2=1.0, b=37, seed=5)
+    sharded = run_detail_sharded(cfg, mesh=rep_mesh())
+    assert sharded.detail["ni_hat"].shape == (37,)
+    local = run_sim_one(cfg)
+    np.testing.assert_allclose(np.asarray(local.detail["ni_hat"]),
+                               np.asarray(sharded.detail["ni_hat"]),
+                               rtol=2e-5, atol=1e-7)
+
+
+def test_summary_sharded_psum_matches_detail(devices):
+    cfg = SimConfig(n=500, rho=0.3, eps1=1.0, eps2=1.0, b=37, seed=5)
+    summ = run_summary_sharded(cfg, mesh=rep_mesh())
+    ref = run_sim_one(cfg).summary
+    for meth in ("NI", "INT"):
+        for k in ("mse", "bias", "var", "coverage", "ci_length"):
+            np.testing.assert_allclose(summ[meth][k], ref[meth][k],
+                                       rtol=5e-4, atol=1e-6), (meth, k)
+
+
+def test_subset_mesh(devices):
+    mesh = rep_mesh(4)
+    assert mesh.devices.size == 4
+    summ = run_summary_sharded(CFG, mesh=mesh)
+    assert 0.0 <= summ["NI"]["coverage"] <= 1.0

@@ -1,0 +1,105 @@
+"""Simulator tests + golden statistical acceptance.
+
+The reference validates itself statistically (SURVEY.md §4): empirical CI
+coverage vs nominal 0.95 with known-truth DGPs, MSE/bias tracking. R is not
+available in this image, so the acceptance here is coverage-vs-nominal
+within Monte-Carlo error — the same oracle the reference's plots use
+(dashed 0.95 line, vert-cor.R:687)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpcorr.sim import DETAIL_FIELDS, SimConfig, SimResult, run_sim_one
+from dpcorr.utils import rng
+
+
+def _coverage_bounds(b, p=0.95, z=3.5):
+    se = np.sqrt(p * (1 - p) / b)
+    return p - z * se, min(p + z * se, 1.0)
+
+
+class TestRunSimOne:
+    def test_detail_shapes_and_fields(self):
+        cfg = SimConfig(n=500, rho=0.3, eps1=1.0, eps2=1.0, b=50)
+        res = run_sim_one(cfg)
+        assert set(res.detail) == set(DETAIL_FIELDS)
+        for v in res.detail.values():
+            assert v.shape == (50,)
+
+    def test_deterministic_given_seed(self):
+        cfg = SimConfig(n=500, rho=0.3, eps1=1.0, eps2=1.0, b=20, seed=7)
+        a, b = run_sim_one(cfg), run_sim_one(cfg)
+        np.testing.assert_array_equal(a.detail["ni_hat"], b.detail["ni_hat"])
+        c = run_sim_one(SimConfig(n=500, rho=0.3, eps1=1.0, eps2=1.0, b=20, seed=8))
+        assert not np.array_equal(a.detail["ni_hat"], c.detail["ni_hat"])
+
+    def test_chunking_invariant(self):
+        base = dict(n=400, rho=0.2, eps1=1.0, eps2=1.0, b=10)
+        a = run_sim_one(SimConfig(**base, chunk_size=4))   # pads 10 -> 12
+        b = run_sim_one(SimConfig(**base, chunk_size=100))
+        np.testing.assert_allclose(
+            np.asarray(a.detail["int_hat"]), np.asarray(b.detail["int_hat"]),
+            rtol=1e-6)
+
+    def test_summary_consistent_with_detail(self):
+        cfg = SimConfig(n=500, rho=0.3, eps1=1.0, eps2=1.0, b=64)
+        res = run_sim_one(cfg)
+        d = res.detail
+        np.testing.assert_allclose(
+            res.summary["NI"]["coverage"], float(jnp.mean(d["ni_cover"])), rtol=1e-6)
+        np.testing.assert_allclose(
+            res.summary["INT"]["mse"], float(jnp.mean(d["int_se2"])), rtol=1e-6)
+        rows = res.summary_rows()
+        assert [r["method"] for r in rows] == ["NI", "INT"]
+
+    def test_summary_se2_matches_hat(self):
+        cfg = SimConfig(n=500, rho=0.4, eps1=1.0, eps2=1.0, b=32)
+        res = run_sim_one(cfg)
+        np.testing.assert_allclose(
+            np.asarray(res.detail["ni_se2"]),
+            (np.asarray(res.detail["ni_hat"]) - 0.4) ** 2, rtol=1e-5)
+
+
+class TestGoldenCoverage:
+    """Coverage within MC error of nominal 0.95 on known-truth DGPs."""
+
+    @pytest.mark.parametrize("rho", [0.0, 0.5])
+    def test_sign_pipeline_gaussian(self, rho):
+        b = 400
+        cfg = SimConfig(n=2000, rho=rho, eps1=1.0, eps2=1.0, b=b)
+        res = run_sim_one(cfg)
+        lo, hi = _coverage_bounds(b)
+        for meth in ("NI", "INT"):
+            cov = res.summary[meth]["coverage"]
+            assert lo <= cov <= hi, (meth, rho, cov)
+            assert abs(res.summary[meth]["bias"]) < 0.06
+
+    def test_subg_pipeline_bounded_factor(self):
+        b = 400
+        cfg = SimConfig(n=4000, rho=0.5, eps1=1.0, eps2=1.0, b=b,
+                        dgp="bounded_factor", use_subg=True)
+        res = run_sim_one(cfg)
+        lo, hi = _coverage_bounds(b)
+        for meth in ("NI", "INT"):
+            cov = res.summary[meth]["coverage"]
+            assert lo <= cov <= hi, (meth, cov)
+            assert abs(res.summary[meth]["bias"]) < 0.06
+
+    def test_mse_decreases_with_n(self):
+        # the reference's fig3 contract: MSE falls as n grows
+        mses = []
+        for n in (500, 4000):
+            cfg = SimConfig(n=n, rho=0.5, eps1=1.0, eps2=1.0, b=200, seed=3)
+            mses.append(run_sim_one(cfg).summary["NI"]["mse"])
+        assert mses[1] < mses[0]
+
+    def test_mc_mixquant_coverage_matches_det(self):
+        # Appendix A #4 substitution check: deterministic mixture quantile
+        # must not shift coverage beyond MC error vs the reference's MC one
+        b = 300
+        base = dict(n=2000, rho=0.5, eps1=1.0, eps2=1.0, b=b)
+        det = run_sim_one(SimConfig(**base, mixquant_mode="det"))
+        mc = run_sim_one(SimConfig(**base, mixquant_mode="mc"))
+        diff = abs(det.summary["INT"]["coverage"] - mc.summary["INT"]["coverage"])
+        assert diff < 0.05, diff

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import time
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -37,9 +38,6 @@ def _one_rep(key):
     return (r.rho_hat - RHO) ** 2, cover, r.ci_high - r.ci_low
 
 
-from functools import partial
-
-
 @partial(jax.jit, static_argnums=(1,))
 def _run_block(key, n_reps: int):
     keys = rng.rep_keys(key, n_reps)
@@ -64,9 +62,8 @@ def main():
     # warmup: compile the big block once
     _timed_run(rng.design_key(key, 0), TARGET_REPS)
     out, elapsed = _timed_run(rng.design_key(key, 1), TARGET_REPS)
-    target_reps = TARGET_REPS
 
-    reps_per_sec = target_reps / elapsed
+    reps_per_sec = TARGET_REPS / elapsed
     mse, coverage, ci_len = (float(x) for x in out)
     print(json.dumps({
         "metric": "mc_reps_per_sec_chip_ni_sign_n10k",
@@ -74,7 +71,7 @@ def main():
         "unit": "reps/sec/chip",
         "vs_baseline": round(reps_per_sec / BASELINE_REPS_PER_SEC_CHIP, 3),
         "detail": {
-            "n": N, "reps": target_reps, "seconds": round(elapsed, 2),
+            "n": N, "reps": TARGET_REPS, "seconds": round(elapsed, 2),
             "coverage": round(coverage, 4), "mse": round(mse, 6),
             "ci_length": round(ci_len, 4),
             "device": str(jax.devices()[0]),

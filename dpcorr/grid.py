@@ -121,18 +121,22 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
         i = int(row.i)
         path = _design_path(out_dir, i) if out_dir else None
         t0 = time.perf_counter()
+        cfg = gcfg.sim_config(row._asdict())
+        # Cache entries are valid only for the exact SimConfig that produced
+        # them: stamp it into the npz and treat any mismatch as a miss.
+        stamp = repr(cfg)
         try:
+            cached = False
             if path is not None and gcfg.resume and path.exists():
                 loaded = dict(np.load(path))
-                detail = {f: loaded[f] for f in sim_mod.DETAIL_FIELDS}
-                cached = True
-            else:
-                cfg = gcfg.sim_config(row._asdict())
+                if str(loaded.get("config_stamp")) == stamp:
+                    detail = {f: loaded[f] for f in sim_mod.DETAIL_FIELDS}
+                    cached = True
+            if not cached:
                 res = _run_point(gcfg, cfg, rng.design_key(master, i), mesh)
                 detail = {k: np.asarray(v) for k, v in res.detail.items()}
                 if path is not None:
-                    np.savez(path, **detail)
-                cached = False
+                    np.savez(path, config_stamp=stamp, **detail)
         except Exception as e:  # fail loudly per design point (SURVEY.md §5)
             log.error("design point %d (n=%d rho=%.2f eps=(%.2f,%.2f)) failed: %s",
                       i, row.n, row.rho, row.eps1, row.eps2, e)
@@ -141,7 +145,7 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
         dt = time.perf_counter() - t0
         timings.append({"i": i, "n": row.n, "rho": row.rho, "eps1": row.eps1,
                         "eps2": row.eps2, "seconds": dt, "cached": cached,
-                        "reps_per_sec": gcfg.b / dt if dt > 0 else np.inf})
+                        "reps_per_sec": np.nan if cached else gcfg.b / dt})
 
         frame = pd.DataFrame(detail)
         frame.insert(0, "repl", np.arange(1, gcfg.b + 1))

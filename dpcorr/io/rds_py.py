@@ -1,0 +1,337 @@
+"""Pure-Python reader for R serialization format (RDS), XDR flavor.
+
+The reference's real-data pipeline starts at ``readRDS("hrs_long_panel.rds")``
+(real-data-sims.R:13). No RDS reader exists in this environment, so the
+framework carries its own: this module is the reference implementation and
+portable fallback; ``dpcorr.io._native`` is the C++ fast path with the same
+output contract (see ``native/rdsread.cpp``).
+
+Scope: the R serialization grammar as emitted by ``saveRDS`` version 2/3 in
+XDR ("X\\n") encoding — atomic vectors (LGL/INT/REAL/CPLX/STR/RAW), pairlists
+with attributes/tags, generic vectors (lists), symbols with the reference
+table, CHARSXP encodings, long vectors, and the ALTREP wrappers R ≥ 3.5
+emits for compact sequences and wrapped/deferred vectors. Environments,
+closures, promises, bytecode, and S4 are out of scope (``saveRDS`` of plain
+data never produces them) and raise.
+
+Output: :class:`RObj` trees of numpy arrays / string lists plus attribute
+dicts; :func:`read_rds` returns the root, :func:`read_rds_table` flattens a
+data.frame/tibble into a column dict (the shape ``dpcorr.hrs`` consumes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import struct
+from typing import Any
+
+import numpy as np
+
+# SEXP type codes (R internals)
+NILSXP, SYMSXP, LISTSXP = 0, 1, 2
+CHARSXP, LGLSXP, INTSXP, REALSXP, CPLXSXP, STRSXP = 9, 10, 13, 14, 15, 16
+VECSXP, EXPRSXP, RAWSXP = 19, 20, 24
+LANGSXP = 6
+# serialization-only pseudo-types
+REFSXP, NILVALUE_SXP, GLOBALENV_SXP = 255, 254, 253
+NAMESPACESXP, PACKAGESXP, PERSISTSXP = 249, 248, 247
+EMPTYENV_SXP, BASEENV_SXP = 242, 241
+ATTRLANGSXP, ATTRLISTSXP = 240, 239
+ALTREP_SXP = 238
+
+#: R's integer/logical NA payload
+R_NA_INT = -0x80000000
+#: R's real NA: an NaN with payload 1954 in the low word
+R_NA_REAL_BITS = 0x7FF00000000007A2
+
+
+@dataclasses.dataclass
+class RObj:
+    """One R object: ``data`` is a numpy array (atomic), list (STRSXP or
+    VECSXP elements), str (symbol name), or None."""
+
+    type: int
+    data: Any = None
+    attributes: dict | None = None
+
+    def attr(self, name: str, default=None):
+        return (self.attributes or {}).get(name, default)
+
+    @property
+    def names(self):
+        nm = self.attr("names")
+        return None if nm is None else nm.data
+
+    @property
+    def rclass(self):
+        cl = self.attr("class")
+        return [] if cl is None else list(cl.data)
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+        self.refs: list[Any] = []
+        self.encoding = "utf-8"
+
+    # ---- primitive reads (XDR = big-endian) ----
+    def _take(self, n: int) -> bytes:
+        b = self.buf[self.pos: self.pos + n]
+        if len(b) != n:
+            raise EOFError(f"truncated RDS stream at byte {self.pos}")
+        self.pos += n
+        return b
+
+    def u8(self) -> int:
+        return self._take(1)[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def f64(self) -> float:
+        return struct.unpack(">d", self._take(8))[0]
+
+    def length(self) -> int:
+        n = self.i32()
+        if n == -1:  # long vector: two more ints, 2^32*hi + lo
+            hi, lo = self.i32(), self.i32()
+            n = (hi << 32) + (lo & 0xFFFFFFFF)
+        return n
+
+    # ---- header ----
+    def header(self) -> None:
+        magic = self._take(2)
+        if magic != b"X\n":
+            raise ValueError(
+                f"unsupported RDS encoding {magic!r} (only XDR 'X\\n')")
+        version = self.i32()
+        self.i32()  # writer R version
+        self.i32()  # minimal reader R version
+        if version >= 3:
+            enc_len = self.i32()
+            self.encoding = self._take(enc_len).decode("ascii")
+        elif version != 2:
+            raise ValueError(f"unsupported RDS version {version}")
+
+    # ---- items ----
+    def item(self) -> RObj:
+        flags = self.i32()
+        ptype = flags & 0xFF
+        has_attr = bool(flags & 0x200)
+        has_tag = bool(flags & 0x400)
+
+        if ptype == NILVALUE_SXP or ptype == NILSXP:
+            return RObj(NILSXP)
+        if ptype == REFSXP:
+            idx = flags >> 8
+            if idx == 0:
+                idx = self.i32()
+            return self.refs[idx - 1]  # 1-based
+        if ptype == SYMSXP:
+            char = self.item()
+            sym = RObj(SYMSXP, data=char.data)
+            self.refs.append(sym)
+            return sym
+        if ptype in (GLOBALENV_SXP, EMPTYENV_SXP, BASEENV_SXP):
+            return RObj(NILSXP)
+        if ptype in (NAMESPACESXP, PACKAGESXP, PERSISTSXP):
+            # length-prefixed string vector naming the namespace/package
+            obj = RObj(ptype, data=self._strsxp(self.length()))
+            self.refs.append(obj)
+            return obj
+        if ptype in (LISTSXP, LANGSXP, ATTRLISTSXP, ATTRLANGSXP):
+            return self._pairlist(ptype, has_attr, has_tag)
+        if ptype == ALTREP_SXP:
+            return self._altrep()
+        if ptype == CHARSXP:
+            n = self.i32()
+            if n == -1:
+                return RObj(CHARSXP, data=None)  # NA_character_
+            return RObj(CHARSXP, data=self._take(n).decode(self.encoding,
+                                                           "replace"))
+        if ptype == SYMSXP:
+            raise AssertionError
+        data: Any
+        if ptype in (LGLSXP, INTSXP):
+            n = self.length()
+            data = np.frombuffer(self._take(4 * n), dtype=">i4").astype(np.int32)
+        elif ptype == REALSXP:
+            n = self.length()
+            data = np.frombuffer(self._take(8 * n), dtype=">f8").astype(np.float64)
+        elif ptype == CPLXSXP:
+            n = self.length()
+            data = np.frombuffer(self._take(16 * n), dtype=">c16").astype(np.complex128)
+        elif ptype == RAWSXP:
+            n = self.length()
+            data = np.frombuffer(self._take(n), dtype=np.uint8).copy()
+        elif ptype == STRSXP:
+            data = self._strsxp(self.length())
+        elif ptype in (VECSXP, EXPRSXP):
+            n = self.length()
+            data = [self.item() for _ in range(n)]
+        else:
+            raise ValueError(f"unsupported SEXP type {ptype} in RDS stream "
+                             f"(byte {self.pos})")
+        obj = RObj(ptype, data=data)
+        if has_attr:
+            obj.attributes = self._attrs()
+        return obj
+
+    def _strsxp(self, n: int) -> list:
+        return [self.item().data for _ in range(n)]
+
+    def _pairlist(self, ptype: int, has_attr: bool, has_tag: bool) -> RObj:
+        """Pairlist read as a Python list of (tag, value); attributes on the
+        whole list are rare for data and folded into the first node."""
+        items = []
+        attrs = self._attrs() if has_attr else None
+        while True:
+            tag = None
+            if has_tag:
+                tag_obj = self.item()
+                tag = tag_obj.data
+            items.append((tag, self.item()))
+            flags = self.i32()
+            nxt = flags & 0xFF
+            if nxt in (NILVALUE_SXP, NILSXP):
+                break
+            if nxt not in (LISTSXP, LANGSXP, ATTRLISTSXP, ATTRLANGSXP):
+                # cdr is a non-pairlist object: re-dispatch it
+                self.pos -= 4
+                items.append((None, self.item()))
+                break
+            if flags & 0x200:
+                self._attrs()  # attributes on an interior cons cell: drop
+            has_tag = bool(flags & 0x400)
+        obj = RObj(LISTSXP, data=items)
+        obj.attributes = attrs
+        return obj
+
+    def _attrs(self) -> dict:
+        plist = self.item()
+        if plist.type == NILSXP:
+            return {}
+        return {tag: val for tag, val in plist.data if tag is not None}
+
+    # ---- ALTREP reconstruction ----
+    def _altrep(self) -> RObj:
+        info = self.item()   # pairlist: (class-sym, package-sym, type int)
+        state = self.item()
+        attr = self.item()
+        cls = info.data[0][1].data if info.type == LISTSXP else None
+        obj = self._expand_altrep(cls, state)
+        if attr.type == LISTSXP:
+            obj.attributes = {t: v for t, v in attr.data if t is not None}
+        return obj
+
+    def _expand_altrep(self, cls: str | None, state: RObj) -> RObj:
+        if cls == "compact_intseq":
+            n, start, step = (float(v) for v in state.data[:3])
+            return RObj(INTSXP, data=np.arange(
+                start, start + step * n, step, dtype=np.int32)[: int(n)])
+        if cls == "compact_realseq":
+            n, start, step = (float(v) for v in state.data[:3])
+            return RObj(REALSXP, data=np.arange(
+                start, start + step * n, step, dtype=np.float64)[: int(n)])
+        if cls in ("wrap_logical", "wrap_integer", "wrap_real", "wrap_string",
+                   "wrap_complex", "wrap_raw"):
+            return state.data[0] if state.type == VECSXP else state
+        if cls == "deferred_string":
+            src = state.data[0] if state.type == VECSXP else state
+            vals = ["" if v is None else _r_num_str(v) for v in
+                    np.asarray(src.data).tolist()]
+            return RObj(STRSXP, data=vals)
+        raise ValueError(f"unsupported ALTREP class {cls!r}")
+
+
+def _r_num_str(v) -> str:
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return str(v)
+
+
+def real_is_na(arr: np.ndarray) -> np.ndarray:
+    """Mask of R ``NA_real_`` (distinct from NaN) in a float64 array."""
+    return arr.view(np.uint64) == R_NA_REAL_BITS
+
+
+def decode_real(arr: np.ndarray) -> np.ndarray:
+    """R doubles → numpy float64 with NA mapped to NaN (already NaN-valued;
+    this is the identity but documents the NA story)."""
+    return arr
+
+
+def decode_int(arr: np.ndarray) -> np.ndarray:
+    """R integers → float64 with NA (INT_MIN) mapped to NaN."""
+    out = arr.astype(np.float64)
+    out[arr == R_NA_INT] = np.nan
+    return out
+
+
+def read_rds(path: str) -> RObj:
+    """Read a (possibly gzip-compressed) .rds file into an :class:`RObj`."""
+    with open(path, "rb") as f:
+        head = f.read(2)
+    opener = gzip.open if head == b"\x1f\x8b" else open
+    with opener(path, "rb") as f:
+        buf = f.read()
+    rd = _Reader(buf)
+    rd.header()
+    return rd.item()
+
+
+@dataclasses.dataclass
+class RColumn:
+    """One data.frame column, decoded.
+
+    ``kind``: "double" | "integer" | "logical" | "string" | "factor".
+    ``values``: float64 array (NA→NaN) for numerics, list[str|None]
+    otherwise; factors keep integer codes (NA→NaN) + ``levels``.
+    ``labels``: haven value-labels mapping, if present.
+    """
+
+    name: str
+    kind: str
+    values: Any
+    levels: list | None = None
+    labels: dict | None = None
+    label: str | None = None
+
+
+def _decode_column(name: str, col: RObj) -> RColumn:
+    cls = col.rclass
+    lab = col.attr("label")
+    label = lab.data[0] if lab is not None and lab.data else None
+    labels_attr = col.attr("labels")
+    labels = None
+    if labels_attr is not None:
+        lv = np.asarray(labels_attr.data, dtype=np.float64)
+        labels = dict(zip(labels_attr.names or [], lv.tolist()))
+    if "factor" in cls:
+        levels = col.attr("levels")
+        return RColumn(name, "factor", decode_int(col.data),
+                       levels=list(levels.data) if levels else [],
+                       label=label)
+    if col.type == REALSXP:
+        return RColumn(name, "double", decode_real(col.data),
+                       labels=labels, label=label)
+    if col.type == INTSXP:
+        return RColumn(name, "integer", decode_int(col.data),
+                       labels=labels, label=label)
+    if col.type == LGLSXP:
+        return RColumn(name, "logical", decode_int(col.data), label=label)
+    if col.type == STRSXP:
+        return RColumn(name, "string", col.data, label=label)
+    raise ValueError(f"column {name!r}: unsupported type {col.type}")
+
+
+def read_rds_table(path: str) -> dict[str, RColumn]:
+    """Read a data.frame/tibble .rds into ``{name: RColumn}`` (ordered)."""
+    root = read_rds(path)
+    if root.type != VECSXP or "data.frame" not in root.rclass:
+        raise ValueError(f"{path}: not a data.frame (class {root.rclass})")
+    names = root.names or []
+    return {nm: _decode_column(nm, col)
+            for nm, col in zip(names, root.data, strict=True)}

@@ -1,0 +1,237 @@
+"""RDS reader tests: synthetic streams from a minimal in-test writer,
+plus schema checks against the real HRS panel (SURVEY.md Appendix B).
+
+The same fixtures exercise every available backend (pure-Python and, once
+built, the native C++ reader) so their output contracts stay identical.
+"""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from dpcorr.io import rds_py
+
+HRS_PATH = "/root/reference/hrs_long_panel.rds"
+
+
+# ---------------------------------------------------------------- writer ----
+class W:
+    """Minimal RDS (XDR v3) writer — just enough to build test fixtures."""
+
+    def __init__(self):
+        self.out = bytearray(b"X\n")
+        self.i32(3)          # version 3
+        self.i32(0x040202)   # writer R 4.2.2
+        self.i32(0x030500)   # min reader 3.5.0
+        enc = b"UTF-8"
+        self.i32(len(enc)); self.out += enc
+
+    def i32(self, v):
+        self.out += struct.pack(">i", v)
+
+    def f64(self, v):
+        self.out += struct.pack(">d", v)
+
+    def flags(self, t, has_attr=False, has_tag=False, levels=0):
+        self.i32(t | (0x200 if has_attr else 0) | (0x400 if has_tag else 0)
+                 | (levels << 12))
+
+    def charsxp(self, s):
+        if s is None:
+            self.flags(rds_py.CHARSXP, levels=0)
+            self.i32(-1)
+        else:
+            b = s.encode()
+            self.flags(rds_py.CHARSXP, levels=0x8)  # UTF-8 bit
+            self.i32(len(b)); self.out += b
+
+    def strsxp(self, items, has_attr=False):
+        self.flags(rds_py.STRSXP, has_attr)
+        self.i32(len(items))
+        for s in items:
+            self.charsxp(s)
+
+    def realsxp(self, vals, has_attr=False):
+        self.flags(rds_py.REALSXP, has_attr)
+        self.i32(len(vals))
+        for v in vals:
+            if v is None:
+                self.out += struct.pack(">Q", rds_py.R_NA_REAL_BITS)
+            else:
+                self.f64(v)
+
+    def intsxp(self, vals, has_attr=False):
+        self.flags(rds_py.INTSXP, has_attr)
+        self.i32(len(vals))
+        for v in vals:
+            self.i32(rds_py.R_NA_INT if v is None else v)
+
+    def sym(self, name):
+        self.flags(rds_py.SYMSXP)
+        self.charsxp(name)
+
+    def attr_list(self, pairs):
+        """pairs: list of (name, emit_value_callable)."""
+        for i, (name, emit) in enumerate(pairs):
+            self.flags(rds_py.LISTSXP, has_tag=True)
+            self.sym(name)
+            emit()
+        self.i32(rds_py.NILVALUE_SXP)
+
+    def nil(self):
+        self.i32(rds_py.NILVALUE_SXP)
+
+    def bytes(self):
+        return bytes(self.out)
+
+
+def _parse(buf: bytes):
+    rd = rds_py._Reader(buf)
+    rd.header()
+    return rd.item()
+
+
+# ------------------------------------------------------------- fixtures ----
+def test_real_vector_with_na():
+    w = W()
+    w.realsxp([1.5, None, -2.0])
+    obj = _parse(w.bytes())
+    assert obj.type == rds_py.REALSXP
+    assert obj.data[0] == 1.5 and obj.data[2] == -2.0
+    assert rds_py.real_is_na(obj.data).tolist() == [False, True, False]
+
+
+def test_int_vector_na_decode():
+    w = W()
+    w.intsxp([7, None, -3])
+    obj = _parse(w.bytes())
+    dec = rds_py.decode_int(obj.data)
+    assert dec[0] == 7.0 and dec[2] == -3.0 and np.isnan(dec[1])
+
+
+def test_string_vector_with_na():
+    w = W()
+    w.strsxp(["a", None, "ζ"])
+    obj = _parse(w.bytes())
+    assert obj.data == ["a", None, "ζ"]
+
+
+def test_named_list_dataframe_roundtrip():
+    """A 2-column tibble: x double, f factor — the HRS shape in miniature."""
+    w = W()
+    w.flags(rds_py.VECSXP, has_attr=True)
+    w.i32(2)
+    w.realsxp([1.0, 2.0, None])
+    # factor column: int codes + levels + class
+    w.intsxp([1, 2, 1], has_attr=True)
+    w.attr_list([
+        ("levels", lambda: w.strsxp(["lo", "hi"])),
+        ("class", lambda: w.strsxp(["factor"])),
+    ])
+    # data.frame attributes
+    w.attr_list([
+        ("names", lambda: w.strsxp(["x", "f"])),
+        ("row.names", lambda: w.intsxp([None, -3])),
+        ("class", lambda: w.strsxp(["tbl_df", "tbl", "data.frame"])),
+    ])
+    import io as _io
+    import tempfile, os
+    buf = w.bytes()
+    with tempfile.NamedTemporaryFile(suffix=".rds", delete=False) as f:
+        f.write(gzip.compress(buf))
+        path = f.name
+    try:
+        cols = rds_py.read_rds_table(path)
+    finally:
+        os.unlink(path)
+    assert list(cols) == ["x", "f"]
+    assert cols["x"].kind == "double"
+    assert np.isnan(cols["x"].values[2])
+    assert cols["f"].kind == "factor"
+    assert cols["f"].levels == ["lo", "hi"]
+    assert cols["f"].values.tolist() == [1.0, 2.0, 1.0]
+
+
+def test_symbol_reference_table():
+    """The second occurrence of a symbol is a REFSXP back-reference."""
+    w = W()
+    w.flags(rds_py.VECSXP, has_attr=True)
+    w.i32(2)
+    w.realsxp([1.0], has_attr=True)
+    w.attr_list([("foo", lambda: w.realsxp([9.0]))])
+    w.realsxp([2.0], has_attr=True)
+    # "foo" again — as a reference (index 1, packed in flags)
+    w.flags(rds_py.LISTSXP, has_tag=True)
+    w.i32((1 << 8) | rds_py.REFSXP)
+    w.realsxp([10.0])
+    w.nil()
+    w.attr_list([("names", lambda: w.strsxp(["a", "b"]))])
+    obj = _parse(w.bytes())
+    assert obj.data[0].attr("foo").data[0] == 9.0
+    assert obj.data[1].attr("foo").data[0] == 10.0
+
+
+def test_altrep_compact_intseq():
+    w = W()
+    w.flags(rds_py.ALTREP_SXP)
+    # info pairlist: class sym, package sym, type int
+    w.flags(rds_py.LISTSXP, has_tag=False)
+    w.sym("compact_intseq")
+    w.flags(rds_py.LISTSXP)
+    w.sym("base")
+    w.flags(rds_py.LISTSXP)
+    w.intsxp([13])
+    w.nil()
+    # state: c(n, start, step); attr: NULL
+    w.realsxp([5.0, 10.0, 1.0])
+    w.nil()
+    obj = _parse(w.bytes())
+    assert obj.data.tolist() == [10, 11, 12, 13, 14]
+
+
+def test_haven_labelled_column():
+    w = W()
+    w.realsxp([1.0, 2.0], has_attr=True)
+    w.attr_list([
+        ("labels", lambda: (w.realsxp([1.0, 2.0], has_attr=True),
+                            w.attr_list([("names",
+                                          lambda: w.strsxp(["yes", "no"]))]))),
+        ("class", lambda: w.strsxp(["haven_labelled", "vctrs_vctr", "double"])),
+    ])
+    col = rds_py._decode_column("h", _parse(w.bytes()))
+    assert col.kind == "double"
+    assert col.labels == {"yes": 1.0, "no": 2.0}
+
+
+# ------------------------------------------------- real file (appendix B) ----
+@pytest.fixture(scope="module")
+def hrs_cols():
+    return rds_py.read_rds_table(HRS_PATH)
+
+
+def test_hrs_schema(hrs_cols):
+    assert list(hrs_cols) == ["hhidpn", "wave", "cenreg", "cendiv", "urbrur",
+                              "agey_e", "bmi", "hearte"]
+    assert len(hrs_cols["wave"].values) == 723_744
+    assert hrs_cols["cenreg"].kind == "factor"
+    assert hrs_cols["cenreg"].levels == ["Northeast", "Midwest", "South", "West"]
+    assert hrs_cols["agey_e"].kind == "double"
+    assert hrs_cols["urbrur"].labels is not None
+
+
+def test_hrs_wave2_complete_cases(hrs_cols):
+    """Wave-2 complete-case count drives every downstream HRS number
+    (real-data-sims.R:38-41)."""
+    wave = np.asarray(hrs_cols["wave"].values, dtype=object)
+    m = wave == "2"
+    age = hrs_cols["agey_e"].values[m]
+    bmi = hrs_cols["bmi"].values[m]
+    ok = ~np.isnan(age) & ~np.isnan(bmi)
+    assert m.sum() > 0 and 0 < ok.sum() <= m.sum()
+    # sanity: plausible human ranges on complete cases
+    assert 20 < np.nanmean(age[ok]) < 110
+    assert 10 < np.nanmean(bmi[ok]) < 60

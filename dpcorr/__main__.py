@@ -1,0 +1,135 @@
+"""Command-line entry points: ``python -m dpcorr <command>``.
+
+Replaces the reference's "source the script" workflow (README.md:28-46):
+
+- ``demo``        single-design-point Gaussian demo (vert-cor.R:449-466)
+- ``demo-subg``   sub-Gaussian single point (ver-cor-subG.R:224-233)
+- ``grid``        v1 Gaussian sign grid + summaries + figures
+                  (vert-cor.R:486-721)
+- ``grid-subg``   v2 bounded-factor sub-Gaussian grid (ver-cor-subG.R:245-436)
+- ``hrs``         HRS point estimates (real-data-sims.R:259-333)
+- ``hrs-sweep``   HRS ε-sweep + panels (real-data-sims.R:342-506)
+
+Grids persist per-design-point ``.npz`` + parquet tables into ``--out`` and
+resume from them (the reference only saves one blob at the end).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _add_common(p):
+    p.add_argument("--out", default=None, help="output directory")
+    p.add_argument("--b", type=int, default=None, help="MC replications")
+    p.add_argument("--seed", type=int, default=2025)
+    p.add_argument("--backend", default="local",
+                   choices=["local", "sharded"])
+
+
+def cmd_demo(args):
+    from dpcorr.sim import SimConfig, run_sim_one
+
+    cfg = SimConfig(n=2000, rho=-0.95, eps1=0.5, eps2=1.0,
+                    b=args.b or 1000, seed=args.seed,
+                    dgp="gaussian", dgp_args={"mu": (2.0, 2.0),
+                                              "sigma": (2.0, 0.1)})
+    t0 = time.perf_counter()
+    res = run_sim_one(cfg)
+    print(json.dumps({"config": {"n": cfg.n, "rho": cfg.rho,
+                                 "eps": [cfg.eps1, cfg.eps2], "B": cfg.b},
+                      "summary": res.summary,
+                      "seconds": round(time.perf_counter() - t0, 2)},
+                     indent=2))
+
+
+def cmd_demo_subg(args):
+    from dpcorr.sim import SimConfig, run_sim_one
+
+    cfg = SimConfig(n=5500, rho=0.6, eps1=5.0, eps2=1.0, b=args.b or 500,
+                    seed=args.seed, dgp="bounded_factor", use_subg=True)
+    res = run_sim_one(cfg)
+    print(json.dumps({"config": {"n": cfg.n, "rho": cfg.rho,
+                                 "eps": [cfg.eps1, cfg.eps2], "B": cfg.b},
+                      "summary": res.summary}, indent=2))
+
+
+def _run_grid(args, gcfg, fig1_n, fig1_eps):
+    from dpcorr import report
+    from dpcorr.grid import run_grid
+
+    t0 = time.perf_counter()
+    res = run_grid(gcfg)
+    dt = time.perf_counter() - t0
+    reps = len(res.detail_all)
+    print(f"grid: {reps} replicate rows in {dt:.1f}s "
+          f"({reps / dt:.0f} reps/sec incl. compile)")
+    print(res.summ_all.to_string(index=False, float_format=lambda v: f"{v:.4f}"))
+    if args.out:
+        paths = report.render_all(grid_detail=res.detail_all,
+                                  grid_summ=res.summ_all, out_dir=args.out,
+                                  fig1_n=fig1_n, fig1_eps=fig1_eps)
+        print("figures:", *(str(p) for p in paths))
+
+
+def cmd_grid(args):
+    from dpcorr.grid import GridConfig
+
+    gcfg = GridConfig(b=args.b or 250, seed=args.seed, backend=args.backend,
+                      out_dir=args.out)
+    _run_grid(args, gcfg, fig1_n=1500, fig1_eps=(1.5, 0.5))
+
+
+def cmd_grid_subg(args):
+    from dpcorr.grid import GridConfig
+
+    gcfg = GridConfig(
+        n_grid=(2500, 4000, 6000, 9000, 12000),  # ver-cor-subG.R:245
+        b=args.b or 250, dgp="bounded_factor", use_subg=True,
+        seed=args.seed, backend=args.backend, out_dir=args.out)
+    _run_grid(args, gcfg, fig1_n=4000, fig1_eps=(1.5, 0.5))
+
+
+def cmd_hrs(args):
+    from dpcorr import hrs
+
+    res = hrs.point_estimates(hrs.HrsConfig(seed=args.seed))
+    print(json.dumps({
+        "n": res.n,
+        "private_moments": {
+            "age": {"mean": res.std.age_mean, "sd": res.std.age_sd},
+            "bmi": {"mean": res.std.bmi_mean, "sd": res.std.bmi_sd}},
+        "lambda": {"age_z": res.std.lam_age, "bmi_z": res.std.lam_bmi},
+        "rho_non_private": res.std.rho_np,
+        "NI": res.ni, "INT_age_to_bmi": res.int_}, indent=2))
+
+
+def cmd_hrs_sweep(args):
+    from dpcorr import hrs, report
+
+    summ = hrs.eps_sweep(hrs.HrsConfig(seed=args.seed),
+                         reps=args.b or 200, progress=True)
+    print(summ.to_string(index=False, float_format=lambda v: f"{v:.4f}"))
+    if args.out:
+        paths = report.render_all(hrs_summ=summ, out_dir=args.out)
+        summ.attrs["runs"].to_parquet(f"{args.out}/hrs_sweep_runs.parquet")
+        print("figures:", *(str(p) for p in paths))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="dpcorr")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, fn in [("demo", cmd_demo), ("demo-subg", cmd_demo_subg),
+                     ("grid", cmd_grid), ("grid-subg", cmd_grid_subg),
+                     ("hrs", cmd_hrs), ("hrs-sweep", cmd_hrs_sweep)]:
+        p = sub.add_parser(name)
+        _add_common(p)
+        p.set_defaults(fn=fn)
+    args = ap.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

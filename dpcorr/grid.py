@@ -121,11 +121,11 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
         i = int(row.i)
         path = _design_path(out_dir, i) if out_dir else None
         t0 = time.perf_counter()
-        cfg = gcfg.sim_config(row._asdict())
-        # Cache entries are valid only for the exact SimConfig that produced
-        # them: stamp it into the npz and treat any mismatch as a miss.
-        stamp = repr(cfg)
         try:
+            cfg = gcfg.sim_config(row._asdict())
+            # Cache entries are valid only for the exact SimConfig that
+            # produced them: stamp it into the npz; mismatch = miss.
+            stamp = repr(cfg)
             cached = False
             if path is not None and gcfg.resume and path.exists():
                 loaded = dict(np.load(path))

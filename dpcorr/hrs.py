@@ -1,0 +1,274 @@
+"""HRS real-data pipeline (reference real-data-sims.R, components #25-#34).
+
+BMI-vs-Age DP correlation on wave 2 of the HRS long panel:
+
+1. ingest via the framework's RDS reader (real-data-sims.R:13);
+2. per-wave missingness summary (:16-33);
+3. wave-2 complete-case extraction (:38-41);
+4. central-DP standardization of both variables + λ bounds from the private
+   moments (:273-287);
+5. point estimates — NI clipped-batch with λ overrides + randomized batches,
+   and INT with AGE as sender (:290-323);
+6. ε-sweep: for each ε in a grid, R Monte-Carlo replications of both
+   estimators (:342-448). The reference runs these 9,200 estimator calls
+   serially in R; here each ε is one ``jit(vmap)`` kernel over the
+   replication axis (batch geometry (m, k) is ε-dependent, so kernels
+   compile per ε — the shape-bucket strategy of SURVEY.md §7), and the
+   23-kernel sweep streams on one chip or shards over a mesh.
+
+Everything below the ingest boundary is pure JAX on device; only the
+column extraction and the final pandas summaries run on host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from dpcorr.io.rds import read_rds_table
+from dpcorr.models.estimators import ci_int_subg, correlation_ni_subg
+from dpcorr.ops.lambdas import lambda_from_priv, lambda_receiver_from_noise
+from dpcorr.ops.standardize import dp_sd, standardize_dp
+from dpcorr.utils import rng
+
+DEFAULT_PANEL = "/root/reference/hrs_long_panel.rds"
+
+
+@dataclasses.dataclass(frozen=True)
+class HrsConfig:
+    """Typed replacement for the reference's script globals
+    (real-data-sims.R:260-270)."""
+
+    panel_path: str = DEFAULT_PANEL
+    wave: str = "2"
+    age_lo: float = 45.0
+    age_hi: float = 90.0
+    bmi_lo: float = 15.0
+    bmi_hi: float = 35.0
+    eps_mean: float = 0.10
+    eps_m2: float = 0.10
+    eps_corr: float = 2.00
+    alpha: float = 0.05
+    seed: int = rng.MASTER_SEED
+    mixquant_mode: str = "det"
+
+
+# ---------------------------------------------------------------- ingest ----
+def load_panel(path: str = DEFAULT_PANEL) -> Mapping:
+    """Read the HRS long panel (723,744 × 8; SURVEY.md Appendix B)."""
+    return read_rds_table(path)
+
+
+def wave_missingness(cols: Mapping) -> pd.DataFrame:
+    """Per-wave n / missing-age / missing-bmi / complete-case counts
+    (real-data-sims.R:16-33)."""
+    wave = np.asarray(cols["wave"].values, dtype=object)
+    age = cols["agey_e"].values
+    bmi = cols["bmi"].values
+    rows = []
+    for w in sorted(set(wave.tolist()), key=lambda s: int(s)):
+        m = wave == w
+        a_miss = np.isnan(age[m])
+        b_miss = np.isnan(bmi[m])
+        rows.append({
+            "wave": int(w), "n": int(m.sum()),
+            "missing_age": int(a_miss.sum()),
+            "missing_bmi": int(b_miss.sum()),
+            "complete": int((~a_miss & ~b_miss).sum()),
+        })
+    return pd.DataFrame(rows)
+
+
+def extract_wave(cols: Mapping, wave: str = "2"):
+    """Complete-case (hhidpn, age, bmi) for one wave
+    (real-data-sims.R:38-41). NA removal is host-side, before any kernel."""
+    m = np.asarray(cols["wave"].values, dtype=object) == wave
+    age = cols["agey_e"].values[m]
+    bmi = cols["bmi"].values[m]
+    ids = cols["hhidpn"].values[m]
+    ok = ~np.isnan(age) & ~np.isnan(bmi)
+    return ids[ok], age[ok].astype(np.float32), bmi[ok].astype(np.float32)
+
+
+# --------------------------------------------------------- standardization ----
+@dataclasses.dataclass(frozen=True)
+class Standardized:
+    """Private standardization output: z-scores, private moments, λ bounds."""
+
+    age_z: jax.Array
+    bmi_z: jax.Array
+    age_mean: float
+    age_sd: float
+    bmi_mean: float
+    bmi_sd: float
+    lam_age: float
+    lam_bmi: float
+    rho_np: float  # non-private baseline on the standardized data (:349)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _standardize_kernel(key, age, bmi, cfg: HrsConfig):
+    a_mu, a_sd = dp_sd(rng.stream(key, "hrs/std/age"), age,
+                       cfg.age_lo, cfg.age_hi, cfg.eps_mean, cfg.eps_m2)
+    b_mu, b_sd = dp_sd(rng.stream(key, "hrs/std/bmi"), bmi,
+                       cfg.bmi_lo, cfg.bmi_hi, cfg.eps_mean, cfg.eps_m2)
+    age_z = standardize_dp(age, a_mu, a_sd, cfg.age_lo, cfg.age_hi)
+    bmi_z = standardize_dp(bmi, b_mu, b_sd, cfg.bmi_lo, cfg.bmi_hi)
+    corr = jnp.corrcoef(age_z, bmi_z)[0, 1]
+    return age_z, bmi_z, a_mu, a_sd, b_mu, b_sd, corr
+
+
+def standardize(age: np.ndarray, bmi: np.ndarray, cfg: HrsConfig,
+                key=None) -> Standardized:
+    """DP standardize both variables and derive λ bounds
+    (real-data-sims.R:273-287)."""
+    if key is None:
+        key = rng.master_key(cfg.seed)
+    age_z, bmi_z, a_mu, a_sd, b_mu, b_sd, corr = _standardize_kernel(
+        key, jnp.asarray(age), jnp.asarray(bmi), cfg)
+    a_mu, a_sd, b_mu, b_sd = (float(v) for v in (a_mu, a_sd, b_mu, b_sd))
+    return Standardized(
+        age_z=age_z, bmi_z=bmi_z,
+        age_mean=a_mu, age_sd=a_sd, bmi_mean=b_mu, bmi_sd=b_sd,
+        lam_age=float(lambda_from_priv(cfg.age_lo, cfg.age_hi, a_mu, a_sd)),
+        lam_bmi=float(lambda_from_priv(cfg.bmi_lo, cfg.bmi_hi, b_mu, b_sd)),
+        rho_np=float(corr),
+    )
+
+
+# ------------------------------------------------------------- estimators ----
+def _ni_once(key, age_z, bmi_z, eps, lam_age, lam_bmi, alpha):
+    """One NI run at privacy ε: λ-override, randomized-batch variant
+    (real-data-sims.R:355-372)."""
+    return correlation_ni_subg(key, age_z, bmi_z, eps, eps, alpha=alpha,
+                               lambda_x=lam_age, lambda_y=lam_bmi,
+                               randomize_batches=True, enforce_min_k=True)
+
+
+def _int_once(key, age_z, bmi_z, eps, lam_age, lam_bmi, lam_recv, delta,
+              alpha, mixquant_mode):
+    """One INT run at ε, AGE as sender (real-data-sims.R:374-404).
+
+    ``eps1 = eps2 = ε`` makes the sender-selection tie break to X = age,
+    matching the reference's explicit AGE→BMI direction.
+    """
+    return ci_int_subg(key, age_z, bmi_z, eps, eps, alpha=alpha,
+                       variant="real", lambda_sender=lam_age,
+                       lambda_other=lam_bmi, lambda_receiver=lam_recv,
+                       delta_clip=delta, mixquant_mode=mixquant_mode)
+
+
+@dataclasses.dataclass
+class HrsPointResult:
+    ni: dict
+    int_: dict
+    std: Standardized
+    n: int
+    config: HrsConfig
+
+
+def point_estimates(cfg: HrsConfig = HrsConfig(), cols=None) -> HrsPointResult:
+    """The headline HRS numbers (real-data-sims.R:259-333): one NI and one
+    INT (AGE→BMI) estimate at ε_corr on the privately standardized data."""
+    cols = load_panel(cfg.panel_path) if cols is None else cols
+    _, age, bmi = extract_wave(cols, cfg.wave)
+    std = standardize(age, bmi, cfg)
+    n = int(age.shape[0])
+    delta = 1.0 / n
+    lam_recv = float(lambda_receiver_from_noise(std.lam_age, std.lam_bmi,
+                                                cfg.eps_corr, delta))
+    key = rng.master_key(cfg.seed)
+    ni = _ni_once(rng.stream(key, "hrs/ni"), std.age_z, std.bmi_z,
+                  cfg.eps_corr, std.lam_age, std.lam_bmi, cfg.alpha)
+    it = _int_once(rng.stream(key, "hrs/int"), std.age_z, std.bmi_z,
+                   cfg.eps_corr, std.lam_age, std.lam_bmi, lam_recv, delta,
+                   cfg.alpha, cfg.mixquant_mode)
+    as_dict = lambda r: {"rho_hat": float(r.rho_hat),
+                         "ci_low": float(r.ci_low),
+                         "ci_high": float(r.ci_high)}
+    return HrsPointResult(as_dict(ni), as_dict(it), std, n, cfg)
+
+
+# --------------------------------------------------------------- ε-sweep ----
+@partial(jax.jit, static_argnums=(3, 8, 9))
+def _sweep_eps_kernel(keys_ni, keys_int, arrays, eps: float, lam_age,
+                      lam_bmi, lam_recv, delta, alpha: float,
+                      mixquant_mode: str):
+    """All replications of both methods at one ε as a single fused kernel."""
+    age_z, bmi_z = arrays
+
+    def ni(k):
+        r = _ni_once(k, age_z, bmi_z, eps, lam_age, lam_bmi, alpha)
+        return r.rho_hat, r.ci_low, r.ci_high
+
+    def it(k):
+        r = _int_once(k, age_z, bmi_z, eps, lam_age, lam_bmi, lam_recv,
+                      delta, alpha, mixquant_mode)
+        return r.rho_hat, r.ci_low, r.ci_high
+
+    return jax.vmap(ni)(keys_ni), jax.vmap(it)(keys_int)
+
+
+def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
+              eps_grid=None, reps: int = 200,
+              progress: bool = False) -> pd.DataFrame:
+    """The ε-sweep (real-data-sims.R:342-448): per-ε mean estimates, mean CI
+    ends, and CI-end quantiles (q10 of lows, q90 of highs) for NI and INT.
+
+    Returns the per-ε summary frame the figures consume; the raw per-rep
+    table is attached as ``.attrs["runs"]``.
+    """
+    cols = load_panel(cfg.panel_path) if cols is None else cols
+    _, age, bmi = extract_wave(cols, cfg.wave)
+    std = standardize(age, bmi, cfg)
+    n = int(age.shape[0])
+    delta = 1.0 / n
+    if eps_grid is None:
+        eps_grid = np.round(np.arange(0.25, 2.5001, 0.1), 10)  # 23 values
+
+    master = rng.master_key(cfg.seed)
+    arrays = (std.age_z, std.bmi_z)
+    runs = []
+    for eps_idx, eps in enumerate(eps_grid):
+        eps = float(eps)
+        # per-(method, ε, rep) keys — the key-tree analogue of the
+        # reference's seed formulas 10+37·rep+1000·eps_idx / 20+41·rep+...
+        k_eps = rng.design_key(master, eps_idx)
+        keys_ni = rng.rep_keys(rng.stream(k_eps, "hrs/sweep/ni"), reps)
+        keys_int = rng.rep_keys(rng.stream(k_eps, "hrs/sweep/int"), reps)
+        lam_recv = float(lambda_receiver_from_noise(std.lam_age, std.lam_bmi,
+                                                    eps, delta))
+        (ni_hat, ni_lo, ni_hi), (int_hat, int_lo, int_hi) = jax.tree.map(
+            np.asarray,
+            _sweep_eps_kernel(keys_ni, keys_int, arrays, eps, std.lam_age,
+                              std.lam_bmi, lam_recv, delta, cfg.alpha,
+                              cfg.mixquant_mode))
+        for meth, hat, lo, hi in (("NI", ni_hat, ni_lo, ni_hi),
+                                  ("INT", int_hat, int_lo, int_hi)):
+            runs.append(pd.DataFrame({
+                "method": meth, "eps_corr": eps,
+                "rep": np.arange(1, reps + 1),
+                "rho_hat": hat, "ci_low": lo, "ci_high": hi,
+            }))
+        if progress:
+            print(f"eps={eps:.2f}: NI mean {ni_hat.mean():+.4f}, "
+                  f"INT mean {int_hat.mean():+.4f}")
+
+    runs_df = pd.concat(runs, ignore_index=True)
+    g = runs_df.groupby(["method", "eps_corr"], sort=True)
+    summ = pd.DataFrame({
+        "rho_hat_mean": g["rho_hat"].mean(),
+        "ci_low_mean": g["ci_low"].mean(),
+        "ci_high_mean": g["ci_high"].mean(),
+        "ci_low_q10": g["ci_low"].quantile(0.10),
+        "ci_high_q90": g["ci_high"].quantile(0.90),
+    }).reset_index()
+    summ.attrs["runs"] = runs_df
+    summ.attrs["rho_np"] = std.rho_np
+    return summ

@@ -1,0 +1,147 @@
+"""RDS ingest front-end: native C++ fast path, pure-Python fallback.
+
+``read_rds_table(path)`` is the one public entry (the framework's
+``readRDS``, reference real-data-sims.R:13). It prefers the C++ reader
+(``native/rdsread.cpp`` → ``libdpcorr_rds.so``, loaded via ctypes and built
+on demand with ``make -C native`` if a toolchain is present) and falls back
+to :mod:`dpcorr.io.rds_py` — both produce identical
+:class:`~dpcorr.io.rds_py.RColumn` dicts, enforced by ``tests/test_rds.py``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+from dpcorr.io import rds_py
+from dpcorr.io.rds_py import RColumn
+
+log = logging.getLogger("dpcorr.io.rds")
+
+_NATIVE_DIR = Path(__file__).parent / "_native"
+_LIB_PATH = _NATIVE_DIR / "libdpcorr_rds.so"
+_lib = None
+_lib_tried = False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64 = ctypes.c_int64
+    lib.rds_read_table.restype = ctypes.c_void_p
+    lib.rds_read_table.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+    lib.rds_table_ncols.argtypes = [ctypes.c_void_p]
+    lib.rds_table_nrows.restype = i64
+    lib.rds_table_nrows.argtypes = [ctypes.c_void_p]
+    lib.rds_col_name.restype = ctypes.c_char_p
+    lib.rds_col_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rds_col_kind.restype = ctypes.c_char_p
+    lib.rds_col_kind.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rds_col_num.restype = ctypes.POINTER(ctypes.c_double)
+    lib.rds_col_num.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rds_col_num_len.restype = i64
+    lib.rds_col_num_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rds_col_str_blob.restype = ctypes.POINTER(ctypes.c_char)
+    lib.rds_col_str_blob.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.POINTER(i64)]
+    lib.rds_col_str_offsets.restype = ctypes.POINTER(i64)
+    lib.rds_col_str_offsets.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.POINTER(i64)]
+    lib.rds_col_nlevels.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rds_col_level.restype = ctypes.c_char_p
+    lib.rds_col_level.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+    lib.rds_col_nlabels.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rds_col_label_name.restype = ctypes.c_char_p
+    lib.rds_col_label_name.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_int]
+    lib.rds_col_label_value.restype = ctypes.c_double
+    lib.rds_col_label_value.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.c_int]
+    lib.rds_col_var_label.restype = ctypes.c_char_p
+    lib.rds_col_var_label.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rds_table_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _ensure_native():
+    """Load (building if necessary) the native reader; None if unavailable.
+
+    Controlled by ``DPCORR_NO_NATIVE=1`` (force the Python path, used by the
+    parity tests) — any build/load failure degrades silently to Python.
+    """
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("DPCORR_NO_NATIVE") == "1":
+        return None
+    try:
+        if not _LIB_PATH.exists():
+            native_dir = Path(__file__).parents[2] / "native"
+            if not (native_dir / "Makefile").exists():
+                return None
+            subprocess.run(["make", "-C", str(native_dir)], check=True,
+                           capture_output=True, timeout=120)
+        _lib = _bind(ctypes.CDLL(str(_LIB_PATH)))
+    except Exception as e:  # toolchain/load problems → portable path
+        log.info("native RDS reader unavailable (%s); using Python parser", e)
+        _lib = None
+    return _lib
+
+
+def _native_columns(lib, handle) -> dict[str, RColumn]:
+    i64 = ctypes.c_int64
+    out: dict[str, RColumn] = {}
+    nrows = lib.rds_table_nrows(handle)
+    for j in range(lib.rds_table_ncols(handle)):
+        name = lib.rds_col_name(handle, j).decode()
+        kind = lib.rds_col_kind(handle, j).decode()
+        nlab = lib.rds_col_nlabels(handle, j)
+        labels = {lib.rds_col_label_name(handle, j, k).decode():
+                  lib.rds_col_label_value(handle, j, k)
+                  for k in range(nlab)} or None
+        raw = lib.rds_col_var_label(handle, j)
+        var_label = raw.decode() if raw is not None else None
+        if kind == "string":
+            blob_len, noff = i64(), i64()
+            blob = lib.rds_col_str_blob(handle, j, ctypes.byref(blob_len))
+            offs = lib.rds_col_str_offsets(handle, j, ctypes.byref(noff))
+            data = ctypes.string_at(blob, blob_len.value)
+            off = np.ctypeslib.as_array(offs, shape=(noff.value,))
+            values = [None if o < 0 else
+                      data[o:data.index(b"\0", o)].decode("utf-8", "replace")
+                      for o in off.tolist()]
+            out[name] = RColumn(name, kind, values, label=var_label)
+            continue
+        n = lib.rds_col_num_len(handle, j)
+        ptr = lib.rds_col_num(handle, j)
+        vals = np.ctypeslib.as_array(ptr, shape=(int(n),)).copy()
+        levels = ([lib.rds_col_level(handle, j, k).decode()
+                   for k in range(lib.rds_col_nlevels(handle, j))]
+                  if kind == "factor" else None)
+        out[name] = RColumn(name, kind, vals, levels=levels, labels=labels,
+                            label=var_label)
+    if out and nrows >= 0:
+        pass  # nrows retrievable for API users; RColumns carry lengths
+    return out
+
+
+def read_rds_table(path: str | os.PathLike) -> dict[str, RColumn]:
+    """Read a data.frame/tibble ``.rds`` file into ``{name: RColumn}``."""
+    path = os.fspath(path)
+    lib = _ensure_native()
+    if lib is not None:
+        err = ctypes.create_string_buffer(512)
+        handle = lib.rds_read_table(path.encode(), err, len(err))
+        if handle:
+            try:
+                return _native_columns(lib, handle)
+            finally:
+                lib.rds_table_free(handle)
+        log.warning("native RDS reader failed on %s (%s); falling back",
+                    path, err.value.decode(errors="replace"))
+    return rds_py.read_rds_table(path)

@@ -137,8 +137,9 @@ class _Reader:
         if ptype in (GLOBALENV_SXP, EMPTYENV_SXP, BASEENV_SXP):
             return RObj(NILSXP)
         if ptype in (NAMESPACESXP, PACKAGESXP, PERSISTSXP):
-            # length-prefixed string vector naming the namespace/package
-            obj = RObj(ptype, data=self._strsxp(self.length()))
+            # InStringVec format: a compatibility 0, then length, then names
+            self.i32()
+            obj = RObj(ptype, data=self._strsxp(self.i32()))
             self.refs.append(obj)
             return obj
         if ptype in (LISTSXP, LANGSXP, ATTRLISTSXP, ATTRLANGSXP):
@@ -151,8 +152,6 @@ class _Reader:
                 return RObj(CHARSXP, data=None)  # NA_character_
             return RObj(CHARSXP, data=self._take(n).decode(self.encoding,
                                                            "replace"))
-        if ptype == SYMSXP:
-            raise AssertionError
         data: Any
         if ptype in (LGLSXP, INTSXP):
             n = self.length()
@@ -237,13 +236,27 @@ class _Reader:
                 start, start + step * n, step, dtype=np.float64)[: int(n)])
         if cls in ("wrap_logical", "wrap_integer", "wrap_real", "wrap_string",
                    "wrap_complex", "wrap_raw"):
-            return state.data[0] if state.type == VECSXP else state
+            return _altrep_payload(state)
         if cls == "deferred_string":
-            src = state.data[0] if state.type == VECSXP else state
+            src = _altrep_payload(state)
             vals = ["" if v is None else _r_num_str(v) for v in
                     np.asarray(src.data).tolist()]
             return RObj(STRSXP, data=vals)
         raise ValueError(f"unsupported ALTREP class {cls!r}")
+
+
+def _altrep_payload(state: RObj) -> RObj:
+    """First element of an ALTREP wrapper's state.
+
+    R serializes wrapper state as CONS(wrapped, metadata) — a LISTSXP whose
+    pairs are untagged — though a VECSXP form also exists; atomic state is
+    already the payload.
+    """
+    if state.type == LISTSXP:
+        return state.data[0][1]
+    if state.type == VECSXP:
+        return state.data[0]
+    return state
 
 
 def _r_num_str(v) -> str:
@@ -271,10 +284,20 @@ def decode_int(arr: np.ndarray) -> np.ndarray:
 
 
 def read_rds(path: str) -> RObj:
-    """Read a (possibly gzip-compressed) .rds file into an :class:`RObj`."""
+    """Read a .rds file (gzip/bzip2/xz-compressed or plain) into an
+    :class:`RObj`. All three are first-class ``saveRDS`` compress modes."""
     with open(path, "rb") as f:
-        head = f.read(2)
-    opener = gzip.open if head == b"\x1f\x8b" else open
+        head = f.read(6)
+    if head.startswith(b"\x1f\x8b"):
+        opener = gzip.open
+    elif head.startswith(b"BZh"):
+        import bz2
+        opener = bz2.open
+    elif head.startswith(b"\xfd7zXZ\x00"):
+        import lzma
+        opener = lzma.open
+    else:
+        opener = open
     with opener(path, "rb") as f:
         buf = f.read()
     rd = _Reader(buf)

@@ -1,0 +1,70 @@
+"""reticulate-facing bridge: plain-data API for the R front-end.
+
+The reference's only process boundary is the ``mclapply`` fan-out over
+design-grid rows (vert-cor.R:534-554); ``r/backend.R`` patches that call
+site with ``backend = c("mclapply", "tpu")`` and, for ``"tpu"``, calls into
+this module via reticulate. Everything here speaks reticulate-native types
+only — lists of dicts in, a pandas DataFrame out (reticulate converts both
+ways automatically) — so the R side stays a thin shim.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import pandas as pd
+
+from dpcorr.sim import SimConfig, run_sim_one
+from dpcorr.utils import rng
+
+
+def run_design_rows(rows: Sequence[Mapping], b: int = 250,
+                    seed: int = rng.MASTER_SEED,
+                    dgp: str = "gaussian", use_subg: bool = False,
+                    alpha: float = 0.05, normalise: bool = True,
+                    ci_mode: str = "auto",
+                    backend: str = "local") -> pd.DataFrame:
+    """Run design-grid rows and return the replicate-level detail frame.
+
+    ``rows``: list of ``{"n": .., "rho": .., "eps1": .., "eps2": ..}`` —
+    exactly the columns of the reference's ``design_df``
+    (vert-cor.R:507-511). Each row gets the key-tree equivalent of the
+    reference's per-task ``seed = 1e6 + i`` (vert-cor.R:531). Returns one
+    data.frame with the reference's metadata-joined detail columns
+    (vert-cor.R:557-568), ready for ``data.table`` aggregation on the R
+    side.
+    """
+    master = rng.master_key(int(seed))
+    frames = []
+    for i, row in enumerate(rows):
+        cfg = SimConfig(
+            n=int(row["n"]), rho=float(row["rho"]),
+            eps1=float(row["eps1"]), eps2=float(row["eps2"]),
+            b=int(b), alpha=float(alpha), dgp=dgp, use_subg=bool(use_subg),
+            normalise=bool(normalise), ci_mode=ci_mode,
+        )
+        if backend == "sharded":
+            from dpcorr.parallel import run_detail_sharded
+
+            res = run_detail_sharded(cfg, key=rng.design_key(master, i))
+        else:
+            res = run_sim_one(cfg, key=rng.design_key(master, i))
+        frame = pd.DataFrame({k: pd.array(v) for k, v in res.detail.items()})
+        frame.insert(0, "repl", range(1, cfg.b + 1))
+        frame["n"] = cfg.n
+        frame["rho_true"] = cfg.rho
+        frame["eps1"] = cfg.eps1
+        frame["eps2"] = cfg.eps2
+        frames.append(frame)
+    return pd.concat(frames, ignore_index=True)
+
+
+def run_hrs_sweep(eps_grid: Sequence[float], reps: int = 200,
+                  seed: int = rng.MASTER_SEED) -> pd.DataFrame:
+    """HRS ε-sweep for the R front-end (real-data-sims.R:342-448 seam)."""
+    from dpcorr import hrs
+
+    cfg = hrs.HrsConfig(seed=int(seed))
+    summ = hrs.eps_sweep(cfg, eps_grid=[float(e) for e in eps_grid],
+                         reps=int(reps))
+    return summ

@@ -1,0 +1,180 @@
+"""Figures / reporting (reference layer L6).
+
+Reproduces the reference's three synthetic figure families
+(vert-cor.R:600-721, ver-cor-subG.R:338-436) and the HRS ε-sweep panels
+(real-data-sims.R:450-506) with matplotlib, writing PDFs like the
+reference's ``ggsave`` calls.
+
+Design notes: two fixed series colors (NI blue, INT orange — a
+colorblind-safe pair, assigned by entity and never re-cycled), one y-axis
+per panel, recessive dotted grid, reference lines dashed. Each function
+takes the grid/sweep summary frames produced by :mod:`dpcorr.grid` /
+:mod:`dpcorr.hrs` and returns the matplotlib figure (also saved when
+``out`` is given).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+import pandas as pd
+
+#: fixed series colors — NI is always blue, INT always orange
+COLORS = {"NI": "#3b6fb5", "INT": "#e07b39"}
+_GRID_KW = dict(color="#cccccc", linestyle=":", linewidth=0.6)
+
+
+def _style(ax, xlabel, ylabel, title=None):
+    ax.grid(True, **_GRID_KW)
+    ax.set_axisbelow(True)
+    ax.spines[["top", "right"]].set_visible(False)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    if title:
+        ax.set_title(title, fontsize=10)
+
+
+def _save(fig, out):
+    if out:
+        out = Path(out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        fig.savefig(out, bbox_inches="tight")
+    return fig
+
+
+def fig_mean_band_vs_rho(detail_all: pd.DataFrame, n: int,
+                         eps_pair: tuple[float, float], out=None):
+    """Family 1 (vert-cor.R:600-661): mean estimate offset and mean CI-end
+    offsets vs true ρ, at one (n, ε) slice. Offsets = value − ρ_true, so a
+    perfect estimator hugs the zero line."""
+    d = detail_all[(detail_all.n == n) & (detail_all.eps1 == eps_pair[0])
+                   & (detail_all.eps2 == eps_pair[1])]
+    fig, axes = plt.subplots(1, 2, figsize=(9, 3.4), sharey=True)
+    for ax, meth in zip(axes, ("NI", "INT")):
+        p = meth.lower()
+        g = d.groupby("rho_true")
+        rho = np.array(sorted(d.rho_true.unique()))
+        mean_off = g[f"{p}_hat"].mean().reindex(rho) - rho
+        lo_off = g[f"{p}_low"].mean().reindex(rho) - rho
+        hi_off = g[f"{p}_up"].mean().reindex(rho) - rho
+        c = COLORS[meth]
+        ax.axhline(0.0, color="#888888", linestyle="--", linewidth=0.8)
+        ax.fill_between(rho, lo_off, hi_off, color=c, alpha=0.18,
+                        label="mean CI band")
+        ax.plot(rho, mean_off, color=c, linewidth=2, marker="o",
+                markersize=4, label="mean offset")
+        _style(ax, r"true $\rho$", "offset from truth",
+               f"{meth}  (n={n}, ε=({eps_pair[0]}, {eps_pair[1]}))")
+        ax.legend(frameon=False, fontsize=8)
+    fig.tight_layout()
+    return _save(fig, out)
+
+
+def fig_width_coverage_vs_n(summ_all: pd.DataFrame, rho: float,
+                            alpha: float = 0.05, out=None):
+    """Family 2 (vert-cor.R:663-694): CI width and empirical coverage vs n
+    at one ρ, per ε-pair; dashed nominal-coverage line."""
+    d = summ_all[summ_all.rho_true == rho]
+    eps_pairs = sorted(set(zip(d.eps1, d.eps2)))
+    fig, axes = plt.subplots(1, 2, figsize=(9, 3.4))
+    for meth in ("NI", "INT"):
+        s = d[d.method == meth]
+        for (e1, e2) in eps_pairs:
+            se = s[(s.eps1 == e1) & (s.eps2 == e2)].sort_values("n")
+            ls = "-" if (e1, e2) == eps_pairs[0] else \
+                 ("--" if (e1, e2) == eps_pairs[min(1, len(eps_pairs) - 1)]
+                  else ":")
+            axes[0].plot(se.n, se.ci_len, color=COLORS[meth], linestyle=ls,
+                         marker="o", markersize=3, linewidth=1.6,
+                         label=f"{meth} ε=({e1},{e2})")
+            axes[1].plot(se.n, se.coverage, color=COLORS[meth], linestyle=ls,
+                         marker="o", markersize=3, linewidth=1.6)
+    axes[1].axhline(1 - alpha, color="#888888", linestyle="--", linewidth=0.8)
+    _style(axes[0], "n", "mean CI length", f"CI width vs n (ρ={rho})")
+    _style(axes[1], "n", "empirical coverage", f"coverage vs n (ρ={rho})")
+    axes[0].legend(frameon=False, fontsize=7)
+    fig.tight_layout()
+    return _save(fig, out)
+
+
+def fig_mse_vs_n(summ_all: pd.DataFrame, rho: float, out=None):
+    """Family 3 (vert-cor.R:696-721): MSE vs n at one ρ (log-y), per ε."""
+    d = summ_all[summ_all.rho_true == rho]
+    eps_pairs = sorted(set(zip(d.eps1, d.eps2)))
+    fig, ax = plt.subplots(figsize=(5.2, 3.6))
+    for meth in ("NI", "INT"):
+        s = d[d.method == meth]
+        for j, (e1, e2) in enumerate(eps_pairs):
+            se = s[(s.eps1 == e1) & (s.eps2 == e2)].sort_values("n")
+            ax.plot(se.n, se.mse, color=COLORS[meth],
+                    linestyle=["-", "--", ":"][j % 3], marker="o",
+                    markersize=3, linewidth=1.6,
+                    label=f"{meth} ε=({e1},{e2})")
+    ax.set_yscale("log")
+    _style(ax, "n", "MSE", f"MSE vs n (ρ={rho})")
+    ax.legend(frameon=False, fontsize=7)
+    fig.tight_layout()
+    return _save(fig, out)
+
+
+def fig_hrs_sweep(summ: pd.DataFrame, rho_np: float | None = None, out=None):
+    """HRS ε-sweep panels (real-data-sims.R:450-506): per method, mean
+    estimate with mean-CI error bars vs ε, dashed non-private baseline,
+    solid zero line; shared y-limits across the two panels."""
+    if rho_np is None:
+        rho_np = summ.attrs.get("rho_np")
+    fig, axes = plt.subplots(1, 2, figsize=(9, 3.4), sharey=True)
+    ylo = summ.ci_low_mean.min()
+    yhi = summ.ci_high_mean.max()
+    pad = 0.05 * (yhi - ylo)
+    for ax, meth in zip(axes, ("NI", "INT")):
+        s = summ[summ.method == meth].sort_values("eps_corr")
+        c = COLORS[meth]
+        ax.axhline(0.0, color="#b03030", linewidth=0.9)
+        if rho_np is not None:
+            ax.axhline(rho_np, color="#555555", linestyle="--", linewidth=0.9,
+                       label=r"non-private $\rho$")
+        ax.errorbar(s.eps_corr, s.rho_hat_mean,
+                    yerr=[s.rho_hat_mean - s.ci_low_mean,
+                          s.ci_high_mean - s.rho_hat_mean],
+                    color=c, fmt="o-", markersize=3.5, linewidth=1.6,
+                    elinewidth=1.0, capsize=2, label=f"{meth} mean ± mean CI")
+        ax.set_ylim(ylo - pad, yhi + pad)
+        _style(ax, r"$\varepsilon$", r"$\hat\rho$", f"{meth} (AGE→BMI)")
+        ax.legend(frameon=False, fontsize=8)
+    fig.tight_layout()
+    return _save(fig, out)
+
+
+def render_all(grid_detail: pd.DataFrame | None = None,
+               grid_summ: pd.DataFrame | None = None,
+               hrs_summ: pd.DataFrame | None = None,
+               out_dir: str | Path = "figures",
+               fig1_n: int = 1500, fig1_eps=(1.5, 0.5),
+               fig23_rho: float = 0.5) -> list[Path]:
+    """Render every available figure family into ``out_dir``; returns the
+    written paths. Mirrors the reference's end-of-script figure dumps."""
+    out_dir = Path(out_dir)
+    written = []
+    if grid_detail is not None:
+        p = out_dir / "fig1_mean_band_vs_rho.pdf"
+        fig_mean_band_vs_rho(grid_detail, fig1_n, fig1_eps, out=p)
+        written.append(p)
+    if grid_summ is not None:
+        p = out_dir / "fig2_width_coverage_vs_n.pdf"
+        fig_width_coverage_vs_n(grid_summ, fig23_rho, out=p)
+        written.append(p)
+        p = out_dir / "fig3_mse_vs_n.pdf"
+        fig_mse_vs_n(grid_summ, fig23_rho, out=p)
+        written.append(p)
+    if hrs_summ is not None:
+        p = out_dir / "hrs_eps_sweep.pdf"
+        fig_hrs_sweep(hrs_summ, out=p)
+        written.append(p)
+    plt.close("all")
+    return written

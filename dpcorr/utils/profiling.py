@@ -1,0 +1,67 @@
+"""Tracing / profiling (SURVEY.md §5: absent in the reference — the only
+perf note there is a comment "~ minutes not hours", vert-cor.R:501).
+
+Two tools:
+
+- :func:`trace`: context manager around ``jax.profiler`` writing a
+  TensorBoard/Perfetto trace directory for kernel-level inspection;
+- :class:`Throughput`: wall-clock replications/sec counter — the
+  BASELINE.json metric (reps/sec/chip) — with a context-manager API used by
+  ``bench.py`` and the grid driver's timing table.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/dpcorr_trace"):
+    """Capture a device trace: ``with trace("dir"): run_kernels()``.
+
+    View with TensorBoard's profile plugin or Perfetto. Traces include XLA
+    op names so fusion decisions and collective overlap are visible.
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclasses.dataclass
+class Throughput:
+    """reps/sec counter.
+
+    >>> tp = Throughput(n_devices=len(jax.devices()))
+    >>> with tp.measure():
+    ...     out = run_block(...)   # must block (fetch) before exiting
+    >>> tp.add(n_reps)
+    >>> tp.reps_per_sec_chip
+    """
+
+    n_devices: int = 1
+    reps: int = 0
+    seconds: float = 0.0
+    _t0: float | None = None
+
+    @contextlib.contextmanager
+    def measure(self):
+        t0 = time.perf_counter()
+        yield
+        self.seconds += time.perf_counter() - t0
+
+    def add(self, n_reps: int) -> None:
+        self.reps += int(n_reps)
+
+    @property
+    def reps_per_sec(self) -> float:
+        return self.reps / self.seconds if self.seconds > 0 else float("nan")
+
+    @property
+    def reps_per_sec_chip(self) -> float:
+        return self.reps_per_sec / max(self.n_devices, 1)

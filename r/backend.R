@@ -1,0 +1,68 @@
+# R front-end for the TPU backend (SURVEY.md §7 step 6).
+#
+# The reference fans its design grid out with parallel::mclapply
+# (vert-cor.R:534-554, ver-cor-subG.R:271-296). This shim wraps that exact
+# seam with a `backend=` switch:
+#
+#   source("r/backend.R")
+#   detail_all <- run_grid_backend(design_df, run_row_fun, B = 250,
+#                                  backend = "tpu")     # or "mclapply"
+#
+# backend = "mclapply" reproduces the reference behavior verbatim (fork on
+# Unix, serial on Windows). backend = "tpu" ships the design rows to the
+# dpcorr JAX backend via reticulate and returns the same metadata-joined
+# replicate-level data.frame the reference builds at vert-cor.R:557-568, so
+# downstream data.table summaries and ggplot figures run unchanged.
+#
+# Requires: install.packages("reticulate"); a Python env with dpcorr on
+# PYTHONPATH (reticulate::use_python(...) or RETICULATE_PYTHON).
+
+run_grid_backend <- function(design_df, run_row_fun = NULL, B = 250,
+                             seed = 2025,
+                             backend = c("tpu", "mclapply"),
+                             dgp = "gaussian", use_subG = FALSE,
+                             alpha = 0.05, normalise = TRUE,
+                             mc_cores = max(1L, parallel::detectCores() - 1L)) {
+  backend <- match.arg(backend)
+
+  if (backend == "mclapply") {
+    # The reference's own path (vert-cor.R:513-554), unchanged.
+    stopifnot(is.function(run_row_fun))
+    runner <- if (.Platform$OS.type == "windows") {
+      function(i) run_row_fun(design_df[i, ], seed = 1e6 + i)
+    } else {
+      NULL
+    }
+    results <- if (.Platform$OS.type == "windows") {
+      lapply(seq_len(nrow(design_df)), runner)
+    } else {
+      parallel::mclapply(seq_len(nrow(design_df)), function(i) {
+        run_row_fun(design_df[i, ], seed = 1e6 + i)
+      }, mc.cores = mc_cores)
+    }
+    return(results)
+  }
+
+  # backend == "tpu": one call across the whole grid; replications are
+  # vmapped/sharded on-device instead of forked across host cores.
+  if (!requireNamespace("reticulate", quietly = TRUE)) {
+    stop("backend='tpu' needs the reticulate package")
+  }
+  bridge <- reticulate::import("dpcorr.rbridge")
+  rows <- lapply(seq_len(nrow(design_df)), function(i) {
+    as.list(design_df[i, c("n", "rho", "eps1", "eps2")])
+  })
+  detail <- bridge$run_design_rows(rows, b = as.integer(B),
+                                   seed = as.integer(seed), dgp = dgp,
+                                   use_subg = use_subG, alpha = alpha,
+                                   normalise = normalise)
+  as.data.frame(detail)
+}
+
+# HRS ε-sweep through the same backend (real-data-sims.R:342-448 seam).
+run_hrs_sweep_backend <- function(eps_grid = seq(0.25, 2.5, by = 0.1),
+                                  R = 200, seed = 2025) {
+  bridge <- reticulate::import("dpcorr.rbridge")
+  as.data.frame(bridge$run_hrs_sweep(eps_grid, reps = as.integer(R),
+                                     seed = as.integer(seed)))
+}

@@ -1,0 +1,80 @@
+"""HRS real-data pipeline tests (reference real-data-sims.R).
+
+Ground truths: counts from the panel itself, the non-private correlation
+baseline, and the reference's statistical behavior (estimates bracket
+ρ_np; CI width shrinks as ε grows).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dpcorr import hrs
+
+
+@pytest.fixture(scope="module")
+def cols():
+    return hrs.load_panel()
+
+
+@pytest.fixture(scope="module")
+def point(cols):
+    return hrs.point_estimates(cols=cols)
+
+
+def test_wave_missingness(cols):
+    df = hrs.wave_missingness(cols)
+    assert len(df) == 16  # 16 waves (SURVEY.md Appendix B)
+    w2 = df[df.wave == 2].iloc[0]
+    assert w2.n == 45_234
+    assert w2.complete == 19_433  # drives every downstream HRS number
+    assert (df.complete <= df.n).all()
+
+
+def test_extract_wave(cols):
+    ids, age, bmi = hrs.extract_wave(cols, "2")
+    assert age.shape == bmi.shape == ids.shape == (19_433,)
+    assert not np.isnan(age).any() and not np.isnan(bmi).any()
+
+
+def test_standardize_moments(cols):
+    """Privately standardized variables have ≈0 mean / ≈1 sd at n≈20k with
+    ε=0.1 DP moments, and λ bounds are the max standardized excursion."""
+    _, age, bmi = hrs.extract_wave(cols, "2")
+    std = hrs.standardize(age, bmi, hrs.HrsConfig())
+    az = np.asarray(std.age_z)
+    assert abs(az.mean()) < 0.05
+    assert abs(az.std() - 1.0) < 0.1
+    # clipped data can't exceed the λ bound derived from the same moments
+    assert np.abs(az).max() <= std.lam_age + 1e-5
+    # non-private baseline: age-BMI correlation in wave 2 is ≈ -0.19
+    assert -0.25 < std.rho_np < -0.15
+
+
+def test_point_estimates(point):
+    for r in (point.ni, point.int_):
+        assert -1.0 <= r["ci_low"] <= r["rho_hat"] <= r["ci_high"] <= 1.0
+        # at ε=2 both methods land near the non-private truth
+        assert abs(r["rho_hat"] - point.std.rho_np) < 0.15
+    assert point.n == 19_433
+
+
+def test_point_estimates_deterministic(cols):
+    a = hrs.point_estimates(cols=cols)
+    b = hrs.point_estimates(cols=cols)
+    assert a.ni == b.ni and a.int_ == b.int_
+
+
+def test_eps_sweep_behavior(cols):
+    summ = hrs.eps_sweep(cols=cols, eps_grid=[0.3, 2.0], reps=24)
+    assert set(summ.method) == {"NI", "INT"}
+    assert summ.attrs["rho_np"] == pytest.approx(-0.193, abs=0.02)
+    for meth in ("NI", "INT"):
+        s = summ[summ.method == meth].set_index("eps_corr")
+        width = s.ci_high_mean - s.ci_low_mean
+        assert width[0.3] > width[2.0]  # CIs shrink with budget
+        # high-ε estimates concentrate near the non-private baseline
+        assert abs(s.rho_hat_mean[2.0] - summ.attrs["rho_np"]) < 0.1
+    runs = summ.attrs["runs"]
+    assert len(runs) == 2 * 2 * 24

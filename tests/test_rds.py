@@ -193,6 +193,44 @@ def test_altrep_compact_intseq():
     assert obj.data.tolist() == [10, 11, 12, 13, 14]
 
 
+def test_altrep_wrap_real_cons_state():
+    """R serializes wrap_* ALTREP state as CONS(wrapped, metadata) — a
+    pairlist, not a VECSXP."""
+    w = W()
+    w.flags(rds_py.ALTREP_SXP)
+    w.flags(rds_py.LISTSXP)
+    w.sym("wrap_real")
+    w.flags(rds_py.LISTSXP)
+    w.sym("base")
+    w.flags(rds_py.LISTSXP)
+    w.intsxp([14])
+    w.nil()
+    # state: CONS(wrapped REALSXP, metadata INTSXP) — untagged pairlist
+    w.flags(rds_py.LISTSXP)
+    w.realsxp([3.5, -1.0])
+    w.flags(rds_py.LISTSXP)
+    w.intsxp([0, 0])
+    w.nil()
+    w.nil()  # attr
+    obj = _parse(w.bytes())
+    assert obj.type == rds_py.REALSXP
+    assert obj.data.tolist() == [3.5, -1.0]
+
+
+@pytest.mark.parametrize("mod", ["gzip", "bz2", "lzma"])
+def test_compression_flavors(mod, tmp_path):
+    """saveRDS supports gzip, bzip2, and xz compression; sniff all three."""
+    import importlib
+
+    w = W()
+    w.realsxp([1.0, 2.0, 3.0])
+    comp = importlib.import_module(mod)
+    path = tmp_path / f"x_{mod}.rds"
+    path.write_bytes(comp.compress(w.bytes()))
+    obj = rds_py.read_rds(str(path))
+    assert obj.data.tolist() == [1.0, 2.0, 3.0]
+
+
 def test_haven_labelled_column():
     w = W()
     w.realsxp([1.0, 2.0], has_attr=True)
@@ -211,6 +249,34 @@ def test_haven_labelled_column():
 @pytest.fixture(scope="module")
 def hrs_cols():
     return rds_py.read_rds_table(HRS_PATH)
+
+
+def _columns_equal(a, b):
+    assert a.kind == b.kind and a.levels == b.levels and a.label == b.label
+    if (a.labels is None) != (b.labels is None):
+        raise AssertionError("labels presence differs")
+    if a.labels is not None:
+        assert list(a.labels) == list(b.labels)
+        assert np.allclose(list(a.labels.values()), list(b.labels.values()),
+                           equal_nan=True)
+    if a.kind == "string":
+        assert a.values == b.values
+    else:
+        assert np.array_equal(np.asarray(a.values), np.asarray(b.values),
+                              equal_nan=True)
+
+
+def test_native_python_parity(hrs_cols):
+    """The C++ reader and the Python reader must be byte-identical on every
+    column of the real panel (same NA placement, levels, labels)."""
+    from dpcorr.io import rds as rds_mod
+
+    if rds_mod._ensure_native() is None:
+        pytest.skip("native RDS reader not available")
+    native = rds_mod.read_rds_table(HRS_PATH)
+    assert list(native) == list(hrs_cols)
+    for name in native:
+        _columns_equal(native[name], hrs_cols[name])
 
 
 def test_hrs_schema(hrs_cols):

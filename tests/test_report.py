@@ -1,0 +1,45 @@
+"""Figure-layer smoke tests: every family renders and writes a PDF from a
+small real grid + sweep output."""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dpcorr import report
+from dpcorr.grid import GridConfig, run_grid
+
+
+@pytest.fixture(scope="module")
+def small_grid():
+    gcfg = GridConfig(n_grid=(400, 800), rho_grid=(0.0, 0.5),
+                      eps_pairs=((1.0, 1.0), (1.5, 0.5)), b=24)
+    return run_grid(gcfg)
+
+
+def test_synthetic_figures(small_grid, tmp_path):
+    paths = report.render_all(grid_detail=small_grid.detail_all,
+                              grid_summ=small_grid.summ_all,
+                              out_dir=tmp_path, fig1_n=800,
+                              fig1_eps=(1.5, 0.5), fig23_rho=0.5)
+    assert len(paths) == 3
+    for p in paths:
+        assert p.exists() and p.stat().st_size > 2_000
+
+
+def test_hrs_figure(tmp_path):
+    # synthetic sweep summary with the exact schema hrs.eps_sweep emits
+    eps = np.round(np.arange(0.25, 0.66, 0.1), 10)
+    rows = []
+    for meth in ("NI", "INT"):
+        for e in eps:
+            w = 0.8 / e
+            rows.append({"method": meth, "eps_corr": e,
+                         "rho_hat_mean": -0.19, "ci_low_mean": -0.19 - w,
+                         "ci_high_mean": -0.19 + w, "ci_low_q10": -0.19 - w,
+                         "ci_high_q90": -0.19 + w})
+    summ = pd.DataFrame(rows)
+    p = tmp_path / "hrs.pdf"
+    report.fig_hrs_sweep(summ, rho_np=-0.193, out=p)
+    assert p.exists() and p.stat().st_size > 2_000

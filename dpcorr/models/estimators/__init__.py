@@ -9,6 +9,9 @@ Four families (SURVEY.md §2.2):
   receiver), with the grid (v1) and real-data (v2) variants exposed as
   explicit parameters per the duplication ledger (SURVEY.md Appendix A).
 
+Each family also has a streaming (n-blocked) variant in :mod:`streaming`
+for stress-scale n where the sample vectors must never materialize in HBM.
+
 Every estimator is a pure function ``f(key, x, y, eps1, eps2, ...) ->
 result`` with static batch geometry, so ``jax.vmap`` over keys evaluates a
 full Monte-Carlo replication batch as one fused kernel.
@@ -28,3 +31,12 @@ from dpcorr.models.estimators.int_sign import (  # noqa: F401
 )
 from dpcorr.models.estimators.ni_subg import correlation_ni_subg  # noqa: F401
 from dpcorr.models.estimators.int_subg import ci_int_subg  # noqa: F401
+from dpcorr.models.estimators.streaming import (  # noqa: F401
+    array_chunk_fn,
+    choose_n_chunk,
+    ci_int_signflip_stream,
+    ci_int_subg_stream,
+    ci_ni_signbatch_stream,
+    correlation_ni_subg_stream,
+    dgp_chunk_fn,
+)

@@ -49,28 +49,14 @@ def correlation_int_signflip(key: jax.Array, x: jax.Array, y: jax.Array,
     return jnp.sin(jnp.pi * eta_hat / 2.0)
 
 
-def ci_int_signflip(key: jax.Array, x: jax.Array, y: jax.Array,
-                    eps1: float, eps2: float, alpha: float = 0.05,
-                    mode: str = "auto", normalise: bool = True,
-                    mixquant_mode: str = "det") -> CorrResult:
-    """Estimate + CI (vert-cor.R:260-317).
-
-    ``mode``: "auto" switches normal/laplace at √n·ε_r > 0.5
-    (vert-cor.R:294-296) — static per design point. ``mixquant_mode``:
-    "det" uses the closed-form quantile; "mc" reproduces the reference's
-    per-CI 1000-draw order statistic (vert-cor.R:302).
-    """
-    n = x.shape[0]
-    if normalise:
-        l_clip = jnp.sqrt(2.0 * jnp.log(float(n)))
-        x = priv_standardize(stream(key, "int_sign/std_x"), x, eps1, l_clip)
-        y = priv_standardize(stream(key, "int_sign/std_y"), y, eps2, l_clip)
-
-    eps_s, eps_r = max(eps1, eps2), min(eps1, eps2)
+def interval_from_rho(key: jax.Array, rho_hat: jax.Array, n: int,
+                      eps_s: float, eps_r: float, alpha: float,
+                      mode: str, mixquant_mode: str) -> CorrResult:
+    """CI construction given ρ̂ (vert-cor.R:281-317), shared by the
+    materialized and streaming estimators. ``key`` is the CI-level key (the
+    mixquant MC substream hangs off it)."""
     e_s = math.exp(eps_s)
     ratio = (e_s + 1.0) / (e_s - 1.0)
-
-    rho_hat = correlation_int_signflip(stream(key, "int_sign/est"), x, y, eps1, eps2)
     # η̂ back out of ρ̂: 1 − (2/π)·acos(ρ̂) ≡ (2/π)·asin(ρ̂) (vert-cor.R:281)
     eta_hat = 1.0 - jnp.arccos(rho_hat) * 2.0 / jnp.pi
     sigma_eta2 = 1.0 - (1.0 / ratio) ** 2 * eta_hat**2  # vert-cor.R:284
@@ -96,3 +82,26 @@ def ci_int_signflip(key: jax.Array, x: jax.Array, y: jax.Array,
     lo = jnp.sin(jnp.pi / 2.0 * jnp.maximum(eta_hat - width_eta, -1.0))
     hi = jnp.sin(jnp.pi / 2.0 * jnp.minimum(eta_hat + width_eta, 1.0))
     return CorrResult(rho_hat, lo, hi)
+
+
+def ci_int_signflip(key: jax.Array, x: jax.Array, y: jax.Array,
+                    eps1: float, eps2: float, alpha: float = 0.05,
+                    mode: str = "auto", normalise: bool = True,
+                    mixquant_mode: str = "det") -> CorrResult:
+    """Estimate + CI (vert-cor.R:260-317).
+
+    ``mode``: "auto" switches normal/laplace at √n·ε_r > 0.5
+    (vert-cor.R:294-296) — static per design point. ``mixquant_mode``:
+    "det" uses the closed-form quantile; "mc" reproduces the reference's
+    per-CI 1000-draw order statistic (vert-cor.R:302).
+    """
+    n = x.shape[0]
+    if normalise:
+        l_clip = jnp.sqrt(2.0 * jnp.log(float(n)))
+        x = priv_standardize(stream(key, "int_sign/std_x"), x, eps1, l_clip)
+        y = priv_standardize(stream(key, "int_sign/std_y"), y, eps2, l_clip)
+
+    eps_s, eps_r = max(eps1, eps2), min(eps1, eps2)
+    rho_hat = correlation_int_signflip(stream(key, "int_sign/est"), x, y, eps1, eps2)
+    return interval_from_rho(key, rho_hat, n, eps_s, eps_r, alpha, mode,
+                             mixquant_mode)

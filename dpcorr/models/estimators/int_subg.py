@@ -36,6 +36,24 @@ from dpcorr.utils.rng import stream
 _CSTAR_MAX = 1e6  # sd(Uc)→0 sends c*→∞; a huge finite c* yields width → ±1 CI
 
 
+def grid_interval(key: jax.Array, rho_hat: jax.Array, sd_uc: jax.Array,
+                  n: int, eps_r: float, central_scale, alpha: float,
+                  mixquant_mode: str) -> CorrResult:
+    """Grid-variant (v1) CI given ρ̂ and sd(Uc) (ver-cor-subG.R:99-104),
+    shared by the materialized and streaming estimators: se includes the
+    central-noise variance term; ρ-space clamp."""
+    sd_safe = jnp.maximum(sd_uc, 1e-30)
+    p = 1.0 - alpha / 2.0
+    se_norm = jnp.sqrt(sd_uc**2 + 2.0 * central_scale**2)
+    cstar = jnp.minimum(2.0 / (jnp.sqrt(float(n)) * sd_safe * eps_r), _CSTAR_MAX)
+    q = (mixquant_mc(stream(key, "int_subg/mixquant"), cstar, p) if mixquant_mode == "mc"
+         else mixquant(cstar, p))
+    width = q * se_norm / jnp.sqrt(float(n))
+    lo = jnp.maximum(rho_hat - width, -1.0)
+    hi = jnp.minimum(rho_hat + width, 1.0)
+    return CorrResult(rho_hat, lo, hi)
+
+
 def ci_int_subg(key: jax.Array, x: jax.Array, y: jax.Array,
                 eps1: float, eps2: float,
                 eta1: float = 1.0, eta2: float = 1.0,
@@ -87,18 +105,14 @@ def ci_int_subg(key: jax.Array, x: jax.Array, y: jax.Array,
     rho_hat = jnp.mean(uc) + laplace(stream(key, "int_subg/lap_recv"), (), central_scale)
 
     sd_uc = sample_sd(uc)
-    sd_safe = jnp.maximum(sd_uc, 1e-30)
-    p = 1.0 - alpha / 2.0
     if variant == "grid":
-        # se includes the central-noise variance term (ver-cor-subG.R:99-101)
-        se_norm = jnp.sqrt(sd_uc**2 + 2.0 * central_scale**2)
-        cstar = jnp.minimum(2.0 / (jnp.sqrt(float(n)) * sd_safe * eps_r), _CSTAR_MAX)
-        q = (mixquant_mc(stream(key, "int_subg/mixquant"), cstar, p) if mixquant_mode == "mc"
-             else mixquant(cstar, p))
-        width = q * se_norm / jnp.sqrt(float(n))
+        return grid_interval(key, rho_hat, sd_uc, n, eps_r, central_scale,
+                             alpha, mixquant_mode)
     else:
         # sampling-only se + explicit sd==0 degenerate branch
         # (real-data-sims.R:237-242)
+        sd_safe = jnp.maximum(sd_uc, 1e-30)
+        p = 1.0 - alpha / 2.0
         cstar = jnp.minimum(2.0 * lam_r / (jnp.sqrt(float(n)) * sd_safe * eps_r),
                             _CSTAR_MAX)
         q = (mixquant_mc(stream(key, "int_subg/mixquant"), cstar, p) if mixquant_mode == "mc"

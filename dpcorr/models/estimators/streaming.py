@@ -1,0 +1,302 @@
+"""Streaming (n-blocked) estimator kernels for stress-scale sample sizes.
+
+The materialized estimators hold the full (n,) sample vectors in HBM; under
+``vmap`` over thousands of resident replications that is n × B_chunk floats
+— fine at the reference's n ≤ 12,000 (ver-cor-subG.R:245) but not at the
+stress config's n = 10⁶ (BASELINE.md config 5). These variants are the
+SURVEY.md §5 "long-context" answer: a ``lax.fori_loop`` over n-chunks whose
+body *regenerates* its chunk of data from a folded key (rematerialization —
+trade RNG FLOPs for HBM, the ``jax.checkpoint`` idea applied to data) and
+accumulates sufficient statistics, so per replication only O(n_chunk + k)
+values are ever live.
+
+What streams, per estimator:
+
+- NI sign-batch / NI sub-Gaussian: per-batch means over m consecutive points
+  (vert-cor.R:131-140). Batch noise is still drawn as one ``(k,)`` vector
+  with the *same key address and call shape* as the materialized path, then
+  sliced per chunk — so given identical data the streaming estimate equals
+  the materialized one bit-for-bit up to float reduction order (k = n/m is
+  small: 500 KB at n=10⁶). Accumulated: Σ T_j, Σ T_j².
+- INT sign-flip: Σ of randomized-response cores (vert-cor.R:186-191);
+  per-sample flips are drawn per chunk from a folded key. The single
+  receiver Laplace draw keeps its materialized key address.
+- INT sub-Gaussian (grid variant): Σ Uc, Σ Uc² of the clipped products
+  (ver-cor-subG.R:87-97); per-sample sender noise per chunk.
+
+DP standardization (``normalise=True``) needs global clipped moments before
+any batch can be processed, so those estimators make **two passes**: pass A
+accumulates Σ clip(x), Σ clip(x)² (the sums inside ``priv_standardize``,
+vert-cor.R:322-348), pass B re-generates the same chunks (same keys) and
+streams the batches. Identical key addressing means the standardization
+noise matches the materialized path exactly.
+
+Chunk protocol: ``chunk_fn(c) -> (n_chunk, 2)`` must return rows
+[c·n_chunk, (c+1)·n_chunk) of an (effectively) infinite i.i.d. sample; rows
+past n are masked out. ``n_chunk`` must be a multiple of the batch size m
+(use :func:`choose_n_chunk`) so batch boundaries never straddle chunks.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import ndtri
+
+from dpcorr.models.estimators import int_sign, int_subg  # submodules, not pkg re-exports
+from dpcorr.models.estimators.common import CorrResult, batch_geometry
+from dpcorr.ops.lambdas import lambda_int_n, lambda_n
+from dpcorr.ops.noise import clip_sym, laplace
+from dpcorr.ops.standardize import priv_moments_from_sums
+from dpcorr.utils.rng import stream
+
+ChunkFn = Callable[[jax.Array], jax.Array]  # c -> (n_chunk, 2)
+
+
+def choose_n_chunk(n: int, m: int, target: int = 65536) -> int:
+    """Largest multiple of m that is ≤ max(target, m): the resident-rows
+    budget per replication, aligned so batches never straddle chunks."""
+    return max(m, (min(target, n + m - 1) // m) * m)
+
+
+def array_chunk_fn(xy: jax.Array, n_chunk: int) -> ChunkFn:
+    """Chunk view of a materialized (n, 2) array (zero-padded tail) — used
+    by the exactness tests and by HRS-sized fixed datasets."""
+    n = xy.shape[0]
+    n_chunks = -(-n // n_chunk)
+    padded = jnp.pad(xy, ((0, n_chunks * n_chunk - n), (0, 0)))
+
+    def chunk_fn(c):
+        return jax.lax.dynamic_slice(padded, (c * n_chunk, 0), (n_chunk, 2))
+
+    return chunk_fn
+
+
+def dgp_chunk_fn(dgp_fn: Callable, key: jax.Array, n_chunk: int, rho) -> ChunkFn:
+    """Chunkwise DGP: chunk c is generated from ``fold_in(key, c)``. Rows
+    are i.i.d., so the chunked sample is distribution-identical to one
+    ``dgp_fn(key, n, rho)`` call (the draws differ — SURVEY.md §5 RNG:
+    acceptance is statistical, and the streaming key-tree is itself
+    deterministic)."""
+
+    def chunk_fn(c):
+        return dgp_fn(jax.random.fold_in(key, c), n_chunk, rho)
+
+    return chunk_fn
+
+
+# ------------------------------------------------------------ pass A ----
+def _clipped_moment_sums(chunk_fn: ChunkFn, n: int, n_chunk: int, l_raw):
+    """Σ clip(·, ±l_raw) and Σ clip(·)² per column over the first n rows —
+    the sufficient statistics of ``priv_standardize`` (vert-cor.R:334-341)."""
+    n_chunks = -(-n // n_chunk)
+
+    # lax.map (not a carried fori_loop): per-chunk partials are *varying*
+    # values under shard_map's vma check, while a scalar carry seeded with a
+    # replicated 0 would be rejected; C scalars of stacked partials are free.
+    def chunk_stats(c):
+        xy = clip_sym(chunk_fn(c), l_raw)
+        w = ((c * n_chunk + jnp.arange(n_chunk)) < n).astype(xy.dtype)[:, None]
+        return jnp.sum(xy * w, axis=0), jnp.sum(xy * xy * w, axis=0)
+
+    s1c, s2c = jax.lax.map(chunk_stats, jnp.arange(n_chunks))
+    return jnp.sum(s1c, axis=0), jnp.sum(s2c, axis=0)
+
+
+def _priv_moments(std_key: jax.Array, s1, s2, n: int, eps_norm, l_raw):
+    """(μ_priv, 1/σ_priv) from streamed sums, via the same shared core (and
+    hence noise scales + key addresses) as ``priv_standardize``."""
+    mu, var = priv_moments_from_sums(std_key, s1, s2, n, eps_norm, l_raw)
+    return mu, 1.0 / jnp.sqrt(var)
+
+
+def _standardizers(key: jax.Array, chunk_fn: ChunkFn, n: int, n_chunk: int,
+                   eps1, eps2, ns: str):
+    """Pass A + per-column transforms (clip → center → scale), matching
+    ``priv_standardize`` with clip L = √(2·log n) (vert-cor.R:212, 269)."""
+    l_clip = math.sqrt(2.0 * math.log(n))
+    s1, s2 = _clipped_moment_sums(chunk_fn, n, n_chunk, l_clip)
+    mu_x, inv_x = _priv_moments(stream(key, f"{ns}/std_x"), s1[0], s2[0],
+                                n, eps1, l_clip)
+    mu_y, inv_y = _priv_moments(stream(key, f"{ns}/std_y"), s1[1], s2[1],
+                                n, eps2, l_clip)
+    tx = lambda v: (clip_sym(v, l_clip) - mu_x) * inv_x
+    ty = lambda v: (clip_sym(v, l_clip) - mu_y) * inv_y
+    return tx, ty
+
+
+# ------------------------------------------------------------ NI core ----
+def _ni_stream(key_x: jax.Array, key_y: jax.Array, chunk_fn: ChunkFn,
+               tx: Callable, ty: Callable, m: int, k: int,
+               scale_x, scale_y, n_chunk: int):
+    """Streamed batch pipeline (vert-cor.R:131-153 / ver-cor-subG.R:40-52):
+    per chunk, kc = n_chunk/m batch means of the transformed columns, plus
+    the sliced batch noise; accumulate Σ T_j and Σ T_j².
+
+    Returns (η̂, sd(T_j)). Noise is one materialized-shape ``(k,)`` draw per
+    side (zero-padded to the chunk grid), so results match the materialized
+    estimators exactly on identical data.
+    """
+    kc = n_chunk // m
+    n_chunks = -(-k // kc)
+    pad = n_chunks * kc - k
+    lap_x = jnp.pad(laplace(key_x, (k,), scale_x), (0, pad))
+    lap_y = jnp.pad(laplace(key_y, (k,), scale_y), (0, pad))
+
+    def chunk_stats(c):
+        xy = chunk_fn(c)
+        xb = tx(xy[:, 0]).reshape(kc, m).mean(axis=1)
+        yb = ty(xy[:, 1]).reshape(kc, m).mean(axis=1)
+        b0 = c * kc
+        xt = xb + jax.lax.dynamic_slice(lap_x, (b0,), (kc,))
+        yt = yb + jax.lax.dynamic_slice(lap_y, (b0,), (kc,))
+        t = jnp.where(b0 + jnp.arange(kc) < k, m * xt * yt, 0.0)
+        return jnp.sum(t), jnp.sum(t * t)
+
+    st_c, st2_c = jax.lax.map(chunk_stats, jnp.arange(n_chunks))
+    st, st2 = jnp.sum(st_c), jnp.sum(st2_c)
+    eta_hat = st / k
+    # sample sd via sufficient statistics (denominator k−1, as R's sd)
+    var_t = jnp.maximum((st2 - k * eta_hat * eta_hat) / max(k - 1, 1), 0.0)
+    return eta_hat, jnp.sqrt(var_t)
+
+
+def ci_ni_signbatch_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
+                           eps1: float, eps2: float, alpha: float = 0.05,
+                           normalise: bool = True,
+                           n_chunk: int = 65536) -> CorrResult:
+    """Streaming NI sign-batch estimate + CI ≡ :func:`ci_ni_signbatch`
+    (vert-cor.R:204-255) without materializing the n-vectors."""
+    m, k = batch_geometry(n, eps1, eps2)
+    if n_chunk % m:
+        # chunk_fn's chunking is baked into its closure, so silently
+        # re-rounding here would desync from it — the caller must align
+        # (use choose_n_chunk) before building chunk_fn.
+        raise ValueError(
+            f"n_chunk={n_chunk} must be a multiple of the batch size m={m} "
+            f"(use choose_n_chunk(n, m, target))")
+    if normalise:
+        sx, sy = _standardizers(key, chunk_fn, n, n_chunk, eps1, eps2, "ni_sign")
+        tx = lambda v: jnp.sign(sx(v))
+        ty = lambda v: jnp.sign(sy(v))
+    else:
+        tx = ty = jnp.sign
+    eta_hat, s_eta = _ni_stream(
+        stream(key, "ni_sign/lap_x"), stream(key, "ni_sign/lap_y"),
+        chunk_fn, tx, ty, m, k, 2.0 / (m * eps1), 2.0 / (m * eps2), n_chunk)
+    rho_hat = jnp.sin(jnp.pi * eta_hat / 2.0)
+    half = ndtri(1.0 - alpha / 2.0) * s_eta / jnp.sqrt(float(k))
+    # η-space clamp THEN sine map (vert-cor.R:249-254)
+    lo = jnp.sin(jnp.pi / 2.0 * jnp.maximum(eta_hat - half, -1.0))
+    hi = jnp.sin(jnp.pi / 2.0 * jnp.minimum(eta_hat + half, 1.0))
+    return CorrResult(rho_hat, lo, hi)
+
+
+def correlation_ni_subg_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
+                               eps1: float, eps2: float,
+                               eta1: float = 1.0, eta2: float = 1.0,
+                               alpha: float = 0.05,
+                               n_chunk: int = 65536) -> CorrResult:
+    """Streaming NI clipped-batch ≡ the grid variant of
+    :func:`correlation_ni_subg` (ver-cor-subG.R:25-62): sequential batches,
+    λ from ``lambda_n`` (randomized batches need a global permutation and
+    stay on the materialized path)."""
+    m, k = batch_geometry(n, eps1, eps2)
+    if n_chunk % m:
+        raise ValueError(
+            f"n_chunk={n_chunk} must be a multiple of the batch size m={m} "
+            f"(use choose_n_chunk(n, m, target))")
+    lam1 = lambda_n(n, eta1)
+    lam2 = lambda_n(n, eta2)
+    eta_hat, s_t = _ni_stream(
+        stream(key, "ni_subg/lap_x"), stream(key, "ni_subg/lap_y"),
+        chunk_fn, lambda v: clip_sym(v, lam1), lambda v: clip_sym(v, lam2),
+        m, k, 2.0 * lam1 / (m * eps1), 2.0 * lam2 / (m * eps2), n_chunk)
+    rho_hat = eta_hat  # no sine link (ver-cor-subG.R:51-52)
+    se = s_t / jnp.sqrt(float(k))
+    crit = ndtri(1.0 - alpha / 2.0)
+    lo = jnp.maximum(rho_hat - crit * se, -1.0)  # ρ-space clamp (:58-59)
+    hi = jnp.minimum(rho_hat + crit * se, 1.0)
+    return CorrResult(rho_hat, lo, hi)
+
+
+# ----------------------------------------------------------- INT sign ----
+def ci_int_signflip_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
+                           eps1: float, eps2: float, alpha: float = 0.05,
+                           mode: str = "auto", normalise: bool = True,
+                           mixquant_mode: str = "det",
+                           n_chunk: int = 65536) -> CorrResult:
+    """Streaming INT sign-flip ≡ :func:`ci_int_signflip`
+    (vert-cor.R:260-317): Σ core accumulated per chunk, per-sample flips
+    from per-chunk folded keys, CI via the shared interval constructor."""
+    if normalise:
+        sx, sy = _standardizers(key, chunk_fn, n, n_chunk, eps1, eps2, "int_sign")
+    else:
+        sx = sy = lambda v: v
+
+    eps_s, eps_r = max(eps1, eps2), min(eps1, eps2)  # vert-cor.R:170-172
+    e_s = math.exp(eps_s)
+    p_keep = e_s / (e_s + 1.0)
+    est_key = stream(key, "int_sign/est")
+    flip_base = stream(est_key, "int_sign/flips")
+    n_chunks = -(-n // n_chunk)
+
+    def chunk_stats(c):
+        xy = chunk_fn(c)
+        s = jax.random.bernoulli(jax.random.fold_in(flip_base, c), p_keep,
+                                 (n_chunk,))
+        core = ((2.0 * s.astype(jnp.float32) - 1.0)
+                * jnp.sign(sx(xy[:, 0])) * jnp.sign(sy(xy[:, 1])))
+        w = (c * n_chunk + jnp.arange(n_chunk)) < n
+        return jnp.sum(jnp.where(w, core, 0.0))
+
+    sum_core = jnp.sum(jax.lax.map(chunk_stats, jnp.arange(n_chunks)))
+    scale_z = 2.0 * (e_s + 1.0) / (n * (e_s - 1.0) * eps_r)
+    z = laplace(stream(est_key, "int_sign/lap_z"), (), scale_z)
+    eta_hat = (e_s + 1.0) / (n * (e_s - 1.0)) * sum_core + z
+    rho_hat = jnp.sin(jnp.pi * eta_hat / 2.0)
+    return int_sign.interval_from_rho(key, rho_hat, n, eps_s, eps_r, alpha,
+                                      mode, mixquant_mode)
+
+
+# ----------------------------------------------------------- INT subG ----
+def ci_int_subg_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
+                       eps1: float, eps2: float,
+                       eta1: float = 1.0, eta2: float = 1.0,
+                       alpha: float = 0.05, mixquant_mode: str = "det",
+                       n_chunk: int = 65536) -> CorrResult:
+    """Streaming INT clipped (grid variant) ≡ ``ci_int_subg(variant="grid")``
+    (ver-cor-subG.R:67-108): Σ Uc, Σ Uc² accumulated per chunk; per-sample
+    sender noise from per-chunk folded keys; one central draw at the
+    materialized key address."""
+    sender_is_x = eps1 >= eps2  # ver-cor-subG.R:76-81
+    eps_s, eps_r = (eps1, eps2) if sender_is_x else (eps2, eps1)
+    eta_s, eta_r = (eta1, eta2) if sender_is_x else (eta2, eta1)
+    lam_s, lam_r = lambda_int_n(n, eta_s=eta_s, eta_r=eta_r, eps_s=eps_s)
+
+    noise_base = stream(key, "int_subg/lap_sender")
+    n_chunks = -(-n // n_chunk)
+
+    def chunk_stats(c):
+        xy = chunk_fn(c)
+        xs = xy[:, 0] if sender_is_x else xy[:, 1]
+        xo = xy[:, 1] if sender_is_x else xy[:, 0]  # v1: other NOT clipped
+        noise = laplace(jax.random.fold_in(noise_base, c), (n_chunk,),
+                        2.0 * lam_s / eps_s)
+        uc = clip_sym((clip_sym(xs, lam_s) + noise) * xo, lam_r)
+        w = (c * n_chunk + jnp.arange(n_chunk)) < n
+        uc = jnp.where(w, uc, 0.0)
+        return jnp.sum(uc), jnp.sum(uc * uc)
+
+    s1c, s2c = jax.lax.map(chunk_stats, jnp.arange(n_chunks))
+    s1, s2 = jnp.sum(s1c), jnp.sum(s2c)
+    mean_uc = s1 / n
+    central_scale = 2.0 * lam_r / (n * eps_r)
+    rho_hat = mean_uc + laplace(stream(key, "int_subg/lap_recv"), (),
+                                central_scale)
+    var_uc = jnp.maximum((s2 - n * mean_uc * mean_uc) / (n - 1), 0.0)
+    return int_subg.grid_interval(key, rho_hat, jnp.sqrt(var_uc), n, eps_r,
+                                  central_scale, alpha, mixquant_mode)

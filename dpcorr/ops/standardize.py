@@ -22,25 +22,34 @@ from dpcorr.ops.noise import clip, clip_sym, laplace
 from dpcorr.utils.rng import stream
 
 
-def priv_standardize(key: jax.Array, vec: jax.Array, eps_norm, l_raw=6.0,
-                     var_floor=1e-12) -> jax.Array:
-    """DP center–scale with a single pre-clip (vert-cor.R:322-348).
+def priv_moments_from_sums(key: jax.Array, s1, s2, n: int, eps_norm, l_raw,
+                           var_floor=1e-12):
+    """(μ_priv, var_priv) from Σ clip(x) and Σ clip(x)² — the noise half of
+    ``priv_standardize`` (vert-cor.R:337-343): split ε in half; DP mean
+    (sensitivity 2L/n) and DP second moment (sensitivity 2L²/n) via one
+    Laplace draw each; variance floored at ``var_floor``.
 
-    Clip at ±l_raw; split ε in half; DP mean (sensitivity 2L/n) and DP
-    second moment (sensitivity 2L²/n) via one Laplace draw each; variance
-    floored at ``var_floor`` (vert-cor.R:343); standardize without further
-    clipping.
+    Shared by the materialized and streaming standardization paths so noise
+    scales and key addresses can never diverge between them.
     """
-    n = vec.shape[0]
-    x = clip_sym(vec, l_raw)
     eps_half = eps_norm / 2.0
     # streams are namespaced per primitive so two different primitives
     # handed the same key never draw correlated noise
-    mu_priv = jnp.mean(x) + laplace(stream(key, "priv_standardize/mu"), (),
-                                    2.0 * l_raw / (n * eps_half))
-    m2_priv = jnp.mean(x * x) + laplace(stream(key, "priv_standardize/m2"), (),
-                                        2.0 * l_raw * l_raw / (n * eps_half))
-    var_priv = jnp.maximum(m2_priv - mu_priv * mu_priv, var_floor)
+    mu_priv = s1 / n + laplace(stream(key, "priv_standardize/mu"), (),
+                               2.0 * l_raw / (n * eps_half))
+    m2_priv = s2 / n + laplace(stream(key, "priv_standardize/m2"), (),
+                               2.0 * l_raw * l_raw / (n * eps_half))
+    return mu_priv, jnp.maximum(m2_priv - mu_priv * mu_priv, var_floor)
+
+
+def priv_standardize(key: jax.Array, vec: jax.Array, eps_norm, l_raw=6.0,
+                     var_floor=1e-12) -> jax.Array:
+    """DP center–scale with a single pre-clip (vert-cor.R:322-348):
+    clip at ±l_raw, private moments, standardize without further clipping."""
+    n = vec.shape[0]
+    x = clip_sym(vec, l_raw)
+    mu_priv, var_priv = priv_moments_from_sums(
+        key, jnp.sum(x), jnp.sum(x * x), n, eps_norm, l_raw, var_floor)
     return (x - mu_priv) / jnp.sqrt(var_priv)
 
 

@@ -57,6 +57,10 @@ class SimConfig:
     mixquant_mode: str = "det"
     seed: int = rng.MASTER_SEED
     chunk_size: int = 4096  # max replications resident in HBM at once
+    #: if set, run the streaming (n-blocked) estimators with ~this many rows
+    #: resident per replication — the stress-scale path for n ≥ ~10⁵
+    #: (BASELINE.md config 5; SURVEY.md §5 long-context analogue)
+    stream_n_chunk: int | None = None
 
     def __post_init__(self):
         # The config is a static jit argument, so it must be hashable:
@@ -87,6 +91,10 @@ def _one_rep(key: jax.Array, rho: jax.Array, cfg: SimConfig) -> tuple:
     traced (not baked into the compilation cache) so one compiled kernel
     serves a whole ρ-sweep at fixed (n, ε) — the grid's shape bucket.
     """
+    if cfg.stream_n_chunk:
+        ni, it = _one_rep_streaming(key, rho, cfg)
+        return _metrics_row(ni, it, rho)
+
     xy = cfg.dgp_fn()(rng.stream(key, "dgp"), cfg.n, rho)
     x, y = xy[:, 0], xy[:, 1]
 
@@ -104,6 +112,12 @@ def _one_rep(key: jax.Array, rho: jax.Array, cfg: SimConfig) -> tuple:
                              normalise=cfg.normalise,
                              mixquant_mode=cfg.mixquant_mode)
 
+    return _metrics_row(ni, it, rho)
+
+
+def _metrics_row(ni, it, rho) -> tuple:
+    """Per-rep metrics in DETAIL_FIELDS order (vert-cor.R:401-417)."""
+
     def metrics(r):
         cover = ((rho >= r.ci_low) & (rho <= r.ci_high)).astype(jnp.float32)
         return (r.rho_hat - rho) ** 2, cover, r.ci_high - r.ci_low
@@ -113,6 +127,37 @@ def _one_rep(key: jax.Array, rho: jax.Array, cfg: SimConfig) -> tuple:
     return (ni.rho_hat, it.rho_hat, ni_se2, int_se2,
             ni.ci_low, ni.ci_high, it.ci_low, it.ci_high,
             ni_cover, int_cover, ni_len, int_len)
+
+
+def _one_rep_streaming(key: jax.Array, rho: jax.Array, cfg: SimConfig):
+    """Streaming replication body: the same generate → estimate pipeline
+    with the n axis blocked into ``cfg.stream_n_chunk``-row chunks that are
+    regenerated from folded keys instead of held in HBM (stress path,
+    BASELINE.md config 5)."""
+    from dpcorr.models.estimators import streaming as st
+    from dpcorr.models.estimators.common import batch_geometry
+
+    m, _ = batch_geometry(cfg.n, cfg.eps1, cfg.eps2)
+    n_chunk = st.choose_n_chunk(cfg.n, m, cfg.stream_n_chunk)
+    chunk_fn = st.dgp_chunk_fn(cfg.dgp_fn(), rng.stream(key, "dgp"),
+                               n_chunk, rho)
+    if cfg.use_subg:
+        ni = st.correlation_ni_subg_stream(
+            rng.stream(key, "ni"), chunk_fn, cfg.n, cfg.eps1, cfg.eps2,
+            alpha=cfg.alpha, n_chunk=n_chunk)
+        it = st.ci_int_subg_stream(
+            rng.stream(key, "int"), chunk_fn, cfg.n, cfg.eps1, cfg.eps2,
+            alpha=cfg.alpha, mixquant_mode=cfg.mixquant_mode,
+            n_chunk=n_chunk)
+    else:
+        ni = st.ci_ni_signbatch_stream(
+            rng.stream(key, "ni"), chunk_fn, cfg.n, cfg.eps1, cfg.eps2,
+            alpha=cfg.alpha, normalise=cfg.normalise, n_chunk=n_chunk)
+        it = st.ci_int_signflip_stream(
+            rng.stream(key, "int"), chunk_fn, cfg.n, cfg.eps1, cfg.eps2,
+            alpha=cfg.alpha, mode=cfg.ci_mode, normalise=cfg.normalise,
+            mixquant_mode=cfg.mixquant_mode, n_chunk=n_chunk)
+    return ni, it
 
 
 def chunked_vmap(fn: Callable, keys: jax.Array, chunk_size: int):

@@ -272,3 +272,69 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
     summ.attrs["runs"] = runs_df
     summ.attrs["rho_np"] = std.rho_np
     return summ
+
+
+# -------------------------------------------------------------- bootstrap ----
+@partial(jax.jit, static_argnums=(2, 7, 8, 9))
+def _bootstrap_kernel(keys, arrays, eps: float, lam_age, lam_bmi, lam_recv,
+                      delta, alpha: float, mixquant_mode: str, chunk: int):
+    """Row-resampled replications of both estimators at one ε as a chunked
+    vmapped kernel: per rep, a with-replacement resample of the standardized
+    rows (gathered on device), then the NI + INT pipeline on the resample.
+
+    This is the uncertainty quantification the reference *lacks* (its sweep
+    replicates only the DP noise on fixed data, real-data-sims.R:342-448);
+    BASELINE.md config 4 asks for 10k of these.
+    """
+    from dpcorr.sim import chunked_vmap
+
+    age_z, bmi_z = arrays
+    n = age_z.shape[0]
+
+    def one(k):
+        idx = jax.random.choice(rng.stream(k, "hrs/boot/idx"), n, (n,),
+                                replace=True)
+        a, b = age_z[idx], bmi_z[idx]
+        ni = _ni_once(rng.stream(k, "hrs/boot/ni"), a, b, eps, lam_age,
+                      lam_bmi, alpha)
+        it = _int_once(rng.stream(k, "hrs/boot/int"), a, b, eps, lam_age,
+                       lam_bmi, lam_recv, delta, alpha, mixquant_mode)
+        return (ni.rho_hat, ni.ci_low, ni.ci_high,
+                it.rho_hat, it.ci_low, it.ci_high)
+
+    return chunked_vmap(one, keys, chunk)
+
+
+def bootstrap(cfg: HrsConfig = HrsConfig(), cols=None, reps: int = 10_000,
+              eps: float | None = None, chunk: int = 64) -> pd.DataFrame:
+    """``reps`` bootstrap replications (row resampling + fresh DP noise) of
+    the headline HRS estimates at privacy ``eps`` (default ε_corr).
+
+    Returns the per-rep frame; summary quantiles in ``.attrs["summary"]``.
+    """
+    cols = load_panel(cfg.panel_path) if cols is None else cols
+    _, age, bmi = extract_wave(cols, cfg.wave)
+    std = standardize(age, bmi, cfg)
+    n = int(age.shape[0])
+    eps = cfg.eps_corr if eps is None else float(eps)
+    delta = 1.0 / n
+    lam_recv = float(lambda_receiver_from_noise(std.lam_age, std.lam_bmi,
+                                                eps, delta))
+    keys = rng.rep_keys(rng.stream(rng.master_key(cfg.seed), "hrs/boot"), reps)
+    out = jax.tree.map(np.asarray, _bootstrap_kernel(
+        keys, (std.age_z, std.bmi_z), eps, std.lam_age, std.lam_bmi,
+        lam_recv, delta, cfg.alpha, cfg.mixquant_mode, chunk))
+    df = pd.DataFrame(dict(zip(
+        ("ni_hat", "ni_low", "ni_high", "int_hat", "int_low", "int_high"),
+        out, strict=True)))
+    df.attrs["rho_np"] = std.rho_np
+    df.attrs["summary"] = {
+        meth: {
+            "mean": float(df[f"{meth}_hat"].mean()),
+            "sd": float(df[f"{meth}_hat"].std(ddof=1)),
+            "q025": float(df[f"{meth}_hat"].quantile(0.025)),
+            "q975": float(df[f"{meth}_hat"].quantile(0.975)),
+        }
+        for meth in ("ni", "int")
+    }
+    return df

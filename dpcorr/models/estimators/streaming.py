@@ -89,6 +89,19 @@ def dgp_chunk_fn(dgp_fn: Callable, key: jax.Array, n_chunk: int, rho) -> ChunkFn
 
 
 # ------------------------------------------------------------ pass A ----
+def clipped_moment_sums(chunk_fn: ChunkFn, n: int, n_chunk: int,
+                        l_raw=None):
+    """Public pass-A entry: the (Σ clip, Σ clip²) sums both sign estimators
+    standardize from. Compute once per replication and pass to both via
+    ``moment_sums=`` — the sums depend only on the data, not the estimator
+    (each still draws its own standardization noise, as the reference's
+    separate ``priv_standardize`` calls do, vert-cor.R:211-215, 268-273).
+    Default clip is the call sites' L = √(2·log n) (vert-cor.R:212, 269)."""
+    if l_raw is None:
+        l_raw = math.sqrt(2.0 * math.log(n))
+    return _clipped_moment_sums(chunk_fn, n, n_chunk, l_raw)
+
+
 def _clipped_moment_sums(chunk_fn: ChunkFn, n: int, n_chunk: int, l_raw):
     """Σ clip(·, ±l_raw) and Σ clip(·)² per column over the first n rows —
     the sufficient statistics of ``priv_standardize`` (vert-cor.R:334-341)."""
@@ -114,11 +127,12 @@ def _priv_moments(std_key: jax.Array, s1, s2, n: int, eps_norm, l_raw):
 
 
 def _standardizers(key: jax.Array, chunk_fn: ChunkFn, n: int, n_chunk: int,
-                   eps1, eps2, ns: str):
+                   eps1, eps2, ns: str, sums=None):
     """Pass A + per-column transforms (clip → center → scale), matching
     ``priv_standardize`` with clip L = √(2·log n) (vert-cor.R:212, 269)."""
     l_clip = math.sqrt(2.0 * math.log(n))
-    s1, s2 = _clipped_moment_sums(chunk_fn, n, n_chunk, l_clip)
+    s1, s2 = (_clipped_moment_sums(chunk_fn, n, n_chunk, l_clip)
+              if sums is None else sums)
     mu_x, inv_x = _priv_moments(stream(key, f"{ns}/std_x"), s1[0], s2[0],
                                 n, eps1, l_clip)
     mu_y, inv_y = _priv_moments(stream(key, f"{ns}/std_y"), s1[1], s2[1],
@@ -167,7 +181,8 @@ def _ni_stream(key_x: jax.Array, key_y: jax.Array, chunk_fn: ChunkFn,
 def ci_ni_signbatch_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
                            eps1: float, eps2: float, alpha: float = 0.05,
                            normalise: bool = True,
-                           n_chunk: int = 65536) -> CorrResult:
+                           n_chunk: int = 65536,
+                           moment_sums=None) -> CorrResult:
     """Streaming NI sign-batch estimate + CI ≡ :func:`ci_ni_signbatch`
     (vert-cor.R:204-255) without materializing the n-vectors."""
     m, k = batch_geometry(n, eps1, eps2)
@@ -179,7 +194,8 @@ def ci_ni_signbatch_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
             f"n_chunk={n_chunk} must be a multiple of the batch size m={m} "
             f"(use choose_n_chunk(n, m, target))")
     if normalise:
-        sx, sy = _standardizers(key, chunk_fn, n, n_chunk, eps1, eps2, "ni_sign")
+        sx, sy = _standardizers(key, chunk_fn, n, n_chunk, eps1, eps2,
+                                "ni_sign", sums=moment_sums)
         tx = lambda v: jnp.sign(sx(v))
         ty = lambda v: jnp.sign(sy(v))
     else:
@@ -228,12 +244,14 @@ def ci_int_signflip_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
                            eps1: float, eps2: float, alpha: float = 0.05,
                            mode: str = "auto", normalise: bool = True,
                            mixquant_mode: str = "det",
-                           n_chunk: int = 65536) -> CorrResult:
+                           n_chunk: int = 65536,
+                           moment_sums=None) -> CorrResult:
     """Streaming INT sign-flip ≡ :func:`ci_int_signflip`
     (vert-cor.R:260-317): Σ core accumulated per chunk, per-sample flips
     from per-chunk folded keys, CI via the shared interval constructor."""
     if normalise:
-        sx, sy = _standardizers(key, chunk_fn, n, n_chunk, eps1, eps2, "int_sign")
+        sx, sy = _standardizers(key, chunk_fn, n, n_chunk, eps1, eps2,
+                                "int_sign", sums=moment_sums)
     else:
         sx = sy = lambda v: v
 

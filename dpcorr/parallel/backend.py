@@ -80,7 +80,9 @@ def _prep(cfg: SimConfig, key, mesh: Mesh):
     if key is None:
         key = rng.master_key(cfg.seed)
     keys = rng.rep_keys(key, b_pad)
-    cfg_norho = dataclasses.replace(cfg, rho=0.0)
+    # seed is host-side-only (key derivation), so drop it from the
+    # compiled-kernel cache key along with rho (see sim._run_detail)
+    cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
     return cfg_norho, keys, b_pad
 
 

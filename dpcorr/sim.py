@@ -52,6 +52,10 @@ class SimConfig:
     dgp: str | Callable = "gaussian"
     dgp_args: Any = ()
     use_subg: bool = False
+    #: sub-Gaussian norm parameters feeding the λ_n clip rules
+    #: (ver-cor-subG.R:28-31); ignored by the sign estimators
+    eta1: float = 1.0
+    eta2: float = 1.0
     ci_mode: str = "auto"
     normalise: bool = True
     mixquant_mode: str = "det"
@@ -100,8 +104,10 @@ def _one_rep(key: jax.Array, rho: jax.Array, cfg: SimConfig) -> tuple:
 
     if cfg.use_subg:
         ni = correlation_ni_subg(rng.stream(key, "ni"), x, y, cfg.eps1,
-                                 cfg.eps2, alpha=cfg.alpha)
+                                 cfg.eps2, eta1=cfg.eta1, eta2=cfg.eta2,
+                                 alpha=cfg.alpha)
         it = ci_int_subg(rng.stream(key, "int"), x, y, cfg.eps1, cfg.eps2,
+                         eta1=cfg.eta1, eta2=cfg.eta2,
                          alpha=cfg.alpha, variant="grid",
                          mixquant_mode=cfg.mixquant_mode)
     else:
@@ -144,19 +150,25 @@ def _one_rep_streaming(key: jax.Array, rho: jax.Array, cfg: SimConfig):
     if cfg.use_subg:
         ni = st.correlation_ni_subg_stream(
             rng.stream(key, "ni"), chunk_fn, cfg.n, cfg.eps1, cfg.eps2,
-            alpha=cfg.alpha, n_chunk=n_chunk)
+            eta1=cfg.eta1, eta2=cfg.eta2, alpha=cfg.alpha, n_chunk=n_chunk)
         it = st.ci_int_subg_stream(
             rng.stream(key, "int"), chunk_fn, cfg.n, cfg.eps1, cfg.eps2,
-            alpha=cfg.alpha, mixquant_mode=cfg.mixquant_mode,
-            n_chunk=n_chunk)
+            eta1=cfg.eta1, eta2=cfg.eta2, alpha=cfg.alpha,
+            mixquant_mode=cfg.mixquant_mode, n_chunk=n_chunk)
     else:
+        # pass A depends only on the data — compute once, share across both
+        # estimators (each still draws its own standardization noise)
+        sums = (st.clipped_moment_sums(chunk_fn, cfg.n, n_chunk)
+                if cfg.normalise else None)
         ni = st.ci_ni_signbatch_stream(
             rng.stream(key, "ni"), chunk_fn, cfg.n, cfg.eps1, cfg.eps2,
-            alpha=cfg.alpha, normalise=cfg.normalise, n_chunk=n_chunk)
+            alpha=cfg.alpha, normalise=cfg.normalise, n_chunk=n_chunk,
+            moment_sums=sums)
         it = st.ci_int_signflip_stream(
             rng.stream(key, "int"), chunk_fn, cfg.n, cfg.eps1, cfg.eps2,
             alpha=cfg.alpha, mode=cfg.ci_mode, normalise=cfg.normalise,
-            mixquant_mode=cfg.mixquant_mode, n_chunk=n_chunk)
+            mixquant_mode=cfg.mixquant_mode, n_chunk=n_chunk,
+            moment_sums=sums)
     return ni, it
 
 
@@ -184,8 +196,10 @@ def _run_detail_core(cfg: SimConfig, key: jax.Array, rho: jax.Array):
 
 
 def _run_detail(cfg: SimConfig, key: jax.Array):
-    # Normalize rho out of the static cache key; pass it traced.
-    cfg_norho = dataclasses.replace(cfg, rho=0.0)
+    # Normalize rho (traced instead) and seed (host-side only: it feeds the
+    # key derivation, never the kernel) out of the static cache key, so a
+    # ρ-sweep / reseeded rerun reuses one compiled kernel.
+    cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
     return _run_detail_core(cfg_norho, key, jnp.float32(cfg.rho))
 
 

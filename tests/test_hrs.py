@@ -78,3 +78,20 @@ def test_eps_sweep_behavior(cols):
         assert abs(s.rho_hat_mean[2.0] - summ.attrs["rho_np"]) < 0.1
     runs = summ.attrs["runs"]
     assert len(runs) == 2 * 2 * 24
+
+
+def test_bootstrap(cols):
+    """Row-resampled bootstrap (BASELINE.md config 4): estimates center on
+    the non-private baseline and the bootstrap percentile interval covers
+    it; deterministic per seed."""
+    df = hrs.bootstrap(cols=cols, reps=48, chunk=16)
+    assert len(df) == 48
+    s = df.attrs["summary"]
+    rho_np = df.attrs["rho_np"]
+    for meth in ("ni", "int"):
+        assert abs(s[meth]["mean"] - rho_np) < 0.15
+        assert s[meth]["q025"] <= rho_np + 0.05
+        assert s[meth]["q975"] >= rho_np - 0.05
+        assert s[meth]["sd"] > 0.0
+    df2 = hrs.bootstrap(cols=cols, reps=48, chunk=16)
+    assert np.allclose(df.ni_hat, df2.ni_hat)

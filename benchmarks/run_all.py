@@ -37,14 +37,15 @@ def _emit(config: int, metric: str, value, unit: str, detail: dict):
                       "detail": detail}), flush=True)
 
 
-def _timed_sim(cfg, warm_cfg=None):
-    """Run one design point twice (compile pass with a shifted seed, then
-    timed) and return (result, steady seconds)."""
+def _timed_sim(cfg):
+    """Run one design point twice (compile pass with a shifted seed — seed
+    is outside the jit cache key — then timed) and return (result, steady
+    seconds)."""
     import dataclasses
 
     from dpcorr.sim import run_sim_one
 
-    run_sim_one(dataclasses.replace(warm_cfg or cfg, seed=cfg.seed + 1))
+    run_sim_one(dataclasses.replace(cfg, seed=cfg.seed + 1))
     t0 = time.perf_counter()
     res = run_sim_one(cfg)
     return res, time.perf_counter() - t0
@@ -91,16 +92,26 @@ def config3(full: bool, b_override=None):
     summaries = {}
     t0 = time.perf_counter()
     rows = 0
+    steady = []
     for dgp in ("gaussian", "bernoulli"):
         gcfg = GridConfig(n_grid=(1000, 10_000), dgp=dgp, b=b)
         res = run_grid(gcfg)
         rows += len(res.detail_all)
         cov = res.summ_all.groupby("method")["coverage"].mean()
         summaries[dgp] = {m: round(float(c), 4) for m, c in cov.items()}
+        steady.append(res.timings["reps_per_sec"])
     dt = time.perf_counter() - t0
-    _emit(3, "full_grid_2dgp_reps_per_sec", rows / dt, "reps/sec", {
+    import pandas as pd
+
+    # kernels compile once per (n, ε, dgp) bucket — 12 of the 96 points pay
+    # compile; the median per-point rate is the steady-state number
+    # comparable to the other configs, the wall-clock covers everything
+    steady_rps = float(pd.concat(steady).median())
+    _emit(3, "full_grid_2dgp_reps_per_sec", steady_rps, "reps/sec", {
         "design_points": 2 * 2 * 8 * 3, "b": b, "replicate_rows": rows,
-        "seconds": round(dt, 2), "mean_coverage": summaries,
+        "wall_seconds_incl_compile": round(dt, 2),
+        "wall_reps_per_sec": round(rows / dt, 1),
+        "mean_coverage": summaries,
     })
 
 

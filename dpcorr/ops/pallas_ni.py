@@ -1,0 +1,245 @@
+"""Pallas TPU kernel: one fully-fused NI sign-batch replication.
+
+The bench hot loop (vert-cor.R:392-419 → ``bench.py``) is, per replication:
+generate an (n, 2) Gaussian pair, privately standardize, sign-batch
+estimate (SURVEY.md §2.2-A). The XLA path materializes the n-vectors
+between fusion boundaries and burns most of its time in the counter-based
+threefry PRNG. This kernel runs the whole replication inside VMEM on one
+grid step:
+
+- **on-chip PRNG** (``pltpu.prng_random_bits``, the TPU hardware generator)
+  seeded per replication from an SMEM scalar; Gaussians via Box–Muller,
+  Laplace via the reference's own inverse-CDF (real-data-sims.R:58-61);
+- **DP standardization** (vert-cor.R:322-348) from masked in-register
+  moment sums;
+- **sign batch sums as an MXU matmul** against a static 0/1 block-
+  aggregation matrix G[l, c] = 1{l//m == c} — the (k, m)-reshape-mean
+  (vert-cor.R:131-140) becomes ``signs(R,128) @ G(128,128//m)``;
+- per-batch Laplace noise, Σ T_j / Σ T_j² reduction; only the two scalars
+  (η̂, sd T) leave the chip per replication.
+
+Applicability: the Gaussian DGP with the batch size m dividing the 128-lane
+register width (the headline ε=1 config has m=8). Other shapes fall back to
+the XLA path (``use_ni_sign_pallas`` reports which). Estimates are
+distribution-identical to :func:`~dpcorr.models.estimators.ci_ni_signbatch`
+but draw from a different PRNG, so acceptance is statistical (SURVEY.md §5
+RNG), validated in ``tests/test_pallas_ni.py``.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.scipy.special import ndtri
+
+from dpcorr.models.estimators.common import CorrResult, batch_geometry
+
+LANES = 128
+_TWO_PI = 2.0 * math.pi
+
+
+def use_ni_sign_pallas(n: int, eps1: float, eps2: float) -> bool:
+    """True iff the fused kernel covers this configuration (m | 128)."""
+    m, _ = batch_geometry(n, eps1, eps2)
+    return LANES % m == 0
+
+
+def _uniform(bits):
+    """uint32 → (0, 1) float32: 24 mantissa-quality bits, never 0."""
+    return (jnp.right_shift(bits, 8).astype(jnp.float32) + 0.5) * (2.0**-24)
+
+
+def _rand_uniform(shape):
+    return _uniform(pltpu.prng_random_bits(shape))
+
+
+def _laplace_from_uniform(u, scale):
+    """Inverse-CDF Laplace(0, scale) — the reference's own sampler
+    (real-data-sims.R:58-61) on centered u−½ ∈ (−½, ½)."""
+    c = u - 0.5
+    return -scale * jnp.sign(c) * jnp.log1p(-2.0 * jnp.abs(c))
+
+
+def n_uniform_rows(n: int) -> int:
+    """Rows of (·, 128) uniforms one replication consumes (external mode):
+    u1 + u2 (rows each) + 8 standardization rows + 2·rows batch noise."""
+    rows = -(-n // LANES)
+    return 4 * rows + 8
+
+
+def _make_kernel(n: int, m: int, k: int, eps1: float, eps2: float,
+                 mu, sigma, normalise: bool, external_uniforms: bool):
+    rows = -(-n // LANES)
+    g_cols = LANES // m
+    l_clip = math.sqrt(2.0 * math.log(n))
+    scale_x = 2.0 / (m * eps1)
+    scale_y = 2.0 / (m * eps2)
+
+    def kernel(seed_ref, rho_ref, gmat_ref, *rest):
+        if external_uniforms:
+            # test mode: the interpreter stubs pltpu.prng_random_bits to
+            # zeros, so uniforms come from HBM and only the on-chip PRNG
+            # is untested off-TPU
+            u_ref, out_ref = rest
+            cursor = [0]
+
+            def take(shape):
+                r0 = cursor[0]
+                cursor[0] += shape[0]
+                return u_ref[pl.ds(r0, shape[0]), :]
+        else:
+            (out_ref,) = rest
+            pltpu.prng_seed(seed_ref[0, 0])
+
+            def take(shape):
+                return _rand_uniform(shape)
+
+        rho = rho_ref[0, 0]
+
+        # ---- generate: Box–Muller pair → 2×2 Cholesky (dgp.py:_bvn) ----
+        u1 = take((rows, LANES))
+        u2 = take((rows, LANES))
+        r = jnp.sqrt(-2.0 * jnp.log(u1))
+        z1 = r * jnp.cos(_TWO_PI * u2)
+        z2 = r * jnp.sin(_TWO_PI * u2)
+        x = mu[0] + sigma[0] * z1
+        y = mu[1] + sigma[1] * (rho * z1 + jnp.sqrt(1.0 - rho * rho) * z2)
+
+        # element mask: global index < n (padding tail of the last row)
+        eidx = (jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0) * LANES
+                + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1))
+        w = (eidx < n).astype(jnp.float32)
+
+        if normalise:
+            # priv_standardize both sides (vert-cor.R:322-348): clip, DP
+            # mean + DP 2nd moment (ε/2 each), standardize. Signs only
+            # need x − μ (σ > 0), so the division is dropped.
+            lap4 = _laplace_from_uniform(take((8, LANES)), 1.0)
+
+            def center(v, eps, mu_noise):
+                # sign((clip(v) − μ_priv)/σ_priv) = sign(clip(v) − μ_priv)
+                # since σ_priv > 0, so the DP 2nd moment (which the budget
+                # still pays for, ε/2) never needs to be materialized here
+                vc = jnp.clip(v, -l_clip, l_clip)
+                eps_half = eps / 2.0
+                mu_p = (jnp.sum(vc * w) / n
+                        + mu_noise * 2.0 * l_clip / (n * eps_half))
+                return vc - mu_p
+
+            x_c = center(x, eps1, lap4[0, 0])
+            y_c = center(y, eps2, lap4[1, 0])
+        else:
+            x_c, y_c = x, y
+
+        # ---- sign batch sums on the MXU: (rows,128) @ G(128,g_cols) ----
+        sx = jnp.sign(x_c)
+        sy = jnp.sign(y_c)
+        g = gmat_ref[:, :g_cols]
+        xb = jnp.dot(sx, g, preferred_element_type=jnp.float32) / m
+        yb = jnp.dot(sy, g, preferred_element_type=jnp.float32) / m
+
+        # ---- per-batch Laplace noise (sens 2/m, vert-cor.R:143-146) ----
+        lap_xy = _laplace_from_uniform(take((2 * rows, LANES)), 1.0)
+        xt = xb + lap_xy[:rows, :g_cols] * scale_x
+        yt = yb + lap_xy[rows:, :g_cols] * scale_y
+
+        # ---- T_j = m·X̃_j·Ỹ_j over the k real batches ----
+        bidx = (jax.lax.broadcasted_iota(jnp.int32, (rows, g_cols), 0) * g_cols
+                + jax.lax.broadcasted_iota(jnp.int32, (rows, g_cols), 1))
+        t = jnp.where(bidx < k, m * xt * yt, 0.0)
+        st = jnp.sum(t)
+        st2 = jnp.sum(t * t)
+
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+        out_ref[0, :] = jnp.where(lane == 0, st,
+                                  jnp.where(lane == 1, st2, 0.0))[0, :]
+
+    return kernel, rows, g_cols
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
+def _ni_sign_pallas_sums(seeds: jax.Array, rho: jax.Array, n: int,
+                         eps1: float, eps2: float, mu, sigma,
+                         normalise: bool, interpret: bool,
+                         uniforms: jax.Array | None = None):
+    b = seeds.shape[0]
+    m, k = batch_geometry(n, eps1, eps2)
+    external = uniforms is not None
+    kernel, rows, g_cols = _make_kernel(n, m, k, eps1, eps2,
+                                        tuple(mu), tuple(sigma), normalise,
+                                        external)
+    # static 0/1 aggregation matrix: lane l feeds batch column l // m
+    gmat = jnp.asarray(
+        (np.arange(LANES)[:, None] // m) == np.arange(LANES)[None, :],
+        jnp.float32)  # padded to (128, 128); kernel slices [:, :g_cols]
+
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((LANES, LANES), lambda i: (0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    inputs = [seeds.reshape(b, 1), rho.reshape(1, 1), gmat]
+    if external:
+        u_rows = n_uniform_rows(n)
+        in_specs.append(pl.BlockSpec((u_rows, LANES), lambda i: (i, 0),
+                                     memory_space=pltpu.VMEM))
+        inputs.append(uniforms.reshape(b * u_rows, LANES))
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, LANES), jnp.float32),
+        # TPU interpret mode runs the kernel on CPU (pltpu.prng_* stubs
+        # return zeros there — external uniforms cover testing)
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(*inputs)
+    return out[:, 0], out[:, 1]
+
+
+def ni_sign_pallas(seeds: jax.Array, rho, n: int, eps1: float, eps2: float,
+                   mu=(0.0, 0.0), sigma=(1.0, 1.0), alpha: float = 0.05,
+                   normalise: bool = True,
+                   interpret: bool | None = None,
+                   uniforms: jax.Array | None = None) -> CorrResult:
+    """Fused generate+estimate for a whole replication batch.
+
+    ``seeds``: (B,) int32 per-replication PRNG seeds. Returns the batched
+    :class:`CorrResult` with the same CI construction as
+    ``ci_ni_signbatch`` (η-space clamp then sine map, vert-cor.R:249-254).
+
+    ``uniforms``: optional (B, n_uniform_rows(n), 128) external uniforms in
+    (0, 1) replacing the on-chip PRNG — the CPU-testable path.
+    """
+    m, k = batch_geometry(n, eps1, eps2)
+    if LANES % m:
+        raise ValueError(
+            f"fused kernel needs m | {LANES}, got m={m}; use the XLA path "
+            f"(see use_ni_sign_pallas)")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if interpret and uniforms is None:
+        raise ValueError(
+            "on-chip PRNG is only live on real TPU (the interpreter stubs "
+            "pltpu.prng_random_bits to zeros) — pass `uniforms` with shape "
+            f"(B, {n_uniform_rows(n)}, {LANES}) off-TPU")
+    st, st2 = _ni_sign_pallas_sums(
+        jnp.asarray(seeds, jnp.int32), jnp.float32(rho), n, eps1, eps2,
+        tuple(mu), tuple(sigma), normalise, interpret, uniforms=uniforms)
+
+    eta_hat = st / k
+    var_t = jnp.maximum((st2 - k * eta_hat * eta_hat) / (k - 1), 0.0)
+    rho_hat = jnp.sin(jnp.pi * eta_hat / 2.0)
+    half = ndtri(1.0 - alpha / 2.0) * jnp.sqrt(var_t) / math.sqrt(k)
+    lo = jnp.sin(jnp.pi / 2.0 * jnp.maximum(eta_hat - half, -1.0))
+    hi = jnp.sin(jnp.pi / 2.0 * jnp.minimum(eta_hat + half, 1.0))
+    return CorrResult(rho_hat, lo, hi)

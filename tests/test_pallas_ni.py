@@ -1,0 +1,80 @@
+"""Fused Pallas NI sign-batch kernel vs the XLA estimator.
+
+Off-TPU the kernel runs under the TPU interpreter, whose pltpu.prng_* stubs
+return zeros — so these tests drive the external-uniforms path, which
+exercises everything except the on-chip PRNG (validated on real TPU by the
+bench). Acceptance is statistical (different PRNG stream ⇒ no bitwise
+comparison; SURVEY.md §5 RNG).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpcorr.ops.pallas_ni import (
+    n_uniform_rows,
+    ni_sign_pallas,
+    use_ni_sign_pallas,
+)
+from dpcorr.sim import SimConfig, run_sim_one
+from dpcorr.utils import rng
+
+N, B, RHO = 1024, 512, 0.5
+
+
+def _uniforms(key, n, b):
+    return jax.random.uniform(key, (b, n_uniform_rows(n), 128), jnp.float32,
+                              minval=1e-7, maxval=1.0 - 1e-7)
+
+
+@pytest.fixture(scope="module")
+def pallas_result():
+    u = _uniforms(rng.master_key(3), N, B)
+    return ni_sign_pallas(np.arange(B, dtype=np.int32), RHO, N, 1.0, 1.0,
+                          uniforms=u)
+
+
+def test_applicability():
+    assert use_ni_sign_pallas(10_000, 1.0, 1.0)  # m=8 | 128
+    assert not use_ni_sign_pallas(10_000, 1.5, 0.5)  # m=11
+    with pytest.raises(ValueError, match="m \\| 128"):
+        ni_sign_pallas(np.arange(4, dtype=np.int32), 0.5, 1000, 1.5, 0.5)
+
+
+def test_statistics_match_xla(pallas_result):
+    """Mean/MSE/coverage agree with the XLA estimator within MC error."""
+    r = np.asarray(pallas_result.rho_hat)
+    cover = np.mean((RHO >= np.asarray(pallas_result.ci_low))
+                    & (RHO <= np.asarray(pallas_result.ci_high)))
+    xla = run_sim_one(SimConfig(n=N, rho=RHO, eps1=1.0, eps2=1.0,
+                                b=B)).summary["NI"]
+    assert abs(r.mean() - RHO - xla["bias"]) < 0.03
+    assert abs(cover - xla["coverage"]) < 0.05
+    mse = ((r - RHO) ** 2).mean()
+    assert 0.5 < mse / xla["mse"] < 2.0
+
+
+def test_ci_ordering_and_range(pallas_result):
+    lo, hi = (np.asarray(pallas_result.ci_low),
+              np.asarray(pallas_result.ci_high))
+    assert (lo <= hi).all()
+    assert (lo >= -1.0).all() and (hi <= 1.0).all()
+
+
+def test_deterministic_in_uniforms():
+    u = _uniforms(rng.master_key(9), N, 64)
+    seeds = np.arange(64, dtype=np.int32)
+    a = ni_sign_pallas(seeds, RHO, N, 1.0, 1.0, uniforms=u)
+    b = ni_sign_pallas(seeds, RHO, N, 1.0, 1.0, uniforms=u)
+    np.testing.assert_array_equal(np.asarray(a.rho_hat),
+                                  np.asarray(b.rho_hat))
+
+
+def test_unnormalised_path():
+    u = _uniforms(rng.master_key(5), N, 256)
+    res = ni_sign_pallas(np.arange(256, dtype=np.int32), RHO, N, 1.0, 1.0,
+                         normalise=False, uniforms=u)
+    r = np.asarray(res.rho_hat)
+    # data is already standard here, so estimates still center on ρ
+    assert abs(r.mean() - RHO) < 0.05

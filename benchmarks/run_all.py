@@ -94,7 +94,8 @@ def config3(full: bool, b_override=None):
     rows = 0
     steady = []
     for dgp in ("gaussian", "bernoulli"):
-        gcfg = GridConfig(n_grid=(1000, 10_000), dgp=dgp, b=b)
+        gcfg = GridConfig(n_grid=(1000, 10_000), dgp=dgp, b=b,
+                          backend="bucketed")
         res = run_grid(gcfg)
         rows += len(res.detail_all)
         cov = res.summ_all.groupby("method")["coverage"].mean()

@@ -26,7 +26,7 @@ def _add_common(p):
     p.add_argument("--b", type=int, default=None, help="MC replications")
     p.add_argument("--seed", type=int, default=2025)
     p.add_argument("--backend", default="local",
-                   choices=["local", "sharded"])
+                   choices=["local", "sharded", "bucketed"])
 
 
 def cmd_demo(args):

@@ -172,20 +172,29 @@ def _one_rep_streaming(key: jax.Array, rho: jax.Array, cfg: SimConfig):
     return ni, it
 
 
-def chunked_vmap(fn: Callable, keys: jax.Array, chunk_size: int):
-    """``vmap(fn)`` over a key vector, blocked into ``lax.map`` chunks.
+def chunked_vmap(fn: Callable, args, chunk_size: int):
+    """``vmap(fn)`` over axis 0, blocked into ``lax.map`` chunks.
 
     Keeps at most ``chunk_size`` replications' intermediates live in HBM.
-    The key count is padded up to a chunk multiple; outputs are truncated.
+    ``args`` is one array (→ ``fn(x)``) or a tuple of same-length arrays
+    mapped together (→ ``fn(*xs)``, e.g. per-element (key, ρ) pairs for the
+    bucketed grid). The axis is padded up to a chunk multiple; outputs are
+    truncated back.
     """
-    b = keys.shape[0]
+    is_tuple = isinstance(args, tuple)
+    tree = args if is_tuple else (args,)
+    b = jax.tree.leaves(tree)[0].shape[0]
     chunk = min(chunk_size, b)
     n_chunks = -(-b // chunk)
     pad = n_chunks * chunk - b
-    if pad:
-        keys = jnp.concatenate([keys, keys[:pad]])
-    blocked = keys.reshape(n_chunks, chunk)
-    out = jax.lax.map(jax.vmap(fn), blocked)
+
+    def block(a):
+        if pad:
+            a = jnp.concatenate([a, a[:pad]])
+        return a.reshape(n_chunks, chunk, *a.shape[1:])
+
+    blocked = jax.tree.map(block, tree)
+    out = jax.lax.map(lambda t: jax.vmap(fn)(*t), blocked)
     return jax.tree.map(lambda a: a.reshape(n_chunks * chunk, *a.shape[2:])[:b], out)
 
 
@@ -193,6 +202,17 @@ def chunked_vmap(fn: Callable, keys: jax.Array, chunk_size: int):
 def _run_detail_core(cfg: SimConfig, key: jax.Array, rho: jax.Array):
     keys = rng.rep_keys(key, cfg.b)
     return chunked_vmap(lambda k: _one_rep(k, rho, cfg), keys, cfg.chunk_size)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _run_detail_flat(cfg_norho: SimConfig, keys: jax.Array, rhos: jax.Array):
+    """Batched-design-point kernel: per-element (key, ρ) pairs, flattened
+    over (points × replications) — the grid-axis vectorization used by the
+    bucketed grid backend (ρ is traced, so every design point in a
+    (n, ε)-shape bucket shares this one compiled kernel *invocation*, not
+    just its cache entry)."""
+    return chunked_vmap(lambda k, r: _one_rep(k, r, cfg_norho),
+                        (keys, rhos), cfg_norho.chunk_size)
 
 
 def _run_detail(cfg: SimConfig, key: jax.Array):

@@ -58,6 +58,26 @@ def test_sharded_backend_grid(devices):
     assert len(res.detail_all) == 4 * 24
 
 
+def test_bucketed_backend_bit_identical_to_local():
+    """The grid-axis-vectorized backend reuses the same per-point keys, so
+    every replicate value matches the local backend exactly."""
+    loc = run_grid(GridConfig(**SMALL))
+    buck = run_grid(GridConfig(**SMALL, backend="bucketed"))
+    pd.testing.assert_frame_equal(loc.detail_all, buck.detail_all)
+    assert len(buck.timings) == 2  # one row per (n, eps) bucket
+    assert (buck.timings["points"] == 2).all()
+
+
+def test_bucketed_resume_cache_interchangeable(tmp_path):
+    """Bucketed and local backends share the per-point .npz cache."""
+    gc_loc = GridConfig(**SMALL, out_dir=str(tmp_path))
+    res1 = run_grid(gc_loc)
+    gc_b = GridConfig(**SMALL, out_dir=str(tmp_path), backend="bucketed")
+    res2 = run_grid(gc_b)
+    assert (res2.timings["points_run"] == 0).all()  # all cache hits
+    pd.testing.assert_frame_equal(res1.detail_all, res2.detail_all)
+
+
 def test_unknown_backend_fails_loudly():
     with pytest.raises(RuntimeError, match="design points failed"):
         run_grid(GridConfig(**SMALL, backend="nope"))

@@ -106,6 +106,33 @@ def cmd_hrs(args):
         "NI": res.ni, "INT_age_to_bmi": res.int_}, indent=2))
 
 
+def cmd_stress(args):
+    """Stress-scale run (BASELINE.md config 5 shape): streaming n-blocked
+    estimators, optionally sharded over the device mesh; prints reps/sec."""
+    from dpcorr.sim import SimConfig, run_sim_one
+
+    cfg = SimConfig(
+        n=args.n, rho=0.5, eps1=1.0, eps2=1.0, b=args.b or 256,
+        dgp="bounded_factor" if args.family == "subg" else "gaussian",
+        use_subg=args.family == "subg",
+        stream_n_chunk=args.n_chunk,
+        chunk_size=max(2, (args.b or 256) // 8))
+    t0 = time.perf_counter()
+    if args.backend == "sharded":
+        from dpcorr.parallel import run_summary_sharded
+
+        summary = run_summary_sharded(cfg)
+    else:
+        summary = run_sim_one(cfg).summary
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "n": cfg.n, "b": cfg.b, "family": args.family,
+        "stream_n_chunk": cfg.stream_n_chunk,
+        "seconds": round(dt, 2),
+        "reps_per_sec_incl_compile": round(cfg.b / dt, 2),
+        "summary": summary}, indent=2))
+
+
 def cmd_hrs_sweep(args):
     from dpcorr import hrs, report
 
@@ -123,9 +150,16 @@ def main(argv=None):
     sub = ap.add_subparsers(dest="cmd", required=True)
     for name, fn in [("demo", cmd_demo), ("demo-subg", cmd_demo_subg),
                      ("grid", cmd_grid), ("grid-subg", cmd_grid_subg),
-                     ("hrs", cmd_hrs), ("hrs-sweep", cmd_hrs_sweep)]:
+                     ("hrs", cmd_hrs), ("hrs-sweep", cmd_hrs_sweep),
+                     ("stress", cmd_stress)]:
         p = sub.add_parser(name)
         _add_common(p)
+        if name == "stress":
+            p.add_argument("--n", type=int, default=1_000_000)
+            p.add_argument("--n-chunk", dest="n_chunk", type=int,
+                           default=65_536)
+            p.add_argument("--family", choices=["sign", "subg"],
+                           default="subg")
         p.set_defaults(fn=fn)
     args = ap.parse_args(argv)
     args.fn(args)

@@ -1,0 +1,38 @@
+"""CLI smoke tests (`python -m dpcorr …`, SURVEY.md entry points)."""
+
+import json
+
+import pytest
+
+from dpcorr.__main__ import main
+
+
+def _run_json(capsys, argv):
+    main(argv)
+    return json.loads(capsys.readouterr().out)
+
+
+def test_demo(capsys):
+    out = _run_json(capsys, ["demo", "--b", "8"])
+    assert out["config"]["n"] == 2000 and out["config"]["rho"] == -0.95
+    for meth in ("NI", "INT"):
+        assert 0.0 <= out["summary"][meth]["coverage"] <= 1.0
+
+
+def test_demo_subg(capsys):
+    out = _run_json(capsys, ["demo-subg", "--b", "8"])
+    assert out["config"]["n"] == 5500
+    assert "NI" in out["summary"]
+
+
+def test_stress(capsys):
+    out = _run_json(capsys, ["stress", "--n", "20000", "--b", "4",
+                             "--n-chunk", "4096", "--family", "sign"])
+    assert out["n"] == 20000 and out["family"] == "sign"
+    assert out["reps_per_sec_incl_compile"] > 0
+    assert 0.0 <= out["summary"]["NI"]["coverage"] <= 1.0
+
+
+def test_bad_command():
+    with pytest.raises(SystemExit):
+        main(["not-a-command"])

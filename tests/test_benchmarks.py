@@ -1,0 +1,31 @@
+"""benchmarks.run_all emits well-formed JSON lines (driver-facing)."""
+
+import json
+
+from benchmarks import run_all
+
+
+def _lines(capsys):
+    return [json.loads(ln) for ln in capsys.readouterr().out.splitlines()]
+
+
+def test_config1_emits_json(capsys):
+    run_all.config1(False, b_override=16)
+    (line,) = _lines(capsys)
+    assert line["config"] == 1
+    assert line["value"] > 0
+    assert 0.0 <= line["detail"]["ni"]["coverage"] <= 1.0
+
+
+def test_config2_emits_three_eps(capsys):
+    run_all.config2(False, b_override=16)
+    lines = _lines(capsys)
+    assert [l["detail"]["eps"] for l in lines] == [0.5, 1.0, 2.0]
+    assert all(l["config"] == 2 for l in lines)
+
+
+def test_main_rejects_unknown_config(capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        run_all.main(["--config", "9"])

@@ -13,14 +13,27 @@ grid step:
 - **DP standardization** (vert-cor.R:322-348) from masked in-register
   moment sums;
 - **sign batch sums as an MXU matmul** against a static 0/1 block-
-  aggregation matrix G[l, c] = 1{l//m == c} — the (k, m)-reshape-mean
-  (vert-cor.R:131-140) becomes ``signs(R,128) @ G(128,128//m)``;
+  aggregation matrix G[l, c] = 1{l//m' == c} — the (k, m)-reshape-mean
+  (vert-cor.R:131-140) becomes ``signs(R,128) @ G(128,128//m')``;
 - per-batch Laplace noise, Σ T_j / Σ T_j² reduction; only the two scalars
   (η̂, sd T) leave the chip per replication.
 
-Applicability: the Gaussian DGP with the batch size m dividing the 128-lane
-register width (the headline ε=1 config has m=8). Other shapes fall back to
-the XLA path (``use_ni_sign_pallas`` reports which). Estimates are
+**Batch layout (any m ≤ 128).** Lanes are grouped into k groups of
+m' = next power of two ≥ m (so m' | 128 and groups never straddle a
+register row); each group's first m lanes hold one batch's data and the
+remaining m'−m lanes are padding, masked out of both the moment sums and
+the sign matmul. The n − k·m leftover observations (which the estimator
+ignores but ``priv_standardize`` *does* consume, vert-cor.R:126 vs 322-348)
+are appended after the k groups so the DP moments see exactly n elements.
+Because every element is an iid draw generated in-kernel, assigning
+positions to batches this way is distribution-identical to the reference's
+consecutive-index batching. When m | 128 the layout degenerates to the
+dense one (m' = m, no padding).
+
+Applicability: the Gaussian DGP with m ≤ 128 and k ≥ 2 — this covers the
+whole reference ε-grid, including the (1.5, 0.5) pair's m = 11 → m' = 16
+(vert-cor.R:488-494). Other shapes fall back to the XLA path
+(``use_ni_sign_pallas`` reports which). Estimates are
 distribution-identical to :func:`~dpcorr.models.estimators.ci_ni_signbatch`
 but draw from a different PRNG, so acceptance is statistical (SURVEY.md §5
 RNG), validated in ``tests/test_pallas_ni.py``.
@@ -44,15 +57,40 @@ LANES = 128
 _TWO_PI = 2.0 * math.pi
 
 
+def _pad_m(m: int) -> int:
+    """Smallest power of two ≥ m (the lane-group width m' | 128)."""
+    return 1 << (m - 1).bit_length()
+
+
+def _layout(n: int, eps1: float, eps2: float):
+    """(m, m', k, leftover, rows) for the padded lane-group layout."""
+    m, k = batch_geometry(n, eps1, eps2)
+    m_pad = _pad_m(m)
+    leftover = n - k * m
+    rows = -(-(k * m_pad + leftover) // LANES)
+    return m, m_pad, k, leftover, rows
+
+
 def use_ni_sign_pallas(n: int, eps1: float, eps2: float) -> bool:
-    """True iff the fused kernel covers this configuration (m | 128)."""
-    m, _ = batch_geometry(n, eps1, eps2)
-    return LANES % m == 0
+    """True iff the fused kernel covers this configuration
+    (m ≤ 128 so one lane group holds a batch, and k ≥ 2 so sd(T_j) exists).
+    """
+    m, k = batch_geometry(n, eps1, eps2)
+    return m <= LANES and k >= 2
 
 
 def _uniform(bits):
-    """uint32 → (0, 1) float32: 24 mantissa-quality bits, never 0."""
-    return (jnp.right_shift(bits, 8).astype(jnp.float32) + 0.5) * (2.0**-24)
+    """random bits → strictly-interior (0, 1) float32 uniforms.
+
+    ``pltpu.prng_random_bits`` yields *int32* on TPU; a bare right-shift
+    would sign-extend and make half the draws negative (NaN under log), so
+    mask the shift result. 23 bits (not 24): with 24, the top value
+    (2²⁴−1)+0.5 rounds to 2²⁴ in float32 and the uniform becomes exactly
+    1.0 — −inf through the Laplace ``log1p``. Every 23-bit value ±0.5 is
+    exactly representable, so u ∈ [2⁻²⁴, 1−2⁻²⁴].
+    """
+    b23 = jnp.bitwise_and(jnp.right_shift(bits, 9), 0x7FFFFF)
+    return (b23.astype(jnp.float32) + 0.5) * (2.0**-23)
 
 
 def _rand_uniform(shape):
@@ -66,17 +104,18 @@ def _laplace_from_uniform(u, scale):
     return -scale * jnp.sign(c) * jnp.log1p(-2.0 * jnp.abs(c))
 
 
-def n_uniform_rows(n: int) -> int:
+def n_uniform_rows(n: int, eps1: float = 1.0, eps2: float = 1.0) -> int:
     """Rows of (·, 128) uniforms one replication consumes (external mode):
-    u1 + u2 (rows each) + 8 standardization rows + 2·rows batch noise."""
-    rows = -(-n // LANES)
+    u1 + u2 (rows each) + 8 standardization rows + 2·rows batch noise.
+    ``rows`` depends on the ε-pair through the padded lane-group layout."""
+    *_, rows = _layout(n, eps1, eps2)
     return 4 * rows + 8
 
 
-def _make_kernel(n: int, m: int, k: int, eps1: float, eps2: float,
+def _make_kernel(n: int, m: int, m_pad: int, k: int, leftover: int,
+                 rows: int, eps1: float, eps2: float,
                  mu, sigma, normalise: bool, external_uniforms: bool):
-    rows = -(-n // LANES)
-    g_cols = LANES // m
+    g_cols = LANES // m_pad
     l_clip = math.sqrt(2.0 * math.log(n))
     scale_x = 2.0 / (m * eps1)
     scale_y = 2.0 / (m * eps2)
@@ -111,10 +150,16 @@ def _make_kernel(n: int, m: int, k: int, eps1: float, eps2: float,
         x = mu[0] + sigma[0] * z1
         y = mu[1] + sigma[1] * (rho * z1 + jnp.sqrt(1.0 - rho * rho) * z2)
 
-        # element mask: global index < n (padding tail of the last row)
-        eidx = (jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0) * LANES
-                + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1))
-        w = (eidx < n).astype(jnp.float32)
+        # position masks over the padded lane-group layout: position p holds
+        # batch element (group p//m', offset p%m' < m), a leftover
+        # observation (k·m' ≤ p < k·m'+leftover), or pure padding
+        pos = (jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0) * LANES
+               + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1))
+        batch_elem = ((pos % m_pad < m) & (pos // m_pad < k))
+        in_leftover = (pos >= k * m_pad) & (pos < k * m_pad + leftover)
+        # moment mask: exactly the n real observations (vert-cor.R:322-348
+        # standardizes over all n, estimator uses the first k·m)
+        w = (batch_elem | in_leftover).astype(jnp.float32)
 
         if normalise:
             # priv_standardize both sides (vert-cor.R:322-348): clip, DP
@@ -138,8 +183,10 @@ def _make_kernel(n: int, m: int, k: int, eps1: float, eps2: float,
             x_c, y_c = x, y
 
         # ---- sign batch sums on the MXU: (rows,128) @ G(128,g_cols) ----
-        sx = jnp.sign(x_c)
-        sy = jnp.sign(y_c)
+        # padding lanes inside a group must not leak into the batch sum
+        bmask = batch_elem.astype(jnp.float32)
+        sx = jnp.sign(x_c) * bmask
+        sy = jnp.sign(y_c) * bmask
         g = gmat_ref[:, :g_cols]
         xb = jnp.dot(sx, g, preferred_element_type=jnp.float32) / m
         yb = jnp.dot(sy, g, preferred_element_type=jnp.float32) / m
@@ -160,7 +207,7 @@ def _make_kernel(n: int, m: int, k: int, eps1: float, eps2: float,
         out_ref[0, :] = jnp.where(lane == 0, st,
                                   jnp.where(lane == 1, st2, 0.0))[0, :]
 
-    return kernel, rows, g_cols
+    return kernel
 
 
 @partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
@@ -169,14 +216,13 @@ def _ni_sign_pallas_sums(seeds: jax.Array, rho: jax.Array, n: int,
                          normalise: bool, interpret: bool,
                          uniforms: jax.Array | None = None):
     b = seeds.shape[0]
-    m, k = batch_geometry(n, eps1, eps2)
+    m, m_pad, k, leftover, rows = _layout(n, eps1, eps2)
     external = uniforms is not None
-    kernel, rows, g_cols = _make_kernel(n, m, k, eps1, eps2,
-                                        tuple(mu), tuple(sigma), normalise,
-                                        external)
-    # static 0/1 aggregation matrix: lane l feeds batch column l // m
+    kernel = _make_kernel(n, m, m_pad, k, leftover, rows, eps1, eps2,
+                          tuple(mu), tuple(sigma), normalise, external)
+    # static 0/1 aggregation matrix: lane l feeds batch column l // m'
     gmat = jnp.asarray(
-        (np.arange(LANES)[:, None] // m) == np.arange(LANES)[None, :],
+        (np.arange(LANES)[:, None] // m_pad) == np.arange(LANES)[None, :],
         jnp.float32)  # padded to (128, 128); kernel slices [:, :g_cols]
 
     in_specs = [
@@ -187,7 +233,7 @@ def _ni_sign_pallas_sums(seeds: jax.Array, rho: jax.Array, n: int,
     ]
     inputs = [seeds.reshape(b, 1), rho.reshape(1, 1), gmat]
     if external:
-        u_rows = n_uniform_rows(n)
+        u_rows = n_uniform_rows(n, eps1, eps2)
         in_specs.append(pl.BlockSpec((u_rows, LANES), lambda i: (i, 0),
                                      memory_space=pltpu.VMEM))
         inputs.append(uniforms.reshape(b * u_rows, LANES))
@@ -217,21 +263,21 @@ def ni_sign_pallas(seeds: jax.Array, rho, n: int, eps1: float, eps2: float,
     :class:`CorrResult` with the same CI construction as
     ``ci_ni_signbatch`` (η-space clamp then sine map, vert-cor.R:249-254).
 
-    ``uniforms``: optional (B, n_uniform_rows(n), 128) external uniforms in
-    (0, 1) replacing the on-chip PRNG — the CPU-testable path.
+    ``uniforms``: optional (B, n_uniform_rows(n, eps1, eps2), 128) external
+    uniforms in (0, 1) replacing the on-chip PRNG — the CPU-testable path.
     """
     m, k = batch_geometry(n, eps1, eps2)
-    if LANES % m:
+    if not use_ni_sign_pallas(n, eps1, eps2):
         raise ValueError(
-            f"fused kernel needs m | {LANES}, got m={m}; use the XLA path "
-            f"(see use_ni_sign_pallas)")
+            f"fused kernel needs m <= {LANES} and k >= 2, got m={m}, k={k}; "
+            f"use the XLA path (see use_ni_sign_pallas)")
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     if interpret and uniforms is None:
         raise ValueError(
             "on-chip PRNG is only live on real TPU (the interpreter stubs "
             "pltpu.prng_random_bits to zeros) — pass `uniforms` with shape "
-            f"(B, {n_uniform_rows(n)}, {LANES}) off-TPU")
+            f"(B, {n_uniform_rows(n, eps1, eps2)}, {LANES}) off-TPU")
     st, st2 = _ni_sign_pallas_sums(
         jnp.asarray(seeds, jnp.int32), jnp.float32(rho), n, eps1, eps2,
         tuple(mu), tuple(sigma), normalise, interpret, uniforms=uniforms)

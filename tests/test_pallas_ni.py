@@ -23,9 +23,9 @@ from dpcorr.utils import rng
 N, B, RHO = 1024, 512, 0.5
 
 
-def _uniforms(key, n, b):
-    return jax.random.uniform(key, (b, n_uniform_rows(n), 128), jnp.float32,
-                              minval=1e-7, maxval=1.0 - 1e-7)
+def _uniforms(key, n, b, eps1=1.0, eps2=1.0):
+    return jax.random.uniform(key, (b, n_uniform_rows(n, eps1, eps2), 128),
+                              jnp.float32, minval=1e-7, maxval=1.0 - 1e-7)
 
 
 @pytest.fixture(scope="module")
@@ -35,11 +35,48 @@ def pallas_result():
                           uniforms=u)
 
 
+def test_uniform_bits_no_sign_extension():
+    """int32 PRNG bits with the sign bit set must still yield (0,1) uniforms
+    (the on-chip generator returns int32; a bare shift sign-extends)."""
+    from dpcorr.ops.pallas_ni import _uniform
+
+    bits = jnp.asarray([-1, -(2**31), -123456789, 0, 1, 2**31 - 1], jnp.int32)
+    u = np.asarray(_uniform(bits))
+    assert (u > 0.0).all() and (u < 1.0).all()
+    # Box–Muller log and the Laplace log1p(-2|u-1/2|) must both stay finite
+    assert np.isfinite(np.log(u)).all()
+    assert np.isfinite(np.log1p(-2.0 * np.abs(u - 0.5))).all()
+
+
 def test_applicability():
-    assert use_ni_sign_pallas(10_000, 1.0, 1.0)  # m=8 | 128
-    assert not use_ni_sign_pallas(10_000, 1.5, 0.5)  # m=11
-    with pytest.raises(ValueError, match="m \\| 128"):
-        ni_sign_pallas(np.arange(4, dtype=np.int32), 0.5, 1000, 1.5, 0.5)
+    assert use_ni_sign_pallas(10_000, 1.0, 1.0)   # m=8 (dense layout)
+    assert use_ni_sign_pallas(10_000, 1.5, 0.5)   # m=11 → m'=16 (padded)
+    assert use_ni_sign_pallas(10_000, 0.5, 0.5)   # m=32
+    assert not use_ni_sign_pallas(10_000, 0.1, 0.1)  # m=800 > 128
+    assert not use_ni_sign_pallas(40, 0.5, 0.5)      # k=1 (m capped at n)
+    with pytest.raises(ValueError, match="m <= 128"):
+        ni_sign_pallas(np.arange(4, dtype=np.int32), 0.5, 1000, 0.1, 0.1)
+
+
+def test_padded_layout_m11_statistics():
+    """ε=(1.5,0.5) ⇒ m=11, m'=16 — the reference's own awkward ε-pair
+    (vert-cor.R:488-494). Padded-lane-group layout must reproduce the XLA
+    estimator's statistics within MC error."""
+    eps1, eps2 = 1.5, 0.5
+    b = 512
+    u = _uniforms(rng.master_key(11), N, b, eps1, eps2)
+    res = ni_sign_pallas(np.arange(b, dtype=np.int32), RHO, N, eps1, eps2,
+                         uniforms=u)
+    r = np.asarray(res.rho_hat)
+    cover = np.mean((RHO >= np.asarray(res.ci_low))
+                    & (RHO <= np.asarray(res.ci_high)))
+    xla = run_sim_one(SimConfig(n=N, rho=RHO, eps1=eps1, eps2=eps2,
+                                b=b)).summary["NI"]
+    assert np.isfinite(r).all()
+    assert abs(r.mean() - RHO - xla["bias"]) < 0.06
+    assert abs(cover - xla["coverage"]) < 0.06
+    mse = ((r - RHO) ** 2).mean()
+    assert 0.5 < mse / xla["mse"] < 2.0
 
 
 def test_statistics_match_xla(pallas_result):

@@ -21,12 +21,18 @@ import json
 import time
 
 
-def _add_common(p):
+def _add_common(p, backends=("local",)):
+    """Shared flags. ``backends`` lists only the execution backends the
+    subcommand actually implements — anything else is an argparse error
+    rather than a silently-ignored flag."""
     p.add_argument("--out", default=None, help="output directory")
     p.add_argument("--b", type=int, default=None, help="MC replications")
     p.add_argument("--seed", type=int, default=2025)
-    p.add_argument("--backend", default="local",
-                   choices=["local", "sharded", "bucketed"])
+    p.add_argument("--backend", default=backends[0], choices=list(backends))
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                   help="force a JAX platform before backend init (the site "
+                        "hook overrides JAX_PLATFORMS env, so this is the "
+                        "only reliable off-TPU switch)")
 
 
 def cmd_demo(args):
@@ -148,12 +154,17 @@ def cmd_hrs_sweep(args):
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="dpcorr")
     sub = ap.add_subparsers(dest="cmd", required=True)
+    backends_by_cmd = {
+        "grid": ("local", "sharded", "bucketed"),
+        "grid-subg": ("local", "sharded", "bucketed"),
+        "stress": ("local", "sharded"),
+    }
     for name, fn in [("demo", cmd_demo), ("demo-subg", cmd_demo_subg),
                      ("grid", cmd_grid), ("grid-subg", cmd_grid_subg),
                      ("hrs", cmd_hrs), ("hrs-sweep", cmd_hrs_sweep),
                      ("stress", cmd_stress)]:
         p = sub.add_parser(name)
-        _add_common(p)
+        _add_common(p, backends_by_cmd.get(name, ("local",)))
         if name == "stress":
             p.add_argument("--n", type=int, default=1_000_000)
             p.add_argument("--n-chunk", dest="n_chunk", type=int,
@@ -162,6 +173,11 @@ def main(argv=None):
                            default="subg")
         p.set_defaults(fn=fn)
     args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+
+        # must run before any backend initialization; no-op if one is live
+        jax.config.update("jax_platforms", args.platform)
     args.fn(args)
 
 

@@ -112,6 +112,14 @@ def _load_cached(path: Path | None, resume: bool, stamp: str):
     return None
 
 
+def _raise_if_failed(failures, n_points: int):
+    """Aggregate fail-loud raise shared by all backends (SURVEY.md §5)."""
+    if failures:
+        raise RuntimeError(
+            f"{len(failures)}/{n_points} design points failed; first: "
+            f"{failures[0][0]} -> {failures[0][1]!r}")
+
+
 def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                        out_dir: Path | None):
     """Grid-axis vectorization: all design points of one (n, ε) compile
@@ -127,48 +135,61 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
 
     import jax.numpy as jnp
 
-    details, timings = {}, []
+    details, timings, failures = {}, [], []
     for _, grp in design.groupby(["n", "eps1", "eps2"], sort=False):
         rows = list(grp.itertuples(index=False))
-        cfg = gcfg.sim_config(rows[0]._asdict())
-        stamps = {int(r.i): repr(dataclasses.replace(cfg, rho=float(r.rho)))
-                  for r in rows}
-        paths = {int(r.i): _design_path(out_dir, int(r.i)) if out_dir else None
-                 for r in rows}
-        to_run = []
         t0 = time.perf_counter()
-        for r in rows:
-            i = int(r.i)
-            cached = _load_cached(paths[i], gcfg.resume, stamps[i])
-            if cached is not None:
-                details[i] = cached
-            else:
-                to_run.append(r)
-        if to_run:
-            keys = jnp.concatenate([
-                rng.rep_keys(rng.design_key(master, int(r.i)), gcfg.b)
-                for r in to_run])
-            rhos = jnp.repeat(jnp.asarray([r.rho for r in to_run],
-                                          jnp.float32), gcfg.b)
-            cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
-            raw = sim_mod._run_detail_flat(cfg_norho, keys, rhos)
-            for j, r in enumerate(to_run):
+        ran = 0
+        # Same fail-loud-per-point semantics as the local backend: a broken
+        # bucket is recorded and the remaining buckets still run; one
+        # aggregated RuntimeError is raised by run_grid at the end.
+        try:
+            cfg = gcfg.sim_config(rows[0]._asdict())
+            stamps = {int(r.i): repr(dataclasses.replace(cfg,
+                                                         rho=float(r.rho)))
+                      for r in rows}
+            paths = {int(r.i): (_design_path(out_dir, int(r.i))
+                                if out_dir else None)
+                     for r in rows}
+            to_run = []
+            for r in rows:
                 i = int(r.i)
-                sl = slice(j * gcfg.b, (j + 1) * gcfg.b)
-                detail = {f: np.asarray(a[sl])
-                          for f, a in zip(sim_mod.DETAIL_FIELDS, raw,
-                                          strict=True)}
-                details[i] = detail
-                if paths[i] is not None:
-                    np.savez(paths[i], config_stamp=stamps[i], **detail)
+                cached = _load_cached(paths[i], gcfg.resume, stamps[i])
+                if cached is not None:
+                    details[i] = cached
+                else:
+                    to_run.append(r)
+            ran = len(to_run)
+            if to_run:
+                keys = jnp.concatenate([
+                    rng.rep_keys(rng.design_key(master, int(r.i)), gcfg.b)
+                    for r in to_run])
+                rhos = jnp.repeat(jnp.asarray([r.rho for r in to_run],
+                                              jnp.float32), gcfg.b)
+                cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
+                raw = sim_mod._run_detail_flat(cfg_norho, keys, rhos)
+                for j, r in enumerate(to_run):
+                    i = int(r.i)
+                    sl = slice(j * gcfg.b, (j + 1) * gcfg.b)
+                    detail = {f: np.asarray(a[sl])
+                              for f, a in zip(sim_mod.DETAIL_FIELDS, raw,
+                                              strict=True)}
+                    details[i] = detail
+                    if paths[i] is not None:
+                        np.savez(paths[i], config_stamp=stamps[i], **detail)
+        except Exception as e:
+            log.error("bucket (n=%d eps=(%.2f,%.2f), %d points) failed: %s",
+                      rows[0].n, rows[0].eps1, rows[0].eps2, len(rows), e)
+            failures.extend((int(r.i), e) for r in rows
+                            if int(r.i) not in details)
+            continue
         dt = time.perf_counter() - t0
-        ran = len(to_run)
         timings.append({
             "n": rows[0].n, "eps1": rows[0].eps1, "eps2": rows[0].eps2,
             "points": len(rows), "points_run": ran, "seconds": dt,
             "reps_per_sec": np.nan if not ran else ran * gcfg.b / dt,
         })
-    return details, timings
+    return details, timings, failures
 
 
 def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
@@ -184,7 +205,9 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
         out_dir.mkdir(parents=True, exist_ok=True)
 
     if gcfg.backend == "bucketed":
-        by_i, timings = _run_grid_bucketed(gcfg, design, master, out_dir)
+        by_i, timings, failures = _run_grid_bucketed(gcfg, design, master,
+                                                     out_dir)
+        _raise_if_failed(failures, len(design))
         details = []
         for row in design.itertuples(index=False):
             frame = pd.DataFrame(by_i[int(row.i)])
@@ -237,10 +260,7 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
         frame["eps2"] = row.eps2
         details.append(frame)
 
-    if failures:
-        raise RuntimeError(
-            f"{len(failures)}/{len(design)} design points failed; first: "
-            f"{failures[0][0]} -> {failures[0][1]!r}")
+    _raise_if_failed(failures, len(design))
 
     detail_all = pd.concat(details, ignore_index=True)
     summ_all = summarize_grid(detail_all)

@@ -36,3 +36,13 @@ def test_stress(capsys):
 def test_bad_command():
     with pytest.raises(SystemExit):
         main(["not-a-command"])
+
+
+def test_unsupported_backend_rejected():
+    """Commands only advertise backends they implement: `stress` has no
+    bucketed path and `demo` is local-only — both are argparse errors, not
+    silently-ignored flags (ADVICE round 1)."""
+    with pytest.raises(SystemExit):
+        main(["stress", "--backend", "bucketed", "--n", "1000", "--b", "2"])
+    with pytest.raises(SystemExit):
+        main(["demo", "--backend", "sharded", "--b", "2"])

@@ -83,6 +83,28 @@ def test_unknown_backend_fails_loudly():
         run_grid(GridConfig(**SMALL, backend="nope"))
 
 
+def test_bucketed_bucket_failure_isolated(monkeypatch, tmp_path):
+    """A failing bucket is recorded, the other buckets still run (their
+    .npz caches land on disk), and one aggregated error is raised at the
+    end — the local backend's fail-loud semantics (ADVICE round 1)."""
+    from dpcorr import sim as sim_mod
+
+    real = sim_mod._run_detail_flat
+
+    def flaky(cfg, keys, rhos):
+        if cfg.n == 400:
+            raise ValueError("boom in bucket n=400")
+        return real(cfg, keys, rhos)
+
+    monkeypatch.setattr(sim_mod, "_run_detail_flat", flaky)
+    gc = GridConfig(**SMALL, backend="bucketed", out_dir=str(tmp_path))
+    with pytest.raises(RuntimeError, match="2/4 design points failed"):
+        run_grid(gc)
+    # the healthy n=800 bucket still ran and persisted its two points
+    done = sorted(p.name for p in tmp_path.glob("design_*.npz"))
+    assert done == ["design_00001.npz", "design_00003.npz"]
+
+
 def test_summarize_grid_pure_function():
     df = pd.DataFrame({
         "n": [100] * 4, "rho_true": [0.5] * 4, "eps1": [1.0] * 4,

@@ -189,9 +189,13 @@ def point_estimates(cfg: HrsConfig = HrsConfig(), cols=None) -> HrsPointResult:
     it = _int_once(rng.stream(key, "hrs/int"), std.age_z, std.bmi_z,
                    cfg.eps_corr, std.lam_age, std.lam_bmi, lam_recv, delta,
                    cfg.alpha, cfg.mixquant_mode)
-    as_dict = lambda r: {"rho_hat": float(r.rho_hat),
-                         "ci_low": float(r.ci_low),
-                         "ci_high": float(r.ci_high)}
+    def as_dict(r):
+        out = {"rho_hat": float(r.rho_hat), "ci_low": float(r.ci_low),
+               "ci_high": float(r.ci_high)}
+        if r.aux:  # λ/geometry block (real-data-sims.R:141-147, 244-252)
+            out.update({k: float(v) for k, v in r.aux.items()})
+        return out
+
     return HrsPointResult(as_dict(ni), as_dict(it), std, n, cfg)
 
 

@@ -4,18 +4,26 @@ sample sd."""
 from __future__ import annotations
 
 import math
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 
 
 class CorrResult(NamedTuple):
-    """Point estimate + CI. Extra per-variant fields ride in ``aux``."""
+    """Point estimate + CI.
+
+    ``aux`` carries the per-variant extras the real-data reference functions
+    return beyond the CI — batch geometry (k, m), λ thresholds, δ
+    (real-data-sims.R:141-147, 244-252) — as a dict of scalars; ``None`` for
+    variants without extras. Dropped before any vmap boundary (the MC
+    simulator consumes only the three array fields).
+    """
 
     rho_hat: jax.Array
     ci_low: jax.Array
     ci_high: jax.Array
+    aux: Any = None
 
 
 def batch_geometry(n: int, eps1: float, eps2: float,

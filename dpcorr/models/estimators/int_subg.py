@@ -105,9 +105,16 @@ def ci_int_subg(key: jax.Array, x: jax.Array, y: jax.Array,
     rho_hat = jnp.mean(uc) + laplace(stream(key, "int_subg/lap_recv"), (), central_scale)
 
     sd_uc = sample_sd(uc)
+    # the real-data variant's richer return (real-data-sims.R:244-252);
+    # the grid variant has no λ_other/δ concepts
+    aux = {"lambda_sender": lam_s, "lambda_receiver": lam_r,
+           "eps_sender": eps_s, "eps_receiver": eps_r}
+    if variant == "real":
+        aux["lambda_other"] = lam_o
+        aux["delta_clip"] = delta_clip
     if variant == "grid":
         return grid_interval(key, rho_hat, sd_uc, n, eps_r, central_scale,
-                             alpha, mixquant_mode)
+                             alpha, mixquant_mode)._replace(aux=aux)
     else:
         # sampling-only se + explicit sd==0 degenerate branch
         # (real-data-sims.R:237-242)
@@ -123,4 +130,4 @@ def ci_int_subg(key: jax.Array, x: jax.Array, y: jax.Array,
 
     lo = jnp.maximum(rho_hat - width, -1.0)  # ρ-space clamp
     hi = jnp.minimum(rho_hat + width, 1.0)
-    return CorrResult(rho_hat, lo, hi)
+    return CorrResult(rho_hat, lo, hi, aux)

@@ -69,4 +69,6 @@ def correlation_ni_subg(key: jax.Array, x: jax.Array, y: jax.Array,
     crit = ndtri(1.0 - alpha / 2.0)
     lo = jnp.maximum(rho_hat - crit * se, -1.0)  # ρ-space clamp (:58-59)
     hi = jnp.minimum(rho_hat + crit * se, 1.0)
-    return CorrResult(rho_hat, lo, hi)
+    # the real-data variant's richer return (real-data-sims.R:141-147)
+    aux = {"k": k, "m": m, "lambda_x": lam1, "lambda_y": lam2}
+    return CorrResult(rho_hat, lo, hi, aux)

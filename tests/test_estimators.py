@@ -162,6 +162,16 @@ class TestNiSubg:
         r = correlation_ni_subg(KEY, x, y, 0.5, 0.5, enforce_min_k=True)
         assert np.isfinite(float(r.rho_hat))
 
+    def test_aux_geometry_and_lambdas(self):
+        """The richer real-data return (k, m, λ_X, λ_Y)
+        (real-data-sims.R:141-147) rides in ``aux``."""
+        x, y = _data(n=2000)
+        r = correlation_ni_subg(KEY, x, y, 1.0, 1.0,
+                                lambda_x=0.7, lambda_y=0.9)
+        assert (r.aux["m"], r.aux["k"]) == batch_geometry(2000, 1.0, 1.0)
+        assert float(r.aux["lambda_x"]) == 0.7
+        assert float(r.aux["lambda_y"]) == 0.9
+
 
 class TestIntSubg:
     def test_grid_variant(self):
@@ -201,6 +211,19 @@ class TestIntSubg:
         x, y = _data()
         with pytest.raises(ValueError):
             ci_int_subg(KEY, x, y, 1.0, 1.0, variant="v3")
+
+    def test_aux_lambdas_and_delta(self):
+        """λ_sender/λ_other/λ_receiver/δ extras (real-data-sims.R:244-252)."""
+        x, y = _data(n=1000)
+        r = ci_int_subg(KEY, x, y, 2.0, 1.0, variant="real",
+                        lambda_sender=2.0, lambda_other=1.5)
+        assert float(r.aux["lambda_sender"]) == 2.0
+        assert float(r.aux["lambda_other"]) == 1.5
+        assert float(r.aux["delta_clip"]) == 1.0 / 1000
+        assert float(r.aux["lambda_receiver"]) > 0
+        assert (r.aux["eps_sender"], r.aux["eps_receiver"]) == (2.0, 1.0)
+        g = ci_int_subg(KEY, x, y, 2.0, 1.0, variant="grid")
+        assert "delta_clip" not in g.aux and "lambda_sender" in g.aux
 
 
 class TestVmapCompat:

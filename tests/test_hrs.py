@@ -58,6 +58,12 @@ def test_point_estimates(point):
         # at ε=2 both methods land near the non-private truth
         assert abs(r["rho_hat"] - point.std.rho_np) < 0.15
     assert point.n == 19_433
+    # λ/geometry block surfaced as in the reference printout
+    # (real-data-sims.R:141-147, 244-252)
+    assert point.ni["lambda_x"] == pytest.approx(point.std.lam_age)
+    assert point.ni["m"] * point.ni["k"] <= point.n
+    assert point.int_["lambda_sender"] == pytest.approx(point.std.lam_age)
+    assert point.int_["delta_clip"] == pytest.approx(1.0 / point.n)
 
 
 def test_point_estimates_deterministic(cols):

@@ -236,7 +236,8 @@ def correlation_ni_subg_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
     crit = ndtri(1.0 - alpha / 2.0)
     lo = jnp.maximum(rho_hat - crit * se, -1.0)  # ρ-space clamp (:58-59)
     hi = jnp.minimum(rho_hat + crit * se, 1.0)
-    return CorrResult(rho_hat, lo, hi)
+    aux = {"k": k, "m": m, "lambda_x": lam1, "lambda_y": lam2}
+    return CorrResult(rho_hat, lo, hi, aux)
 
 
 # ----------------------------------------------------------- INT sign ----
@@ -316,5 +317,8 @@ def ci_int_subg_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
     rho_hat = mean_uc + laplace(stream(key, "int_subg/lap_recv"), (),
                                 central_scale)
     var_uc = jnp.maximum((s2 - n * mean_uc * mean_uc) / (n - 1), 0.0)
+    aux = {"lambda_sender": lam_s, "lambda_receiver": lam_r,
+           "eps_sender": eps_s, "eps_receiver": eps_r}
     return int_subg.grid_interval(key, rho_hat, jnp.sqrt(var_uc), n, eps_r,
-                                  central_scale, alpha, mixquant_mode)
+                                  central_scale, alpha,
+                                  mixquant_mode)._replace(aux=aux)

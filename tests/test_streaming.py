@@ -37,9 +37,16 @@ def _data(n, rho=0.4, seed=7, dgp=gen_gaussian):
 
 
 def _assert_close(a, b, atol=2e-5):
-    for fa, fb in zip(a, b):
+    for fa, fb in zip(a[:3], b[:3]):
         np.testing.assert_allclose(np.asarray(fa), np.asarray(fb),
                                    atol=atol, rtol=2e-5)
+    assert (a.aux is None) == (b.aux is None)
+    if a.aux is not None:
+        assert set(a.aux) == set(b.aux)
+        for name in a.aux:
+            np.testing.assert_allclose(np.asarray(a.aux[name]),
+                                       np.asarray(b.aux[name]),
+                                       atol=atol, rtol=2e-5)
 
 
 class TestChunkPlumbing:

@@ -23,8 +23,11 @@ Inside a worker, two implementations are raced on TPU:
 
 - **xla**: the framework's ``jit(vmap)`` estimator path (``dpcorr.sim``);
 - **pallas**: the fused VMEM kernel (``dpcorr.ops.pallas_ni``) with on-chip
-  hardware PRNG — TPU only; any failure falls back to xla with the failure
-  recorded in the JSON detail.
+  hardware PRNG — TPU only; measured in its *own* bounded subprocess
+  (a Mosaic compile hang has been observed to wedge the remote backend —
+  isolation keeps the XLA number safe); any failure falls back to xla with
+  the failure recorded in the JSON detail. ``DPCORR_BENCH_SKIP_PALLAS=1``
+  skips the attempt entirely.
 
 Each path compiles one fixed-size block, calibrates its wall-clock, then
 dispatches its share of the time budget asynchronously with a single fetch
@@ -66,7 +69,7 @@ def worker_main(mode: str, budget_s: float) -> None:
         # Must happen before any backend is initialized; keeps the worker
         # clear of the (possibly hung) TPU tunnel entirely.
         jax.config.update("jax_platforms", "cpu")
-    elif jax.devices()[0].platform not in ("tpu", "axon"):
+    elif jax.devices()[0].platform not in ("tpu", "axon"):  # tpu + tpu-pallas
         # Don't let a TPU-less host silently measure CPU with TPU-sized
         # blocks and report it as a healthy TPU number — fail loudly so the
         # orchestrator routes to the real CPU fallback (degraded-marked).
@@ -82,7 +85,7 @@ def worker_main(mode: str, budget_s: float) -> None:
     from dpcorr.sim import chunked_vmap
     from dpcorr.utils import rng
 
-    block_reps, chunk = WORKER_SHAPE[mode]
+    block_reps, chunk = WORKER_SHAPE["tpu" if mode == "tpu-pallas" else mode]
 
     def _metrics(r):
         cover = ((RHO >= r.ci_low) & (RHO <= r.ci_high)).astype(jnp.float32)
@@ -132,13 +135,34 @@ def worker_main(mode: str, budget_s: float) -> None:
         means = tuple(sum(o[j] for o in outs) / len(outs) for j in range(3))
         return n_blocks * block_reps / elapsed, means
 
-    def _sane(means) -> bool:
+    def _sane(means, ref_means) -> bool:
+        """Pallas draws from a different PRNG, so agreement with the XLA
+        path is statistical: coverage near nominal, mse/ci_length within
+        30% of the XLA-measured values."""
         mse, coverage, ci_len = means
-        return (0.90 <= coverage <= 0.99 and 0.0 < mse < 0.01
-                and 0.0 < ci_len < 0.2)
+        ref_mse, _, ref_ci_len = ref_means
+        return (0.90 <= coverage <= 0.99
+                and 0.7 * ref_mse < mse < 1.3 * ref_mse
+                and 0.7 * ref_ci_len < ci_len < 1.3 * ref_ci_len)
 
     key = rng.master_key()
     results = {}
+
+    if mode == "tpu-pallas":
+        # Pallas-only sub-worker (spawned by the tpu worker below): a
+        # Mosaic compile hang here kills only this subprocess, never the
+        # already-measured XLA number.
+        p_rps, p_means = _measure(_pallas_block, lambda i: jnp.int32(i))
+        print(json.dumps({
+            "metric": METRIC, "value": round(p_rps, 1),
+            "unit": "reps/sec/chip", "vs_baseline": 0.0,
+            "detail": {"paths": {"pallas": {
+                "reps_per_sec": round(p_rps, 1),
+                "mse": round(p_means[0], 6),
+                "coverage": round(p_means[1], 4),
+                "ci_length": round(p_means[2], 4)}}},
+        }), flush=True)
+        return
 
     xla_rps, xla_means = _measure(_xla_block,
                                   lambda i: rng.design_key(key, i))
@@ -148,18 +172,24 @@ def worker_main(mode: str, budget_s: float) -> None:
                       "ci_length": round(xla_means[2], 4)}
 
     pallas_err = None
-    if jax.devices()[0].platform in ("tpu", "axon"):
-        try:
-            p_rps, p_means = _measure(_pallas_block, lambda i: jnp.int32(i))
-            if _sane(p_means):
-                results["pallas"] = {"reps_per_sec": round(p_rps, 1),
-                                     "mse": round(p_means[0], 6),
-                                     "coverage": round(p_means[1], 4),
-                                     "ci_length": round(p_means[2], 4)}
+    if os.environ.get("DPCORR_BENCH_SKIP_PALLAS"):
+        pallas_err = "skipped (DPCORR_BENCH_SKIP_PALLAS)"
+    elif jax.devices()[0].platform in ("tpu", "axon"):
+        # A Mosaic compile hang on this kernel has been observed to wedge
+        # the whole remote-TPU backend (round-2 log), so the pallas path
+        # runs in its own bounded subprocess and only its result is trusted.
+        p_out, p_err = _run_worker("tpu-pallas",
+                                   timeout_s=180 + 1.5 * budget_s,
+                                   budget_s=budget_s)
+        if p_out is not None:
+            p = p_out["detail"]["paths"]["pallas"]
+            p_means = (p["mse"], p["coverage"], p["ci_length"])
+            if _sane(p_means, xla_means):
+                results["pallas"] = p
             else:
                 pallas_err = f"sanity check failed: {p_means}"
-        except Exception as e:  # fall back to xla, record why
-            pallas_err = f"{type(e).__name__}: {e}"[:300]
+        else:
+            pallas_err = p_err
     else:
         pallas_err = "not on TPU (on-chip PRNG unavailable)"
 
@@ -184,21 +214,37 @@ def worker_main(mode: str, budget_s: float) -> None:
 # --------------------------------------------------------------------------
 
 def _run_worker(mode: str, timeout_s: float, budget_s: float):
-    """Spawn a worker; return (parsed JSON, None) or (None, error string)."""
+    """Spawn a worker; return (parsed JSON, None) or (None, error string).
+
+    Workers get their own process group and the whole group is killed on
+    timeout — the tpu worker spawns a tpu-pallas *grandchild*, and an
+    orphaned grandchild hung in a Mosaic compile would keep the exclusive
+    TPU client alive and wedge every retry.
+    """
     cmd = [sys.executable, os.path.abspath(__file__),
            "--worker", mode, "--budget", str(budget_s)]
     try:
-        p = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return None, f"{mode} worker: timeout after {timeout_s:.0f}s"
+        p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True,
+                             start_new_session=True)
     except Exception as e:  # spawn failure itself
         return None, f"{mode} worker: {type(e).__name__}: {e}"[:300]
+    try:
+        stdout, stderr = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        p.wait()
+        return None, f"{mode} worker: timeout after {timeout_s:.0f}s"
     if p.returncode != 0:
-        tail = (p.stderr or "").strip().splitlines()[-3:]
+        tail = (stderr or "").strip().splitlines()[-3:]
         return None, (f"{mode} worker: rc={p.returncode}: "
                       + " | ".join(tail))[:400]
-    for line in reversed((p.stdout or "").strip().splitlines()):
+    for line in reversed((stdout or "").strip().splitlines()):
         try:
             out = json.loads(line)
         except json.JSONDecodeError:
@@ -211,7 +257,8 @@ def _run_worker(mode: str, timeout_s: float, budget_s: float):
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--worker", choices=["tpu", "cpu"], default=None)
+    ap.add_argument("--worker", choices=["tpu", "tpu-pallas", "cpu"],
+                    default=None)
     ap.add_argument("--budget", type=float, default=30.0,
                     help="per-path measurement budget (seconds)")
     args = ap.parse_args()
@@ -222,10 +269,11 @@ def main() -> None:
 
     attempts = []
     # Attempt 1: TPU, full budget. Init alone can take minutes through the
-    # tunnel; the timeout bounds init + compile + the 2 measured paths, and
-    # scales with the requested budget so a long --budget isn't killed
-    # mid-measurement.
-    out, err = _run_worker("tpu", timeout_s=420 + 2.5 * args.budget,
+    # tunnel; the timeout bounds init + compile + the XLA measurement PLUS
+    # the nested tpu-pallas sub-worker (its own init + compile + 180+1.5·b
+    # cap), and scales with the requested budget so a long --budget isn't
+    # killed mid-measurement.
+    out, err = _run_worker("tpu", timeout_s=600 + 4.0 * args.budget,
                            budget_s=args.budget)
     if out is None:
         attempts.append(err)

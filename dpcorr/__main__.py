@@ -62,7 +62,7 @@ def cmd_demo_subg(args):
                       "summary": res.summary}, indent=2))
 
 
-def _run_grid(args, gcfg, fig1_n, fig1_eps):
+def _run_grid(args, gcfg, fig1_n, fig1_eps, family="v1"):
     from dpcorr import report
     from dpcorr.grid import run_grid
 
@@ -74,9 +74,15 @@ def _run_grid(args, gcfg, fig1_n, fig1_eps):
           f"({reps / dt:.0f} reps/sec incl. compile)")
     print(res.summ_all.to_string(index=False, float_format=lambda v: f"{v:.4f}"))
     if args.out:
-        paths = report.render_all(grid_detail=res.detail_all,
-                                  grid_summ=res.summ_all, out_dir=args.out,
-                                  fig1_n=fig1_n, fig1_eps=fig1_eps)
+        if family == "subg":
+            paths = report.render_all_subg(
+                grid_detail=res.detail_all, grid_summ=res.summ_all,
+                out_dir=args.out, fig1_n=fig1_n, fig1_eps=fig1_eps)
+        else:
+            paths = report.render_all(grid_detail=res.detail_all,
+                                      grid_summ=res.summ_all,
+                                      out_dir=args.out,
+                                      fig1_n=fig1_n, fig1_eps=fig1_eps)
         print("figures:", *(str(p) for p in paths))
 
 
@@ -95,7 +101,8 @@ def cmd_grid_subg(args):
         n_grid=(2500, 4000, 6000, 9000, 12000),  # ver-cor-subG.R:245
         b=args.b or 250, dgp="bounded_factor", use_subg=True,
         seed=args.seed, backend=args.backend, out_dir=args.out)
-    _run_grid(args, gcfg, fig1_n=4000, fig1_eps=(1.5, 0.5))
+    # the reference's subG fig1 slices n=6000 (ver-cor-subG.R:342)
+    _run_grid(args, gcfg, fig1_n=6000, fig1_eps=(1.5, 0.5), family="subg")
 
 
 def cmd_hrs(args):

@@ -131,10 +131,10 @@ def _make_kernel(n: int, m: int, m_pad: int, k: int, leftover: int,
             def take(shape):
                 r0 = cursor[0]
                 cursor[0] += shape[0]
-                return u_ref[pl.ds(r0, shape[0]), :]
+                return u_ref[0, pl.ds(r0, shape[0]), :]
         else:
             (out_ref,) = rest
-            pltpu.prng_seed(seed_ref[0, 0])
+            pltpu.prng_seed(seed_ref[0, 0, 0])
 
             def take(shape):
                 return _rand_uniform(shape)
@@ -204,8 +204,8 @@ def _make_kernel(n: int, m: int, m_pad: int, k: int, leftover: int,
         st2 = jnp.sum(t * t)
 
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
-        out_ref[0, :] = jnp.where(lane == 0, st,
-                                  jnp.where(lane == 1, st2, 0.0))[0, :]
+        out_ref[0, 0, :] = jnp.where(lane == 0, st,
+                                     jnp.where(lane == 1, st2, 0.0))[0, :]
 
     return kernel
 
@@ -225,31 +225,35 @@ def _ni_sign_pallas_sums(seeds: jax.Array, rho: jax.Array, n: int,
         (np.arange(LANES)[:, None] // m_pad) == np.arange(LANES)[None, :],
         jnp.float32)  # padded to (128, 128); kernel slices [:, :g_cols]
 
+    # Mosaic requires every block's trailing two dims to be divisible by
+    # (8, 128) or equal to the array's — so the grid axis is a *leading*
+    # third dim everywhere and each block's last two dims equal the array's.
     in_specs = [
-        pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0), memory_space=pltpu.SMEM),
         pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
         pl.BlockSpec((LANES, LANES), lambda i: (0, 0),
                      memory_space=pltpu.VMEM),
     ]
-    inputs = [seeds.reshape(b, 1), rho.reshape(1, 1), gmat]
+    inputs = [seeds.reshape(b, 1, 1), rho.reshape(1, 1), gmat]
     if external:
         u_rows = n_uniform_rows(n, eps1, eps2)
-        in_specs.append(pl.BlockSpec((u_rows, LANES), lambda i: (i, 0),
+        in_specs.append(pl.BlockSpec((1, u_rows, LANES),
+                                     lambda i: (i, 0, 0),
                                      memory_space=pltpu.VMEM))
-        inputs.append(uniforms.reshape(b * u_rows, LANES))
+        inputs.append(uniforms.reshape(b, u_rows, LANES))
 
     out = pl.pallas_call(
         kernel,
         grid=(b,),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, LANES), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((1, 1, LANES), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, LANES), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b, 1, LANES), jnp.float32),
         # TPU interpret mode runs the kernel on CPU (pltpu.prng_* stubs
         # return zeros there — external uniforms cover testing)
         interpret=pltpu.InterpretParams() if interpret else False,
     )(*inputs)
-    return out[:, 0], out[:, 1]
+    return out[:, 0, 0], out[:, 0, 1]
 
 
 def ni_sign_pallas(seeds: jax.Array, rho, n: int, eps1: float, eps2: float,

@@ -35,6 +35,26 @@ def run_design_rows(rows: Sequence[Mapping], b: int = 250,
     side.
     """
     master = rng.master_key(int(seed))
+
+    if backend == "bucketed":
+        # the grid speedup (one kernel per (n, ε) shape bucket, ρ traced,
+        # dispatch-ahead) — reachable from R, bit-identical per point to
+        # the local path (both fold design_key(master, i))
+        from dpcorr import grid as grid_mod
+
+        gcfg = grid_mod.GridConfig(
+            b=int(b), alpha=float(alpha), dgp=dgp, use_subg=bool(use_subg),
+            normalise=bool(normalise), ci_mode=ci_mode, seed=int(seed),
+            backend="bucketed")
+        design = pd.DataFrame(
+            [{"i": i, "n": int(r["n"]), "rho": float(r["rho"]),
+              "eps1": float(r["eps1"]), "eps2": float(r["eps2"])}
+             for i, r in enumerate(rows)])
+        by_i, _, failures = grid_mod._run_grid_bucketed(
+            gcfg, design, master, out_dir=None)
+        grid_mod._raise_if_failed(failures, len(design))
+        return grid_mod._assemble_details(design, by_i, gcfg.b)
+
     frames = []
     for i, row in enumerate(rows):
         cfg = SimConfig(

@@ -122,30 +122,150 @@ def fig_mse_vs_n(summ_all: pd.DataFrame, rho: float, out=None):
     return _save(fig, out)
 
 
+# ---------------------------------------------------------------- subG ----
+# The v2 grid's own figure family (ver-cor-subG.R:338-436) — structurally
+# distinct from v1: fig1 overlays both methods on ONE panel (NI grey,
+# INT steelblue — the reference's scale_fill/colour_manual at :369-372);
+# fig2a/2b are separate width/coverage files on a log-x axis with one color
+# per ε-pair and linetype by method; fig3 is log-log.
+
+_SUBG_FILL = {"NI": "#b3b3b3", "INT": "#4682b4"}   # grey70 / steelblue
+_SUBG_LINE = {"NI": "#595959", "INT": "#4682b4"}   # grey35 / steelblue
+#: one color per ε-pair for the subG vs-n figures (colorblind-safe trio)
+_EPS_COLORS = ("#3b6fb5", "#e07b39", "#4daf8c")
+_METH_LS = {"NI": "-", "INT": "--"}
+
+
+def fig_subg_mean_band(detail_all: pd.DataFrame, n: int = 6000,
+                       eps_pair: tuple[float, float] = (1.5, 0.5), out=None):
+    """subG_fig1 (ver-cor-subG.R:338-380): mean CI offset bands vs ρ at one
+    (n, ε) slice — both methods overlaid on a single panel, dashed zero
+    line, y = mean(CI) − ρ. Reference slice: n=6000, ε=(1.5, 0.5)."""
+    d = detail_all[(detail_all.n == n) & (detail_all.eps1 == eps_pair[0])
+                   & (detail_all.eps2 == eps_pair[1])]
+    fig, ax = plt.subplots(figsize=(6.8, 4.4))
+    ax.axhline(0.0, color="#888888", linestyle="--", linewidth=0.9)
+    rho = np.array(sorted(d.rho_true.unique()))
+    g = d.groupby("rho_true")
+    for meth in ("NI", "INT"):
+        p = meth.lower()
+        lo_off = g[f"{p}_low"].mean().reindex(rho) - rho
+        hi_off = g[f"{p}_up"].mean().reindex(rho) - rho
+        est_off = g[f"{p}_hat"].mean().reindex(rho) - rho
+        ax.fill_between(rho, lo_off, hi_off, color=_SUBG_FILL[meth],
+                        alpha=0.35, linewidth=0, label=meth)
+        ax.plot(rho, est_off, color=_SUBG_LINE[meth], linewidth=1.6)
+    _style(ax, r"$\rho$", r"mean(CI) $-$ $\rho$",
+           f"Mean CI offset bands — n = {n}, "
+           f"ε₁ = {eps_pair[0]}, ε₂ = {eps_pair[1]}")
+    ax.legend(frameon=False, fontsize=9, title="Estimator", title_fontsize=9)
+    fig.tight_layout()
+    return _save(fig, out)
+
+
+def _fig_subg_vs_n(summ_all: pd.DataFrame, rho: float, ycol: str,
+                   ylabel: str, title: str, logy: bool = False,
+                   nominal: float | None = None, out=None):
+    """Shared body of subG fig2a/2b/3: y vs n (log-x), one color per
+    ε-pair, linetype by method (ver-cor-subG.R:383-436)."""
+    d = summ_all[summ_all.rho_true == rho]
+    eps_pairs = sorted(set(zip(d.eps1, d.eps2)))
+    fig, ax = plt.subplots(figsize=(6.0, 4.0))
+    for j, (e1, e2) in enumerate(eps_pairs):
+        c = _EPS_COLORS[j % len(_EPS_COLORS)]
+        for meth in ("NI", "INT"):
+            s = d[(d.method == meth) & (d.eps1 == e1)
+                  & (d.eps2 == e2)].sort_values("n")
+            ax.plot(s.n, s[ycol], color=c, linestyle=_METH_LS[meth],
+                    marker="o", markersize=3, linewidth=1.6,
+                    label=f"({e1},{e2}) {meth}")
+    if nominal is not None:
+        ax.axhline(nominal, color="#888888", linestyle="--", linewidth=0.8)
+    ax.set_xscale("log")
+    if logy:
+        ax.set_yscale("log")
+    _style(ax, "n (log-scale)", ylabel, title)
+    ax.legend(frameon=False, fontsize=7, title="(ε₁,ε₂)  method",
+              title_fontsize=7)
+    fig.tight_layout()
+    return _save(fig, out)
+
+
+def fig_subg_width(summ_all: pd.DataFrame, rho: float = 0.5, out=None):
+    """subG_fig2a (ver-cor-subG.R:383-397): average CI width vs n."""
+    return _fig_subg_vs_n(summ_all, rho, "ci_len", "Average CI length",
+                          f"Average CI width vs n (ρ = {rho})", out=out)
+
+
+def fig_subg_coverage(summ_all: pd.DataFrame, rho: float = 0.5,
+                      alpha: float = 0.05, out=None):
+    """subG_fig2b (ver-cor-subG.R:399-413): coverage vs n, nominal line."""
+    return _fig_subg_vs_n(summ_all, rho, "coverage", "Empirical coverage",
+                          f"Coverage vs n (ρ = {rho})",
+                          nominal=1 - alpha, out=out)
+
+
+def fig_subg_mse(summ_all: pd.DataFrame, rho: float = 0.5, out=None):
+    """subG_fig3 (ver-cor-subG.R:418-436): MSE vs n, log-log."""
+    return _fig_subg_vs_n(summ_all, rho, "mse", "MSE (log-scale)",
+                          f"MSE of ρ̂ vs n (ρ = {rho})", logy=True, out=out)
+
+
+def render_all_subg(grid_detail: pd.DataFrame | None = None,
+                    grid_summ: pd.DataFrame | None = None,
+                    out_dir: str | Path = "figures",
+                    fig1_n: int = 6000, fig1_eps=(1.5, 0.5),
+                    rho: float = 0.5) -> list[Path]:
+    """The v2 grid's four-figure dump with the reference's filenames
+    (ver-cor-subG.R:380, 411-413, 434)."""
+    out_dir = Path(out_dir)
+    written = []
+    if grid_detail is not None:
+        p = out_dir / "subG_fig1_mean_band.pdf"
+        fig_subg_mean_band(grid_detail, fig1_n, fig1_eps, out=p)
+        written.append(p)
+    if grid_summ is not None:
+        for name, fn in (("subG_fig2a_width.pdf", fig_subg_width),
+                         ("subG_fig2b_cov.pdf", fig_subg_coverage),
+                         ("subG_fig3_mse.pdf", fig_subg_mse)):
+            p = out_dir / name
+            fn(grid_summ, rho, out=p)
+            written.append(p)
+    plt.close("all")
+    return written
+
+
 def fig_hrs_sweep(summ: pd.DataFrame, rho_np: float | None = None, out=None):
-    """HRS ε-sweep panels (real-data-sims.R:450-506): per method, mean
-    estimate with mean-CI error bars vs ε, dashed non-private baseline,
-    solid zero line; shared y-limits across the two panels."""
+    """HRS ε-sweep panels (real-data-sims.R:450-506): per method, the
+    mean-CI *midpoint* ``(ci_low_mean + ci_high_mean)/2`` as the point
+    (real-data-sims.R:459-461 — NOT the mean ρ̂, which differs for
+    asymmetric CIs) with mean-CI error bars vs ε, dashed non-private
+    baseline, red zero line; shared y-limits spanning the CIs, ρ_np and 0
+    (real-data-sims.R:463-468)."""
     if rho_np is None:
         rho_np = summ.attrs.get("rho_np")
     fig, axes = plt.subplots(1, 2, figsize=(9, 3.4), sharey=True)
-    ylo = summ.ci_low_mean.min()
-    yhi = summ.ci_high_mean.max()
-    pad = 0.05 * (yhi - ylo)
+    y_all = [summ.ci_low_mean.min(), summ.ci_high_mean.max(), 0.0]
+    if rho_np is not None:
+        y_all.append(rho_np)
+    ylo, yhi = min(y_all), max(y_all)
+    pad = 0.02 * (yhi - ylo)
+    titles = {"NI": "Non-interactive", "INT": "Interactive"}
     for ax, meth in zip(axes, ("NI", "INT")):
         s = summ[summ.method == meth].sort_values("eps_corr")
+        mid = (s.ci_low_mean + s.ci_high_mean) / 2.0
         c = COLORS[meth]
         ax.axhline(0.0, color="#b03030", linewidth=0.9)
         if rho_np is not None:
             ax.axhline(rho_np, color="#555555", linestyle="--", linewidth=0.9,
                        label=r"non-private $\rho$")
-        ax.errorbar(s.eps_corr, s.rho_hat_mean,
-                    yerr=[s.rho_hat_mean - s.ci_low_mean,
-                          s.ci_high_mean - s.rho_hat_mean],
-                    color=c, fmt="o-", markersize=3.5, linewidth=1.6,
-                    elinewidth=1.0, capsize=2, label=f"{meth} mean ± mean CI")
+        ax.errorbar(s.eps_corr, mid,
+                    yerr=[mid - s.ci_low_mean, s.ci_high_mean - mid],
+                    color=c, fmt="o", markersize=3.5,
+                    elinewidth=1.0, capsize=2, label="mean CI (midpoint)")
         ax.set_ylim(ylo - pad, yhi + pad)
-        _style(ax, r"$\varepsilon$", r"$\hat\rho$", f"{meth} (AGE→BMI)")
+        _style(ax, r"$\varepsilon_{corr}$", r"mean(CI) for $\rho$",
+               titles[meth])
         ax.legend(frameon=False, fontsize=8)
     fig.tight_layout()
     return _save(fig, out)

@@ -22,6 +22,7 @@ run_grid_backend <- function(design_df, run_row_fun = NULL, B = 250,
                              backend = c("tpu", "mclapply"),
                              dgp = "gaussian", use_subG = FALSE,
                              alpha = 0.05, normalise = TRUE,
+                             py_backend = "bucketed",
                              mc_cores = max(1L, parallel::detectCores() - 1L)) {
   backend <- match.arg(backend)
 
@@ -52,10 +53,13 @@ run_grid_backend <- function(design_df, run_row_fun = NULL, B = 250,
   rows <- lapply(seq_len(nrow(design_df)), function(i) {
     as.list(design_df[i, c("n", "rho", "eps1", "eps2")])
   })
+  # py_backend = "bucketed" is the grid fast path (one compiled kernel per
+  # (n, eps) shape bucket); results are bit-identical to "local" per point.
   detail <- bridge$run_design_rows(rows, b = as.integer(B),
                                    seed = as.integer(seed), dgp = dgp,
                                    use_subg = use_subG, alpha = alpha,
-                                   normalise = normalise)
+                                   normalise = normalise,
+                                   backend = py_backend)
   as.data.frame(detail)
 }
 

@@ -28,6 +28,44 @@ def test_synthetic_figures(small_grid, tmp_path):
         assert p.exists() and p.stat().st_size > 2_000
 
 
+def test_subg_figures(small_grid, tmp_path):
+    """The distinct v2 family (ver-cor-subG.R:338-436): 4 files with the
+    reference's names."""
+    paths = report.render_all_subg(grid_detail=small_grid.detail_all,
+                                   grid_summ=small_grid.summ_all,
+                                   out_dir=tmp_path, fig1_n=800,
+                                   fig1_eps=(1.5, 0.5), rho=0.5)
+    assert [p.name for p in paths] == [
+        "subG_fig1_mean_band.pdf", "subG_fig2a_width.pdf",
+        "subG_fig2b_cov.pdf", "subG_fig3_mse.pdf"]
+    for p in paths:
+        assert p.exists() and p.stat().st_size > 2_000
+
+
+def test_hrs_point_is_ci_midpoint(tmp_path):
+    """The HRS panel point must be (ci_low_mean+ci_high_mean)/2
+    (real-data-sims.R:459-461), not the mean ρ̂ — build a summary where the
+    two differ wildly and check the plotted point."""
+    summ = pd.DataFrame([
+        {"method": m, "eps_corr": e, "rho_hat_mean": 10.0,
+         "ci_low_mean": -0.4 - e, "ci_high_mean": 0.0 + e,
+         "ci_low_q10": -0.5, "ci_high_q90": 0.1}
+        for m in ("NI", "INT") for e in (0.25, 0.5)])
+    fig = report.fig_hrs_sweep(summ, rho_np=-0.193)
+    for ax in fig.axes:
+        for line in ax.lines:
+            ys = np.asarray(line.get_ydata(), dtype=float)
+            # nothing plotted at the decoy mean ρ̂
+            assert not np.any(np.isclose(ys, 10.0))
+    # the NI panel's point series is the midpoint of the CI means
+    pts = [line for line in fig.axes[0].lines
+           if len(line.get_xdata()) == 2 and line.get_marker() == "o"]
+    mids = (summ[summ.method == "NI"].sort_values("eps_corr")
+            [["ci_low_mean", "ci_high_mean"]].mean(axis=1).to_numpy())
+    assert any(np.allclose(np.asarray(line.get_ydata(), float), mids)
+               for line in pts)
+
+
 def test_hrs_figure(tmp_path):
     # synthetic sweep summary with the exact schema hrs.eps_sweep emits
     eps = np.round(np.arange(0.25, 0.66, 0.1), 10)

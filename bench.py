@@ -135,23 +135,13 @@ def worker_main(mode: str, budget_s: float) -> None:
         means = tuple(sum(o[j] for o in outs) / len(outs) for j in range(3))
         return n_blocks * block_reps / elapsed, means
 
-    def _sane(means, ref_means) -> bool:
-        """Pallas draws from a different PRNG, so agreement with the XLA
-        path is statistical: coverage near nominal, mse/ci_length within
-        30% of the XLA-measured values."""
-        mse, coverage, ci_len = means
-        ref_mse, _, ref_ci_len = ref_means
-        return (0.90 <= coverage <= 0.99
-                and 0.7 * ref_mse < mse < 1.3 * ref_mse
-                and 0.7 * ref_ci_len < ci_len < 1.3 * ref_ci_len)
-
     key = rng.master_key()
-    results = {}
 
     if mode == "tpu-pallas":
-        # Pallas-only sub-worker (spawned by the tpu worker below): a
-        # Mosaic compile hang here kills only this subprocess, never the
-        # already-measured XLA number.
+        # Pallas-only worker — run by the orchestrator as a *sibling* of
+        # the tpu worker, after it exits, so the two never contend for the
+        # (possibly exclusive) TPU client; a Mosaic compile hang here kills
+        # only this process, never the already-captured XLA number.
         p_rps, p_means = _measure(_pallas_block, lambda i: jnp.int32(i))
         print(json.dumps({
             "metric": METRIC, "value": round(p_rps, 1),
@@ -166,47 +156,81 @@ def worker_main(mode: str, budget_s: float) -> None:
 
     xla_rps, xla_means = _measure(_xla_block,
                                   lambda i: rng.design_key(key, i))
-    results["xla"] = {"reps_per_sec": round(xla_rps, 1),
-                      "mse": round(xla_means[0], 6),
-                      "coverage": round(xla_means[1], 4),
-                      "ci_length": round(xla_means[2], 4)}
+    paths = {"xla": {"reps_per_sec": round(xla_rps, 1),
+                     "mse": round(xla_means[0], 6),
+                     "coverage": round(xla_means[1], 4),
+                     "ci_length": round(xla_means[2], 4)}}
 
-    pallas_err = None
-    if os.environ.get("DPCORR_BENCH_SKIP_PALLAS"):
-        pallas_err = "skipped (DPCORR_BENCH_SKIP_PALLAS)"
-    elif jax.devices()[0].platform in ("tpu", "axon"):
-        # A Mosaic compile hang on this kernel has been observed to wedge
-        # the whole remote-TPU backend (round-2 log), so the pallas path
-        # runs in its own bounded subprocess and only its result is trusted.
-        p_out, p_err = _run_worker("tpu-pallas",
-                                   timeout_s=180 + 1.5 * budget_s,
-                                   budget_s=budget_s)
-        if p_out is not None:
-            p = p_out["detail"]["paths"]["pallas"]
-            p_means = (p["mse"], p["coverage"], p["ci_length"])
-            if _sane(p_means, xla_means):
-                results["pallas"] = p
+    if mode == "tpu":
+        # Same kernel on the rbg key impl (the TPU hardware generator):
+        # the threefry key derivation dominates the XLA path's runtime, so
+        # this is the cheap-PRNG variant. Gated on the same statistical
+        # sanity as pallas — different streams, same distributions.
+        try:
+            key_rbg = rng.master_key(impl="rbg")
+            rbg_rps, rbg_means = _measure(_xla_block,
+                                          lambda i: rng.design_key(key_rbg, i))
+            if _sane(rbg_means, xla_means):
+                paths["xla_rbg"] = {"reps_per_sec": round(rbg_rps, 1),
+                                    "mse": round(rbg_means[0], 6),
+                                    "coverage": round(rbg_means[1], 4),
+                                    "ci_length": round(rbg_means[2], 4)}
             else:
-                pallas_err = f"sanity check failed: {p_means}"
-        else:
-            pallas_err = p_err
-    else:
-        pallas_err = "not on TPU (on-chip PRNG unavailable)"
+                paths["xla_rbg_skipped"] = f"sanity: {rbg_means}"
+        except Exception as e:
+            paths["xla_rbg_skipped"] = f"{type(e).__name__}: {e}"[:200]
 
-    best = max(results, key=lambda p: results[p]["reps_per_sec"])
-    rps = results[best]["reps_per_sec"]
+    best = max((p for p in paths if not p.endswith("_skipped")),
+               key=lambda p: paths[p]["reps_per_sec"])
     print(json.dumps({
         "metric": METRIC,
-        "value": rps,
+        "value": paths[best]["reps_per_sec"],
         "unit": "reps/sec/chip",
-        "vs_baseline": round(rps / BASELINE_REPS_PER_SEC_CHIP, 3),
+        "vs_baseline": round(paths[best]["reps_per_sec"]
+                             / BASELINE_REPS_PER_SEC_CHIP, 3),
         "detail": {
             "n": N, "block_reps": block_reps, "path": best,
-            "paths": results,
-            **({"pallas_skipped": pallas_err} if pallas_err else {}),
+            "paths": paths,
             "device": str(jax.devices()[0]),
         },
     }), flush=True)
+
+
+def _sane(means, ref_means) -> bool:
+    """Pallas draws from a different PRNG, so agreement with the XLA path
+    is statistical: coverage near nominal, mse/ci_length within 30% of the
+    XLA-measured values."""
+    mse, coverage, ci_len = means
+    ref_mse, _, ref_ci_len = ref_means
+    return (0.90 <= coverage <= 0.99
+            and 0.7 * ref_mse < mse < 1.3 * ref_mse
+            and 0.7 * ref_ci_len < ci_len < 1.3 * ref_ci_len)
+
+
+def _merge_pallas(out: dict, budget_s: float) -> None:
+    """Run the pallas worker (its own process + TPU client) and fold its
+    result into the tpu worker's measurement, keeping the faster path."""
+    if os.environ.get("DPCORR_BENCH_SKIP_PALLAS"):
+        out["detail"]["pallas_skipped"] = "skipped (DPCORR_BENCH_SKIP_PALLAS)"
+        return
+    p_out, p_err = _run_worker("tpu-pallas",
+                               timeout_s=420 + 1.5 * budget_s,
+                               budget_s=budget_s)
+    if p_out is None:
+        out["detail"]["pallas_skipped"] = p_err
+        return
+    p = p_out["detail"]["paths"]["pallas"]
+    xla = out["detail"]["paths"]["xla"]
+    if not _sane((p["mse"], p["coverage"], p["ci_length"]),
+                 (xla["mse"], xla["coverage"], xla["ci_length"])):
+        out["detail"]["pallas_skipped"] = f"sanity check failed: {p}"
+        return
+    out["detail"]["paths"]["pallas"] = p
+    if p["reps_per_sec"] > out["value"]:
+        out["value"] = p["reps_per_sec"]
+        out["vs_baseline"] = round(p["reps_per_sec"]
+                                   / BASELINE_REPS_PER_SEC_CHIP, 3)
+        out["detail"]["path"] = "pallas"
 
 
 # --------------------------------------------------------------------------
@@ -268,12 +292,11 @@ def main() -> None:
         return
 
     attempts = []
-    # Attempt 1: TPU, full budget. Init alone can take minutes through the
-    # tunnel; the timeout bounds init + compile + the XLA measurement PLUS
-    # the nested tpu-pallas sub-worker (its own init + compile + 180+1.5·b
-    # cap), and scales with the requested budget so a long --budget isn't
-    # killed mid-measurement.
-    out, err = _run_worker("tpu", timeout_s=600 + 4.0 * args.budget,
+    # Attempt 1: TPU, full budget, XLA path only. Init alone can take
+    # minutes through the tunnel; the timeout bounds init + compile + the
+    # measurement and scales with the requested budget so a long --budget
+    # isn't killed mid-measurement.
+    out, err = _run_worker("tpu", timeout_s=420 + 2.5 * args.budget,
                            budget_s=args.budget)
     if out is None:
         attempts.append(err)
@@ -282,6 +305,10 @@ def main() -> None:
         retry_budget = min(10.0, args.budget)
         out, err = _run_worker("tpu", timeout_s=270 + 2.5 * retry_budget,
                                budget_s=retry_budget)
+    if out is not None:
+        # Pallas probe as a *sibling* worker after the tpu worker exited
+        # (own TPU client; a Mosaic hang loses only this probe).
+        _merge_pallas(out, args.budget)
     if out is None:
         attempts.append(err)
         cpu_budget = min(10.0, args.budget)

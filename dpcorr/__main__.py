@@ -67,7 +67,13 @@ def _run_grid(args, gcfg, fig1_n, fig1_eps, family="v1"):
     from dpcorr.grid import run_grid
 
     t0 = time.perf_counter()
-    res = run_grid(gcfg)
+    if getattr(args, "n_hosts", 1) > 1:
+        from dpcorr.parallel import run_grid_multihost
+
+        res = run_grid_multihost(gcfg, n_hosts=args.n_hosts,
+                                 platform=args.platform)
+    else:
+        res = run_grid(gcfg)
     dt = time.perf_counter() - t0
     reps = len(res.detail_all)
     print(f"grid: {reps} replicate rows in {dt:.1f}s "
@@ -146,6 +152,15 @@ def cmd_stress(args):
         "summary": summary}, indent=2))
 
 
+def cmd_acceptance(args):
+    """B≥10⁶ coverage campaign at the BASELINE 1e-3 criterion
+    (vert-cor.R:687 oracle; see dpcorr.acceptance)."""
+    from dpcorr.acceptance import run_campaign
+
+    table = run_campaign(b=args.b or 1_000_000, out=args.out_json)
+    print(json.dumps(table, indent=1))
+
+
 def cmd_hrs_sweep(args):
     from dpcorr import hrs, report
 
@@ -169,7 +184,7 @@ def main(argv=None):
     for name, fn in [("demo", cmd_demo), ("demo-subg", cmd_demo_subg),
                      ("grid", cmd_grid), ("grid-subg", cmd_grid_subg),
                      ("hrs", cmd_hrs), ("hrs-sweep", cmd_hrs_sweep),
-                     ("stress", cmd_stress)]:
+                     ("stress", cmd_stress), ("acceptance", cmd_acceptance)]:
         p = sub.add_parser(name)
         _add_common(p, backends_by_cmd.get(name, ("local",)))
         if name == "stress":
@@ -178,6 +193,13 @@ def main(argv=None):
                            default=65_536)
             p.add_argument("--family", choices=["sign", "subg"],
                            default="subg")
+        if name == "acceptance":
+            p.add_argument("--out-json", dest="out_json", default=None)
+        if name in ("grid", "grid-subg"):
+            p.add_argument("--n-hosts", dest="n_hosts", type=int, default=1,
+                           help="fan the grid out over this many worker "
+                                "processes (needs --out; see "
+                                "dpcorr.parallel.multihost)")
         p.set_defaults(fn=fn)
     args = ap.parse_args(argv)
     if args.platform:

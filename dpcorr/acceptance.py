@@ -1,0 +1,195 @@
+"""Acceptance campaign: B≥10⁶ CI-coverage at the BASELINE 1e-3 criterion.
+
+The reference validates itself statistically — empirical coverage against
+the 0.95 nominal line (vert-cor.R:687, ver-cor-subG.R:404) — but only at
+B=250 per design point (±2.8 pp of MC noise). BASELINE.json sets the
+acceptance bar at 1e-3, which needs B ≥ 10⁶ (MC SE of a 0.95 proportion at
+B=10⁶ is 2.2e-4). This module runs that campaign:
+
+- all four estimator families (NI/INT sign — SURVEY.md §2.2-A/B; NI/INT
+  sub-Gaussian — §2.2-C/D) at design points chosen to cross every CI
+  regime: the INT sign normal-vs-Laplace switch at √n·ε_r = 0.5
+  (vert-cor.R:294-296), the λ_r log-n cap branches (ver-cor-subG.R:3-7),
+  and both mixquant modes;
+- **det-vs-MC mixquant agreement**: the deterministic closed-form mixture
+  quantile replaces the reference's fresh 1000-draw MC per CI
+  (vert-cor.R:302, 44-56) — the one deliberate behavioral deviation
+  (SURVEY.md §7 hard parts). Both modes run on the SAME replication keys
+  (common random numbers), so their coverage difference isolates the CI
+  construction itself; the campaign asserts |cov_det − cov_mc| ≤ 1e-3.
+
+Summary sums are accumulated block-by-block on device (nothing bigger than
+one block of detail rows is ever resident), so B=10⁶ at n≤4000 fits any
+chip. Results persist as a JSON table (``benchmarks/results/``) consumed by
+``tests/test_acceptance.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from functools import partial
+from pathlib import Path
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from dpcorr import sim as sim_mod
+from dpcorr.sim import SimConfig
+from dpcorr.utils import rng
+
+#: fields summed per block; coverage is the acceptance-critical one
+_SUM_FIELDS = ("ni_cover", "int_cover", "ni_se2", "int_se2",
+               "ni_ci_len", "int_ci_len")
+
+
+@dataclasses.dataclass(frozen=True)
+class AccPoint:
+    """One acceptance design point; ``both_mixquant`` adds the MC-mode twin
+    run on identical rep keys. ``coverage_exempt`` maps method → reason for
+    points that exist to *cross a CI regime branch* whose construction is
+    not 0.95-calibrated there (the recorded coverage documents the actual
+    behavior; the nominal criterion is waived with the reason)."""
+
+    name: str
+    regime: str
+    kwargs: Mapping[str, Any]
+    both_mixquant: bool = False
+    coverage_exempt: Mapping[str, str] = dataclasses.field(
+        default_factory=dict)
+
+
+#: The campaign grid. n kept ≤ 4000 so the whole campaign is minutes, not
+#: hours; every CI regime the estimators can enter is crossed at least once.
+POINTS: tuple[AccPoint, ...] = (
+    AccPoint("sign_normal", "INT normal regime (√n·ε_r = 44.7 > 0.5), "
+             "mixquant width", {"n": 2000, "rho": 0.3, "eps1": 1.0,
+                                "eps2": 1.0}, both_mixquant=True),
+    AccPoint("sign_low_eps", "reference ε-pair (0.5, 0.5) ⇒ m=32 batches",
+             {"n": 2000, "rho": 0.0, "eps1": 0.5, "eps2": 0.5}),
+    AccPoint("sign_laplace", "INT Laplace regime (√400·0.02 = 0.4 < 0.5, "
+             "vert-cor.R:304-308)", {"n": 400, "rho": 0.3, "eps1": 1.0,
+                                     "eps2": 0.02},
+             coverage_exempt={"INT": "Laplace-regime width "
+                              "(2/(nε_r))·log(1/α) exceeds the ρ range at "
+                              "ε_r=0.02 — the CI clamps to [-1,1] and "
+                              "coverage saturates near 1, the "
+                              "construction's intended behavior at tiny ε "
+                              "(vert-cor.R:304-313)"}),
+    AccPoint("subg_factor", "subG families on bounded-factor DGP "
+             "(ver-cor-subG.R:283)", {"n": 4000, "rho": 0.5, "eps1": 1.0,
+                                      "eps2": 1.0, "dgp": "bounded_factor",
+                                      "use_subg": True}, both_mixquant=True),
+    AccPoint("subg_small_n", "λ_r log-n branch: log 300 < 6 "
+             "(ver-cor-subG.R:5)", {"n": 300, "rho": 0.4, "eps1": 2.0,
+                                    "eps2": 0.5, "dgp": "bounded_factor",
+                                    "use_subg": True},
+             coverage_exempt={"NI": "n=300 is 8× below the reference's "
+                              "own smallest subG grid point (n=2500, "
+                              "ver-cor-subG.R:245); the normal CI is not "
+                              "0.95-calibrated there — the point exists "
+                              "to cross the λ_r log-n branch",
+                              "INT": "same small-n regime; recorded "
+                              "coverage documents the construction's "
+                              "actual behavior"}),
+)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _block_sums(cfg_norho: SimConfig, keys: jax.Array, rho: jax.Array):
+    raw = sim_mod.chunked_vmap(
+        lambda k: sim_mod._one_rep(k, rho, cfg_norho), keys,
+        cfg_norho.chunk_size)
+    named = dict(zip(sim_mod.DETAIL_FIELDS, raw, strict=True))
+    return [jnp.sum(named[f], dtype=jnp.float64
+                    if jax.config.jax_enable_x64 else jnp.float32)
+            for f in _SUM_FIELDS]
+
+
+def _coverage_run(cfg: SimConfig, b: int, block: int) -> dict:
+    """Accumulate summary sums over ⌈b/block⌉ equal blocks of reps."""
+    n_blocks = -(-b // block)
+    b_run = n_blocks * block  # run whole blocks; record the exact count
+    master = rng.master_key(cfg.seed)
+    cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
+    totals = [0.0] * len(_SUM_FIELDS)
+    t0 = time.perf_counter()
+    for j in range(n_blocks):
+        keys = rng.rep_keys(rng.design_key(master, j), block)
+        sums = _block_sums(cfg_norho, keys, jnp.float32(cfg.rho))
+        for i, s in enumerate(sums):
+            totals[i] += float(s)
+    dt = time.perf_counter() - t0
+    out = {f: totals[i] / b_run for i, f in enumerate(_SUM_FIELDS)}
+    return {
+        "b": b_run,
+        "seconds": round(dt, 1),
+        "reps_per_sec": round(b_run / dt, 1),
+        "NI": {"coverage": out["ni_cover"], "mse": out["ni_se2"],
+               "ci_length": out["ni_ci_len"]},
+        "INT": {"coverage": out["int_cover"], "mse": out["int_se2"],
+                "ci_length": out["int_ci_len"]},
+    }
+
+
+def run_campaign(b: int = 1_000_000, block: int = 65_536,
+                 points: Sequence[AccPoint] = POINTS,
+                 chunk_size: int = 4096,
+                 out: str | Path | None = None) -> dict:
+    """Run the acceptance campaign; returns (and optionally writes) the
+    table with per-point coverage, MC standard errors, and the det-vs-MC
+    criterion evaluation."""
+    alpha = 0.05
+    block = min(block, b)
+    rows = []
+    for pt in points:
+        cfg = SimConfig(**pt.kwargs, alpha=alpha, chunk_size=chunk_size,
+                        mixquant_mode="det")
+        res_det = _coverage_run(cfg, b, block)
+        row = {"point": pt.name, "regime": pt.regime,
+               "config": dict(pt.kwargs), "det": res_det}
+        if pt.coverage_exempt:
+            row["coverage_exempt"] = dict(pt.coverage_exempt)
+        if pt.both_mixquant:
+            cfg_mc = dataclasses.replace(cfg, mixquant_mode="mc")
+            row["mc"] = _coverage_run(cfg_mc, b, block)
+            # mixquant enters only the INT CI widths (vert-cor.R:302,
+            # ver-cor-subG.R:99-101) — NI must agree exactly, INT at 1e-3
+            row["int_det_mc_diff"] = abs(row["det"]["INT"]["coverage"]
+                                         - row["mc"]["INT"]["coverage"])
+            row["ni_det_mc_diff"] = abs(row["det"]["NI"]["coverage"]
+                                        - row["mc"]["NI"]["coverage"])
+        rows.append(row)
+        if out:  # incremental: a killed campaign keeps finished points
+            # (.tmp so it can never match the test suite's *.json glob)
+            Path(out).parent.mkdir(parents=True, exist_ok=True)
+            Path(out).with_suffix(".partial.tmp").write_text(
+                json.dumps({"points": rows}, indent=1))
+
+    b_eff = rows[0]["det"]["b"]
+    mc_se = (0.95 * 0.05 / b_eff) ** 0.5
+    table = {
+        "criterion": "BASELINE.json: CI-coverage error <= 1e-3; "
+                     "det-vs-MC mixquant agreement <= 1e-3",
+        "b_per_run": b_eff,
+        "coverage_mc_se": mc_se,
+        "nominal": 1 - alpha,
+        "device": str(jax.devices()[0]),
+        "points": rows,
+        # NI diffs included: mixquant must not touch the NI CI at all, so
+        # any NI diff is a regression the criterion must catch
+        "det_mc_max_diff": max((max(r.get("int_det_mc_diff", 0.0),
+                                    r.get("ni_det_mc_diff", 0.0))
+                                for r in rows), default=0.0),
+    }
+    # same rep keys in both modes (common random numbers), so the diff is
+    # the CI construction itself — held to the bare criterion, no MC slack
+    table["det_mc_pass"] = bool(table["det_mc_max_diff"] <= 1e-3)
+    if out:
+        out = Path(out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(table, indent=1))
+        out.with_suffix(".partial.tmp").unlink(missing_ok=True)
+    return table

@@ -94,6 +94,13 @@ def _design_path(out_dir: Path, i: int) -> Path:
     return out_dir / f"design_{i:05d}.npz"
 
 
+def _stamp(cfg: SimConfig) -> str:
+    """Cache-validity stamp: the exact SimConfig plus the process PRNG
+    implementation — rbg- and threefry-generated results are different
+    numbers and a resume must never mix them."""
+    return f"{cfg!r}|prng={rng.impl_tag()}"
+
+
 def _run_point(gcfg: GridConfig, cfg: SimConfig, key, mesh):
     if gcfg.backend == "sharded":
         from dpcorr.parallel import run_detail_sharded
@@ -151,8 +158,8 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
         # aggregated RuntimeError is raised by run_grid at the end.
         try:
             cfg = gcfg.sim_config(rows[0]._asdict())
-            stamps = {int(r.i): repr(dataclasses.replace(cfg,
-                                                         rho=float(r.rho)))
+            stamps = {int(r.i): _stamp(dataclasses.replace(
+                          cfg, rho=float(r.rho)))
                       for r in rows}
             paths = {int(r.i): (_design_path(out_dir, int(r.i))
                                 if out_dir else None)
@@ -185,6 +192,12 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                         time.perf_counter() - t0))
 
     # Phase 2 — fetch in dispatch order; device-side failures surface here.
+    # Per-bucket wall times overlap under dispatch-ahead (a later bucket's
+    # fetch_s is near zero because its device work ran during earlier
+    # fetches), so throughput is reported only at grid level:
+    # ``grid_reps_per_sec``, total reps over the whole two-phase wall clock.
+    t_fetch0 = time.perf_counter()
+    total_ran = 0
     for rows, to_run, raw, stamps, paths, dispatch_s in pending:
         t0 = time.perf_counter()
         try:
@@ -206,14 +219,20 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             failures.extend((int(r.i), e) for r in rows
                             if int(r.i) not in details)
             continue
-        dt = dispatch_s + (time.perf_counter() - t0)
+        fetch_s = time.perf_counter() - t0
         ran = len(to_run)
+        total_ran += ran
         timings.append({
             "n": rows[0].n, "eps1": rows[0].eps1, "eps2": rows[0].eps2,
-            "points": len(rows), "points_run": ran, "seconds": dt,
-            "dispatch_s": dispatch_s,
-            "reps_per_sec": np.nan if not ran else ran * gcfg.b / dt,
+            "points": len(rows), "points_run": ran,
+            "seconds": dispatch_s + fetch_s,
+            "dispatch_s": dispatch_s, "fetch_s": fetch_s,
         })
+    wall = (time.perf_counter() - t_fetch0) + sum(
+        t[5] for t in pending)  # fetch phase + all dispatch times
+    grid_rps = np.nan if not total_ran else total_ran * gcfg.b / wall
+    for t in timings:
+        t["grid_reps_per_sec"] = grid_rps
     return details, timings, failures
 
 
@@ -262,9 +281,9 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
         t0 = time.perf_counter()
         try:
             cfg = gcfg.sim_config(row._asdict())
-            # Cache entries are valid only for the exact SimConfig that
-            # produced them: stamp it into the npz; mismatch = miss.
-            stamp = repr(cfg)
+            # Cache entries are valid only for the exact SimConfig (and
+            # PRNG impl) that produced them; mismatch = miss.
+            stamp = _stamp(cfg)
             detail = _load_cached(path, gcfg.resume, stamp)
             cached = detail is not None
             if not cached:

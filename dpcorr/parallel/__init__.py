@@ -8,12 +8,18 @@ invert, TPU-style (SURVEY.md §2.3):
   device mesh's ``rep`` axis (ICI);
 - **metric reductions** → XLA collectives (``psum``) instead of fork/pipe
   joins;
-- **design grid** → host-level loop over compiled kernels (DCN fan-out for
-  multi-host is a straight extension of the same mesh spec).
+- **design grid** → host-level loop over compiled kernels, or the
+  multi-host fan-out in :mod:`dpcorr.parallel.multihost` (hosts own whole
+  shape buckets; DCN carries nothing but the final file-system merge).
 """
 
 from dpcorr.parallel.mesh import rep_mesh, local_device_count  # noqa: F401
 from dpcorr.parallel.backend import (  # noqa: F401
     run_detail_sharded,
     run_summary_sharded,
+)
+from dpcorr.parallel.multihost import (  # noqa: F401
+    grid_slice,
+    run_grid_host,
+    run_grid_multihost,
 )

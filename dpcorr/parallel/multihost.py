@@ -1,0 +1,177 @@
+"""Multi-host grid fan-out (SURVEY.md §2.3: "grid axis → host-level scan or
+multi-host DCN fan-out").
+
+The design-grid axis is embarrassingly parallel across *hosts* exactly as it
+is across the reference's forked R processes (vert-cor.R:534-554) — no
+cross-host communication exists until the final merge, so the right
+transport is none at all: each host runs a deterministic slice of the grid
+into the shared per-point ``.npz`` cache (the same one the single-host
+driver uses for resume, ``grid.py``), and any host — or a later single-host
+run — assembles the full result from the cache. On a real multi-host TPU
+pod the hosts are the pod's workers and the shared cache is the job's
+filesystem (the pattern DCN-connected slices use for independent work);
+here the same code path is exercised with local worker subprocesses.
+
+Slicing is by *shape bucket*, not by design row: a host owns whole (n, ε)
+buckets (round-robin by bucket index) so the bucketed backend's
+one-kernel-per-bucket speedup survives the split and no two hosts ever
+compile the same kernel.
+
+Within each host, replications can additionally shard over that host's
+device mesh (``backend="sharded"``) — the two axes compose exactly like the
+reference's mclapply-over-grid × vectorized-reps split.
+"""
+
+from __future__ import annotations
+
+import json
+import dataclasses
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pandas as pd
+
+from dpcorr.grid import GridConfig, GridResult, run_grid
+
+__all__ = ["grid_slice", "run_grid_host", "run_grid_multihost"]
+
+
+def grid_slice(design: pd.DataFrame, host_id: int,
+               n_hosts: int) -> pd.DataFrame:
+    """The design rows host ``host_id`` owns: whole (n, ε) buckets,
+    round-robin by bucket order. Deterministic — every host computes the
+    same partition with no coordination."""
+    if not 0 <= host_id < n_hosts:
+        raise ValueError(f"host_id {host_id} not in [0, {n_hosts})")
+    keys = design[["n", "eps1", "eps2"]].drop_duplicates().reset_index(
+        drop=True)
+    mine = keys.iloc[host_id::n_hosts]
+    take = design.merge(mine, on=["n", "eps1", "eps2"], how="inner")
+    return take.sort_values("i").reset_index(drop=True)
+
+
+def run_grid_host(gcfg: GridConfig, host_id: int, n_hosts: int) -> int:
+    """Run this host's slice into the shared npz cache; returns the number
+    of design points this host owned. ``gcfg.out_dir`` must be set (it is
+    the only channel between hosts). ``gcfg.backend`` is honored — each
+    host runs its buckets through the bucketed kernel, or its rows through
+    the local/sharded per-point path (replications over this host's own
+    device mesh)."""
+    if not gcfg.out_dir:
+        raise ValueError("multi-host execution needs a shared out_dir")
+    design = gcfg.design_points()
+    mine = grid_slice(design, host_id, n_hosts)
+    if not len(mine):
+        return 0
+
+    import numpy as np
+
+    from dpcorr import grid as grid_mod
+    from dpcorr.utils import rng
+
+    out_dir = Path(gcfg.out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    # keys fold the *global* design index i, so the result is
+    # bit-identical to a single-host run of the full grid
+    master = rng.master_key(gcfg.seed)
+    if gcfg.backend == "bucketed":
+        _, _, failures = grid_mod._run_grid_bucketed(gcfg, mine, master,
+                                                     out_dir)
+        grid_mod._raise_if_failed(failures, len(mine))
+        return len(mine)
+
+    failures = []
+    for row in mine.itertuples(index=False):
+        i = int(row.i)
+        cfg = gcfg.sim_config(row._asdict())
+        stamp = grid_mod._stamp(cfg)
+        path = grid_mod._design_path(out_dir, i)
+        if grid_mod._load_cached(path, gcfg.resume, stamp) is not None:
+            continue
+        try:
+            res = grid_mod._run_point(gcfg, cfg,
+                                      rng.design_key(master, i), None)
+            np.savez(path, config_stamp=stamp,
+                     **{k: np.asarray(v) for k, v in res.detail.items()})
+        except Exception as e:
+            failures.append((i, e))
+    grid_mod._raise_if_failed(failures, len(mine))
+    return len(mine)
+
+
+def run_grid_multihost(gcfg: GridConfig, n_hosts: int = 2,
+                       python: str | None = None,
+                       platform: str | None = None) -> GridResult:
+    """Fan the grid out over ``n_hosts`` local worker processes, then
+    assemble the merged result from the shared cache.
+
+    Each worker is a fresh process (its own JAX runtime — the single-host
+    stand-in for a pod worker); the parent merges by re-running the grid
+    through the resume cache, which by then is fully populated, so the
+    merge never recomputes anything. ``platform`` forces each worker's JAX
+    platform (the site hook ignores JAX_PLATFORMS env, so workers apply it
+    via config.update — see ``_worker_main``); leave ``None`` on a real
+    pod, where each worker should claim its own chips.
+    """
+    if not gcfg.out_dir:
+        raise ValueError("multi-host execution needs a shared out_dir")
+    env = dict(os.environ)
+    if platform:
+        env["DPCORR_HOST_PLATFORM"] = platform
+    procs = []
+    for h in range(n_hosts):
+        spec = {"host_id": h, "n_hosts": n_hosts,
+                "gcfg": {f.name: getattr(gcfg, f.name)
+                         for f in dataclasses.fields(gcfg)}}
+        procs.append(subprocess.Popen(
+            [python or sys.executable, "-m", "dpcorr.parallel.multihost"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, env=env))
+        # deliver the spec at spawn time so hosts run concurrently; null
+        # the handle so the later communicate() won't flush a closed file
+        procs[-1].stdin.write(json.dumps(spec))
+        procs[-1].stdin.close()
+        procs[-1].stdin = None
+    errs = []
+    for h, p in enumerate(procs):
+        # communicate() drains stdout+stderr together — a worker that fills
+        # one pipe can never deadlock the join
+        out, err = p.communicate()
+        if p.returncode != 0:
+            tail = err.strip().splitlines()[-3:]
+            errs.append(f"host {h}: rc={p.returncode}: " + " | ".join(tail))
+    if errs:
+        raise RuntimeError(f"{len(errs)}/{n_hosts} hosts failed: "
+                           + "; ".join(errs)[:800])
+    # assemble from the (now complete) shared cache — pure cache hits even
+    # when the caller disabled resume for the compute itself
+    return run_grid(dataclasses.replace(gcfg, resume=True))
+
+
+def _worker_main() -> None:
+    # This environment's site hook force-selects the TPU platform at
+    # interpreter start regardless of JAX_PLATFORMS; a post-import
+    # config.update is the only override that sticks, so honor the
+    # requested worker platform here, before any backend initializes.
+    platform = os.environ.get("DPCORR_HOST_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    spec = json.loads(sys.stdin.read())
+    gd = spec["gcfg"]
+    # JSON round-trips tuples as lists; GridConfig fields tolerate sequences
+    gd["eps_pairs"] = tuple(tuple(p) for p in gd["eps_pairs"])
+    for k in ("n_grid", "rho_grid"):
+        gd[k] = tuple(gd[k])
+    if isinstance(gd.get("dgp_args"), list):
+        gd["dgp_args"] = tuple(gd["dgp_args"])
+    gcfg = GridConfig(**gd)
+    owned = run_grid_host(gcfg, spec["host_id"], spec["n_hosts"])
+    print(json.dumps({"host_id": spec["host_id"], "points": owned}))
+
+
+if __name__ == "__main__":
+    _worker_main()

@@ -19,6 +19,7 @@ backend.
 
 from __future__ import annotations
 
+import os
 import zlib
 
 import jax
@@ -28,9 +29,27 @@ import jax.numpy as jnp
 MASTER_SEED: int = 2025
 
 
-def master_key(seed: int = MASTER_SEED) -> jax.Array:
-    """Root of the key-tree. Replaces ``set.seed(MASTER_SEED)``."""
-    return jax.random.key(seed)
+def master_key(seed: int = MASTER_SEED, impl: str | None = None) -> jax.Array:
+    """Root of the key-tree. Replaces ``set.seed(MASTER_SEED)``.
+
+    ``impl`` selects the PRNG implementation for the whole tree below this
+    root (everything downstream is impl-generic ``fold_in``): the default
+    ``threefry2x32`` is the bit-reproducibility contract; ``"rbg"`` maps to
+    the TPU hardware generator and is substantially cheaper in
+    PRNG-dominated kernels (the bench's ``xla_rbg`` path), at the cost of
+    weaker stream-independence guarantees — acceptance for it is
+    statistical, like everything else (SURVEY.md §5 RNG). The
+    ``DPCORR_PRNG`` env var sets a default for the whole process.
+    """
+    impl = impl or os.environ.get("DPCORR_PRNG") or None
+    return jax.random.key(seed, impl=impl)
+
+
+def impl_tag() -> str:
+    """The process-default PRNG impl, for result-cache stamps: results from
+    different implementations are different numbers and must never be mixed
+    by a resume (grid.py stamps npz files with this)."""
+    return os.environ.get("DPCORR_PRNG") or "threefry2x32"
 
 
 def design_key(key: jax.Array, design_index: int | jax.Array) -> jax.Array:

@@ -1,0 +1,81 @@
+"""Acceptance-campaign tests (BASELINE 1e-3 criterion; VERDICT r1 item 3).
+
+Two layers:
+
+- smoke: the campaign machinery end-to-end at tiny B (block sums match a
+  direct run_sim_one summary on the same config shape);
+- table: the checked-in B≥10⁶ campaign result
+  (``benchmarks/results/acceptance_*.json``) must satisfy the criteria —
+  det-vs-MC mixquant agreement ≤ 1e-3 and coverage within the recorded MC
+  envelope of nominal. Regenerating the table is opt-in
+  (``python -m dpcorr acceptance``, minutes on TPU / hours on CPU).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from dpcorr.acceptance import POINTS, AccPoint, run_campaign
+
+RESULTS_DIR = Path(__file__).parent.parent / "benchmarks" / "results"
+
+
+def test_campaign_smoke():
+    pts = (AccPoint("smoke_sign", "smoke",
+                    {"n": 300, "rho": 0.3, "eps1": 1.0, "eps2": 1.0},
+                    both_mixquant=True),)
+    table = run_campaign(b=512, block=256, points=pts, chunk_size=256)
+    [row] = table["points"]
+    assert row["det"]["b"] == 512
+    for meth in ("NI", "INT"):
+        assert 0.0 <= row["det"][meth]["coverage"] <= 1.0
+        assert row["det"][meth]["ci_length"] > 0.0
+    # mixquant only enters the INT CI: NI must agree exactly under
+    # common random numbers
+    assert row["ni_det_mc_diff"] == 0.0
+    assert "int_det_mc_diff" in row
+
+
+def test_campaign_points_cover_regimes():
+    """The campaign grid must keep crossing every CI regime: both INT sign
+    regimes (√n·ε_r around 0.5, vert-cor.R:294-296), both estimator
+    families, both mixquant modes."""
+    regimes = {p.name: p for p in POINTS}
+    sign = [p for p in POINTS if not p.kwargs.get("use_subg")]
+    subg = [p for p in POINTS if p.kwargs.get("use_subg")]
+    assert sign and subg
+    assert any((p.kwargs["n"] ** 0.5
+                * min(p.kwargs["eps1"], p.kwargs["eps2"])) < 0.5
+               for p in sign), "no Laplace-regime point"
+    assert any((p.kwargs["n"] ** 0.5
+                * min(p.kwargs["eps1"], p.kwargs["eps2"])) > 0.5
+               for p in sign), "no normal-regime point"
+    assert any(p.both_mixquant for p in POINTS)
+    assert "sign_laplace" in regimes
+
+
+@pytest.mark.parametrize("path", sorted(RESULTS_DIR.glob("acceptance_*.json"))
+                         or [pytest.param(None, marks=pytest.mark.skip(
+                             reason="no checked-in campaign table yet"))])
+def test_checked_in_table_meets_criteria(path):
+    table = json.loads(Path(path).read_text())
+    assert table["b_per_run"] >= 1_000_000
+    assert table["det_mc_pass"], (
+        f"det-vs-MC mixquant coverage diff {table['det_mc_max_diff']} "
+        "exceeds 1e-3")
+    # coverage itself: every family/point within 1e-3 + 3.5 MC SE of the
+    # recorded nominal (the asymptotic construction's finite-n bias is
+    # part of the reference's own behavior; the sign families at these n
+    # are well inside it — see the table's regime notes otherwise)
+    envelope = 1e-3 + 3.5 * table["coverage_mc_se"]
+    for row in table["points"]:
+        for meth in ("NI", "INT"):
+            cov = row["det"][meth]["coverage"]
+            if row.get("coverage_exempt", {}).get(meth):
+                continue
+            assert abs(cov - table["nominal"]) <= max(
+                envelope, row.get("coverage_tol", 0.0)), (
+                f"{row['point']}/{meth}: coverage {cov}")

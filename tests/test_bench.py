@@ -34,26 +34,76 @@ def _run_main(monkeypatch, capsys, responses):
     return json.loads(line), calls
 
 
-GOOD = {"metric": bench.METRIC, "value": 5000.0, "unit": "reps/sec/chip",
-        "vs_baseline": 1.2, "detail": {"path": "pallas"}}
+def _good():
+    return {"metric": bench.METRIC, "value": 5000.0,
+            "unit": "reps/sec/chip", "vs_baseline": 1.2,
+            "detail": {"path": "xla",
+                       "paths": {"xla": {"reps_per_sec": 5000.0,
+                                         "mse": 0.006, "coverage": 0.95,
+                                         "ci_length": 0.30}}}}
+
+
+def _pallas(rps=9000.0, coverage=0.95, mse=0.006, ci_length=0.30):
+    return {"metric": bench.METRIC, "value": rps, "unit": "reps/sec/chip",
+            "vs_baseline": 0.0,
+            "detail": {"paths": {"pallas": {"reps_per_sec": rps, "mse": mse,
+                                            "coverage": coverage,
+                                            "ci_length": ci_length}}}}
+
+
 CPU = {"metric": bench.METRIC, "value": 1700.0, "unit": "reps/sec/chip",
        "vs_baseline": 0.41, "detail": {"path": "xla"}}
 
 
 def test_tpu_first_try(monkeypatch, capsys):
-    out, calls = _run_main(monkeypatch, capsys, [(dict(GOOD), None)])
-    assert calls == ["tpu"]
-    assert out["value"] == 5000.0
+    out, calls = _run_main(monkeypatch, capsys, [
+        (_good(), None),
+        (_pallas(), None),
+    ])
+    assert calls == ["tpu", "tpu-pallas"]
+    # faster sane pallas result takes the headline
+    assert out["value"] == 9000.0
+    assert out["detail"]["path"] == "pallas"
     assert "degraded" not in out["detail"]
     assert "attempts" not in out["detail"]
+
+
+def test_pallas_probe_failure_keeps_xla_number(monkeypatch, capsys):
+    """A hung/killed pallas probe must never cost the XLA measurement."""
+    out, calls = _run_main(monkeypatch, capsys, [
+        (_good(), None),
+        (None, "tpu-pallas worker: timeout after 465s"),
+    ])
+    assert calls == ["tpu", "tpu-pallas"]
+    assert out["value"] == 5000.0
+    assert out["detail"]["path"] == "xla"
+    assert "timeout" in out["detail"]["pallas_skipped"]
+
+
+def test_pallas_insane_stats_rejected(monkeypatch, capsys):
+    out, calls = _run_main(monkeypatch, capsys, [
+        (_good(), None),
+        (_pallas(coverage=0.70), None),  # NaN-ish kernel: wrong coverage
+    ])
+    assert out["value"] == 5000.0
+    assert out["detail"]["path"] == "xla"
+    assert "sanity" in out["detail"]["pallas_skipped"]
+
+
+def test_skip_pallas_env(monkeypatch, capsys):
+    monkeypatch.setenv("DPCORR_BENCH_SKIP_PALLAS", "1")
+    out, calls = _run_main(monkeypatch, capsys, [(_good(), None)])
+    assert calls == ["tpu"]
+    assert "DPCORR_BENCH_SKIP_PALLAS" in out["detail"]["pallas_skipped"]
 
 
 def test_tpu_retry_succeeds(monkeypatch, capsys):
     out, calls = _run_main(monkeypatch, capsys, [
         (None, "tpu worker: timeout after 480s"),
-        (dict(GOOD), None),
+        (_good(), None),
+        (None, "tpu-pallas worker: rc=1: boom"),
     ])
-    assert calls == ["tpu", "tpu"]
+    assert calls == ["tpu", "tpu", "tpu-pallas"]
     assert out["value"] == 5000.0
     assert out["detail"]["attempts"] == ["tpu worker: timeout after 480s"]
 

@@ -75,6 +75,21 @@ class TestGoldenCoverage:
             assert lo <= cov <= hi, (meth, rho, cov)
             assert abs(res.summary[meth]["bias"]) < 0.06
 
+    def test_sign_pipeline_rbg_prng(self):
+        """The rbg key implementation (the bench's cheap-PRNG TPU variant)
+        must produce the same statistics as threefry — acceptance is
+        statistical, like the R→JAX RNG switch itself (SURVEY.md §5)."""
+        from dpcorr.utils import rng
+
+        b = 400
+        cfg = SimConfig(n=2000, rho=0.5, eps1=1.0, eps2=1.0, b=b)
+        res = run_sim_one(cfg, key=rng.master_key(impl="rbg"))
+        lo, hi = _coverage_bounds(b)
+        for meth in ("NI", "INT"):
+            cov = res.summary[meth]["coverage"]
+            assert lo <= cov <= hi, (meth, cov)
+            assert abs(res.summary[meth]["bias"]) < 0.06
+
     def test_subg_pipeline_bounded_factor(self):
         b = 400
         cfg = SimConfig(n=4000, rho=0.5, eps1=1.0, eps2=1.0, b=b,

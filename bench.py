@@ -241,9 +241,11 @@ def _run_worker(mode: str, timeout_s: float, budget_s: float):
     """Spawn a worker; return (parsed JSON, None) or (None, error string).
 
     Workers get their own process group and the whole group is killed on
-    timeout — the tpu worker spawns a tpu-pallas *grandchild*, and an
-    orphaned grandchild hung in a Mosaic compile would keep the exclusive
-    TPU client alive and wedge every retry.
+    timeout, so nothing a hung worker leaves behind (helper threads,
+    library-spawned children) can keep the exclusive TPU client alive and
+    wedge the next attempt. The tpu-pallas probe runs as a *sibling*
+    worker via this same path after the tpu worker exits (see
+    ``_merge_pallas``), never nested inside it.
     """
     cmd = [sys.executable, os.path.abspath(__file__),
            "--worker", mode, "--budget", str(budget_s)]

@@ -155,10 +155,10 @@ def cmd_stress(args):
 def cmd_acceptance(args):
     """B≥10⁶ coverage campaign at the BASELINE 1e-3 criterion
     (vert-cor.R:687 oracle; see dpcorr.acceptance)."""
-    from dpcorr.acceptance import run_campaign
+    from dpcorr import acceptance
 
-    table = run_campaign(b=args.b or 1_000_000, out=args.out_json)
-    print(json.dumps(table, indent=1))
+    table = acceptance.run_campaign(b=args.b or 1_000_000, out=args.out_json)
+    print(acceptance.dumps(table))
 
 
 def cmd_hrs_sweep(args):

@@ -162,12 +162,11 @@ def _worker_main() -> None:
         jax.config.update("jax_platforms", platform)
     spec = json.loads(sys.stdin.read())
     gd = spec["gcfg"]
-    # JSON round-trips tuples as lists; GridConfig fields tolerate sequences
+    # JSON round-trips tuples as lists; GridConfig fields tolerate
+    # sequences, and SimConfig.__post_init__ freezes dgp_args recursively
     gd["eps_pairs"] = tuple(tuple(p) for p in gd["eps_pairs"])
     for k in ("n_grid", "rho_grid"):
         gd[k] = tuple(gd[k])
-    if isinstance(gd.get("dgp_args"), list):
-        gd["dgp_args"] = tuple(gd["dgp_args"])
     gcfg = GridConfig(**gd)
     owned = run_grid_host(gcfg, spec["host_id"], spec["n_hosts"])
     print(json.dumps({"host_id": spec["host_id"], "points": owned}))

@@ -68,11 +68,17 @@ class SimConfig:
 
     def __post_init__(self):
         # The config is a static jit argument, so it must be hashable:
-        # normalize dgp_args (dict or items) to a sorted items tuple.
-        args = self.dgp_args
-        if isinstance(args, Mapping):
-            args = tuple(sorted(args.items()))
-        object.__setattr__(self, "dgp_args", tuple(args))
+        # normalize dgp_args (dict or items) to a sorted items tuple,
+        # recursively — nested lists arrive from JSON round-trips
+        # (multihost worker specs, R bridge) and must freeze too.
+        def freeze(v):
+            if isinstance(v, Mapping):
+                return tuple(sorted((k, freeze(x)) for k, x in v.items()))
+            if isinstance(v, (list, tuple)):
+                return tuple(freeze(x) for x in v)
+            return v
+
+        object.__setattr__(self, "dgp_args", freeze(self.dgp_args))
 
     def dgp_fn(self) -> Callable:
         fn = dgp_mod.DGPS[self.dgp] if isinstance(self.dgp, str) else self.dgp

@@ -116,6 +116,19 @@ POINTS: tuple[AccPoint, ...] = (
              "intrinsic under-coverage at n=4000 — the faithful MC mode "
              "measures 0.9397 at B=10⁶, so this is the reference's own "
              "finite-n behavior, reproduced (det is closer to nominal)"),
+    AccPoint("subg_real", "real-data (v2) estimator pair: randomized "
+             "batches + k≥2 fallback, receiver-λ from noise, sampling-only "
+             "se, δ_clip=1/n (real-data-sims.R:115-252)",
+             {"n": 4000, "rho": 0.5, "eps1": 1.0, "eps2": 1.0,
+              "dgp": "bounded_factor", "use_subg": True,
+              "subg_variant": "real"},
+             coverage_tol=0.015,
+             tol_reason="the v2 INT construction pairs a sampling-only se "
+             "(real-data-sims.R:237-242) with the much larger "
+             "lambda_receiver_from_noise product clip (≈194 vs the grid "
+             "rule's 30 here) — measured ≈0.946 at b=4096 during design; "
+             "like the grid variant, its finite-n coverage sits ~1pp "
+             "under nominal, the construction's own behavior, reproduced"),
     AccPoint("subg_small_n", "λ_r log-n branch: log 300 < 6 "
              "(ver-cor-subG.R:5)", {"n": 300, "rho": 0.4, "eps1": 2.0,
                                     "eps2": 0.5, "dgp": "bounded_factor",
@@ -230,16 +243,19 @@ def build_table(rows: list[dict], alpha: float = 0.05,
     criterion fails.
     """
     b_eff = rows[0]["det"]["b"]
-    mc_se = (0.95 * 0.05 / b_eff) ** 0.5
     nominal = 1 - alpha
+    mc_se = (nominal * alpha / b_eff) ** 0.5
     # NI diffs included: mixquant must not touch the NI CI at all, so any
     # NI diff is a regression the criterion must catch
     det_mc_max = max((max(r.get("int_det_mc_diff", 0.0),
                           r.get("ni_det_mc_diff", 0.0))
                       for r in rows), default=0.0)
     compared = [r for r in rows if "mc" in r]
+    # the attribution escape hatch is for the INT-only quantile-bias gap;
+    # it must never excuse an NI diff (mixquant is not in the NI CI)
     det_closer = all(
-        abs(r["det"]["INT"]["coverage"] - nominal)
+        r.get("ni_det_mc_diff", 0.0) <= 1e-3
+        and abs(r["det"]["INT"]["coverage"] - nominal)
         <= abs(r["mc"]["INT"]["coverage"] - nominal) + mc_se
         for r in compared)
     table = {

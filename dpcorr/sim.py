@@ -52,6 +52,12 @@ class SimConfig:
     dgp: str | Callable = "gaussian"
     dgp_args: Any = ()
     use_subg: bool = False
+    #: which subG estimator pair runs under ``use_subg``: "grid" is the
+    #: synthetic-grid pair (sequential batches, se with Laplace term —
+    #: ver-cor-subG.R:25-108); "real" is the real-data pair (randomized
+    #: batches + k≥2 fallback, receiver-λ from noise, sampling-only se,
+    #: δ_clip=1/n — real-data-sims.R:115-252)
+    subg_variant: str = "grid"
     #: sub-Gaussian norm parameters feeding the λ_n clip rules
     #: (ver-cor-subG.R:28-31); ignored by the sign estimators
     eta1: float = 1.0
@@ -67,6 +73,15 @@ class SimConfig:
     stream_n_chunk: int | None = None
 
     def __post_init__(self):
+        if self.subg_variant not in ("grid", "real"):
+            raise ValueError(f"subg_variant must be 'grid' or 'real', "
+                             f"got {self.subg_variant!r}")
+        if self.stream_n_chunk and self.use_subg \
+                and self.subg_variant == "real":
+            # randomized batch assignment needs a global permutation of all
+            # n rows — fundamentally not n-blockable (streaming.py)
+            raise ValueError("subg_variant='real' is not available on the "
+                             "streaming path")
         # The config is a static jit argument, so it must be hashable:
         # normalize dgp_args (dict or items) to a sorted items tuple,
         # recursively — nested lists arrive from JSON round-trips
@@ -109,12 +124,15 @@ def _one_rep(key: jax.Array, rho: jax.Array, cfg: SimConfig) -> tuple:
     x, y = xy[:, 0], xy[:, 1]
 
     if cfg.use_subg:
+        real = cfg.subg_variant == "real"
         ni = correlation_ni_subg(rng.stream(key, "ni"), x, y, cfg.eps1,
                                  cfg.eps2, eta1=cfg.eta1, eta2=cfg.eta2,
-                                 alpha=cfg.alpha)
+                                 alpha=cfg.alpha,
+                                 randomize_batches=real,
+                                 enforce_min_k=real)
         it = ci_int_subg(rng.stream(key, "int"), x, y, cfg.eps1, cfg.eps2,
                          eta1=cfg.eta1, eta2=cfg.eta2,
-                         alpha=cfg.alpha, variant="grid",
+                         alpha=cfg.alpha, variant=cfg.subg_variant,
                          mixquant_mode=cfg.mixquant_mode)
     else:
         ni = ci_ni_signbatch(rng.stream(key, "ni"), x, y, cfg.eps1, cfg.eps2,

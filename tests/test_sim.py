@@ -75,6 +75,29 @@ class TestGoldenCoverage:
             assert lo <= cov <= hi, (meth, rho, cov)
             assert abs(res.summary[meth]["bias"]) < 0.06
 
+    def test_subg_real_variant_pipeline(self):
+        """subg_variant='real' routes the v2 estimator pair (randomized
+        batches + enforce_min_k, ci_int_subg variant='real') through the
+        simulator; coverage stays statistically sane and differs from the
+        grid variant (different construction)."""
+        b = 400
+        base = dict(n=2000, rho=0.5, eps1=1.0, eps2=1.0, b=b,
+                    dgp="bounded_factor", use_subg=True)
+        real = run_sim_one(SimConfig(**base, subg_variant="real"))
+        grid = run_sim_one(SimConfig(**base))
+        lo, hi = _coverage_bounds(b, z=4.0)
+        assert lo <= real.summary["NI"]["coverage"] <= hi
+        # different constructions: the v2 receiver clip
+        # (lambda_receiver_from_noise ≈ 194 at these params vs the grid
+        # rule's 30) reshapes the INT CI — widths must differ materially
+        r_len = real.summary["INT"]["ci_length"]
+        g_len = grid.summary["INT"]["ci_length"]
+        assert abs(r_len - g_len) > 0.05 * g_len
+        with pytest.raises(ValueError, match="streaming"):
+            SimConfig(**base, subg_variant="real", stream_n_chunk=512)
+        with pytest.raises(ValueError, match="subg_variant"):
+            SimConfig(**base, subg_variant="bogus")
+
     def test_sign_pipeline_rbg_prng(self):
         """The rbg key implementation (the bench's cheap-PRNG TPU variant)
         must produce the same statistics as threefry — acceptance is

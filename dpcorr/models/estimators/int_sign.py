@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from dpcorr.models.estimators.common import CorrResult
 from dpcorr.ops.mixquant import mixquant
 from dpcorr.ops.noise import laplace
-from dpcorr.ops.standardize import priv_standardize
+from dpcorr.ops.standardize import priv_center
 from dpcorr.utils.rng import stream
 
 
@@ -98,8 +98,10 @@ def ci_int_signflip(key: jax.Array, x: jax.Array, y: jax.Array,
     n = x.shape[0]
     if normalise:
         l_clip = jnp.sqrt(2.0 * jnp.log(float(n)))
-        x = priv_standardize(stream(key, "int_sign/std_x"), x, eps1, l_clip)
-        y = priv_standardize(stream(key, "int_sign/std_y"), y, eps2, l_clip)
+        # center-only: this estimator consumes signs, and
+        # sign((x−μ)/σ) ≡ sign(x−μ) — see priv_center
+        x = priv_center(stream(key, "int_sign/std_x"), x, eps1, l_clip)
+        y = priv_center(stream(key, "int_sign/std_y"), y, eps2, l_clip)
 
     eps_s, eps_r = max(eps1, eps2), min(eps1, eps2)
     rho_hat = correlation_int_signflip(stream(key, "int_sign/est"), x, y, eps1, eps2)

@@ -25,7 +25,7 @@ from dpcorr.models.estimators.common import (
     sample_sd,
 )
 from dpcorr.ops.noise import laplace
-from dpcorr.ops.standardize import priv_standardize
+from dpcorr.ops.standardize import priv_center
 from dpcorr.utils.rng import stream
 
 
@@ -54,15 +54,19 @@ def ci_ni_signbatch(key: jax.Array, x: jax.Array, y: jax.Array,
     """Estimate + CI (vert-cor.R:204-255).
 
     With ``normalise``, the *raw* values (not the signs) are privately
-    standardized first with clip L = √(2·log n), spending ε₁/ε₂ again —
-    faithful to the reference's budget accounting (vert-cor.R:211-215).
+    centered first with clip L = √(2·log n), spending ε₁/ε₂ again exactly
+    as the reference's full standardization does (vert-cor.R:211-215) —
+    the σ division is dropped because this estimator consumes only signs
+    and sign((x−μ)/σ) ≡ sign(x−μ); see :func:`priv_center`.
     """
     n = x.shape[0]
     m, k = batch_geometry(n, eps1, eps2)
     if normalise:
         l_clip = jnp.sqrt(2.0 * jnp.log(float(n)))
-        x = priv_standardize(stream(key, "ni_sign/std_x"), x, eps1, l_clip)
-        y = priv_standardize(stream(key, "ni_sign/std_y"), y, eps2, l_clip)
+        # center-only: this estimator consumes signs, and
+        # sign((x−μ)/σ) ≡ sign(x−μ) — see priv_center
+        x = priv_center(stream(key, "ni_sign/std_x"), x, eps1, l_clip)
+        y = priv_center(stream(key, "ni_sign/std_y"), y, eps2, l_clip)
 
     xt, yt = _noisy_batch_products(key, x, y, eps1, eps2, m, k)
     tj = m * xt * yt  # Sec 3.1 eq. (2) components (vert-cor.R:233)

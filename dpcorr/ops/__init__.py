@@ -14,6 +14,8 @@ from dpcorr.ops.lambdas import (  # noqa: F401
 from dpcorr.ops.mixquant import mixquant, mixquant_mc  # noqa: F401
 from dpcorr.ops.standardize import (  # noqa: F401
     priv_standardize,
+    priv_center,
+    priv_mean_from_sum,
     dp_mean,
     dp_second_moment,
     dp_sd,

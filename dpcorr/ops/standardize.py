@@ -33,13 +33,24 @@ def priv_moments_from_sums(key: jax.Array, s1, s2, n: int, eps_norm, l_raw,
     scales and key addresses can never diverge between them.
     """
     eps_half = eps_norm / 2.0
-    # streams are namespaced per primitive so two different primitives
-    # handed the same key never draw correlated noise
-    mu_priv = s1 / n + laplace(stream(key, "priv_standardize/mu"), (),
-                               2.0 * l_raw / (n * eps_half))
+    mu_priv = priv_mean_from_sum(key, s1, n, eps_norm, l_raw)
     m2_priv = s2 / n + laplace(stream(key, "priv_standardize/m2"), (),
                                2.0 * l_raw * l_raw / (n * eps_half))
     return mu_priv, jnp.maximum(m2_priv - mu_priv * mu_priv, var_floor)
+
+
+def priv_mean_from_sum(key: jax.Array, s1, n: int, eps_norm, l_raw):
+    """The DP-mean half of ``priv_moments_from_sums`` alone: ε/2 of the
+    standardization budget, sensitivity 2L/n (vert-cor.R:337-339).
+
+    Streams are namespaced per primitive so two different primitives
+    handed the same key never draw correlated noise; the ``mu`` address is
+    shared with ``priv_moments_from_sums``, so a center-only consumer sees
+    the *bit-identical* μ_priv the full standardizer would compute.
+    """
+    eps_half = eps_norm / 2.0
+    return s1 / n + laplace(stream(key, "priv_standardize/mu"), (),
+                            2.0 * l_raw / (n * eps_half))
 
 
 def priv_standardize(key: jax.Array, vec: jax.Array, eps_norm, l_raw=6.0,
@@ -51,6 +62,20 @@ def priv_standardize(key: jax.Array, vec: jax.Array, eps_norm, l_raw=6.0,
     mu_priv, var_priv = priv_moments_from_sums(
         key, jnp.sum(x), jnp.sum(x * x), n, eps_norm, l_raw, var_floor)
     return (x - mu_priv) / jnp.sqrt(var_priv)
+
+
+def priv_center(key: jax.Array, vec: jax.Array, eps_norm,
+                l_raw=6.0) -> jax.Array:
+    """Center-only ``priv_standardize`` for sign-only consumers: since
+    σ_priv > 0, sign((x−μ_priv)/σ_priv) ≡ sign(x−μ_priv), so the second
+    moment — whose ε/2 the construction's budget accounting still spends
+    (vert-cor.R:340-343) — never needs materializing. Saves the Σx²
+    reduction and the n-length divide per call; μ_priv is bit-identical to
+    the full standardizer's (same ``mu`` stream address). The fused Pallas
+    kernel applies the same identity on-chip (pallas_ni.py)."""
+    n = vec.shape[0]
+    x = clip_sym(vec, l_raw)
+    return x - priv_mean_from_sum(key, jnp.sum(x), n, eps_norm, l_raw)
 
 
 def dp_mean(key: jax.Array, x: jax.Array, lo, hi, eps) -> jax.Array:

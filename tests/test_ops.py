@@ -170,3 +170,21 @@ class TestStandardize:
     def test_standardize_dp_sd_floor(self):
         z = standardize_dp(jnp.array([1.0]), 0.0, 0.0, -5.0, 5.0)
         assert np.isfinite(float(z[0]))
+
+
+class TestPrivCenter:
+    def test_sign_identity_with_full_standardize(self):
+        """priv_center is the sign-only shortcut: same key ⇒ identical
+        signs as the full priv_standardize (σ>0 never flips a sign), which
+        is what makes the estimator switch output-identical."""
+        from dpcorr.models.dgp import gen_gaussian
+        from dpcorr.ops import priv_center, priv_standardize
+
+        key = rng.master_key(77)
+        xy = gen_gaussian(rng.stream(key, "d"), 4096, jnp.float32(0.3))
+        x = 2.0 + 1.7 * xy[:, 0]
+        kz = rng.stream(key, "z")
+        full = priv_standardize(kz, x, 1.0, 2.5)
+        cent = priv_center(kz, x, 1.0, 2.5)
+        np.testing.assert_array_equal(np.sign(np.asarray(full)),
+                                      np.sign(np.asarray(cent)))

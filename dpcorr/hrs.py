@@ -238,7 +238,19 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
 
     master = rng.master_key(cfg.seed)
     arrays = (std.age_z, std.bmi_z)
-    runs = []
+
+    # Dispatch-ahead over the ε axis (the grid backend's pattern): each ε
+    # has its own batch geometry, so each compiles its own kernel — by
+    # dispatching every ε before the first fetch, ε_{j+1}'s host-side
+    # compile overlaps ε_j's device execution instead of serializing
+    # 23 compile+run cycles (real-data-sims.R:345-448 is fully serial).
+    # receiver λs fetched BEFORE the first kernel dispatch: float() of a
+    # device value after a dispatch would queue behind the in-flight sweep
+    # kernel and re-serialize the pipeline
+    lam_recvs = [float(lambda_receiver_from_noise(std.lam_age, std.lam_bmi,
+                                                  float(e), delta))
+                 for e in eps_grid]
+    pending = []
     for eps_idx, eps in enumerate(eps_grid):
         eps = float(eps)
         # per-(method, ε, rep) keys — the key-tree analogue of the
@@ -246,13 +258,14 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
         k_eps = rng.design_key(master, eps_idx)
         keys_ni = rng.rep_keys(rng.stream(k_eps, "hrs/sweep/ni"), reps)
         keys_int = rng.rep_keys(rng.stream(k_eps, "hrs/sweep/int"), reps)
-        lam_recv = float(lambda_receiver_from_noise(std.lam_age, std.lam_bmi,
-                                                    eps, delta))
+        pending.append((eps, _sweep_eps_kernel(
+            keys_ni, keys_int, arrays, eps, std.lam_age, std.lam_bmi,
+            lam_recvs[eps_idx], delta, cfg.alpha, cfg.mixquant_mode)))
+
+    runs = []
+    for eps, out in pending:
         (ni_hat, ni_lo, ni_hi), (int_hat, int_lo, int_hi) = jax.tree.map(
-            np.asarray,
-            _sweep_eps_kernel(keys_ni, keys_int, arrays, eps, std.lam_age,
-                              std.lam_bmi, lam_recv, delta, cfg.alpha,
-                              cfg.mixquant_mode))
+            np.asarray, out)
         for meth, hat, lo, hi in (("NI", ni_hat, ni_lo, ni_hi),
                                   ("INT", int_hat, int_lo, int_hi)):
             runs.append(pd.DataFrame({

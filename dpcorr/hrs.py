@@ -258,6 +258,9 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
         k_eps = rng.design_key(master, eps_idx)
         keys_ni = rng.rep_keys(rng.stream(k_eps, "hrs/sweep/ni"), reps)
         keys_int = rng.rep_keys(rng.stream(k_eps, "hrs/sweep/int"), reps)
+        if progress:
+            print(f"eps={eps:.2f}: dispatched "
+                  f"({eps_idx + 1}/{len(eps_grid)})", flush=True)
         pending.append((eps, _sweep_eps_kernel(
             keys_ni, keys_int, arrays, eps, std.lam_age, std.lam_bmi,
             lam_recvs[eps_idx], delta, cfg.alpha, cfg.mixquant_mode)))

@@ -177,8 +177,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(prog="dpcorr")
     sub = ap.add_subparsers(dest="cmd", required=True)
     backends_by_cmd = {
-        "grid": ("local", "sharded", "bucketed"),
-        "grid-subg": ("local", "sharded", "bucketed"),
+        "grid": ("local", "sharded", "bucketed", "bucketed-sharded"),
+        "grid-subg": ("local", "sharded", "bucketed", "bucketed-sharded"),
         "stress": ("local", "sharded"),
     }
     for name, fn in [("demo", cmd_demo), ("demo-subg", cmd_demo_subg),

@@ -54,7 +54,11 @@ class GridConfig:
     mixquant_mode: str = "det"
     seed: int = rng.MASTER_SEED
     chunk_size: int = 4096
-    backend: str = "local"  # "local" | "sharded" | "bucketed"
+    #: "local" | "sharded" (replications of each point over the mesh) |
+    #: "bucketed" (one kernel per (n, ε) shape bucket) |
+    #: "bucketed-sharded" (bucket kernels with the flat point×rep axis
+    #: split across the mesh — both parallel axes composed)
+    backend: str = "local"
     out_dir: str | None = None
     resume: bool = True
 
@@ -128,7 +132,7 @@ def _raise_if_failed(failures, n_points: int):
 
 
 def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
-                       out_dir: Path | None):
+                       out_dir: Path | None, mesh=None):
     """Grid-axis vectorization: all design points of one (n, ε) compile
     bucket run as a single kernel invocation over flattened
     (point × replication) pairs — ρ is traced (sim._run_detail_flat), so the
@@ -180,7 +184,13 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                 rhos = jnp.repeat(jnp.asarray([r.rho for r in to_run],
                                               jnp.float32), gcfg.b)
                 cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
-                raw = sim_mod._run_detail_flat(cfg_norho, keys, rhos)
+                if gcfg.backend == "bucketed-sharded":
+                    from dpcorr.parallel import run_detail_flat_sharded
+
+                    raw = run_detail_flat_sharded(cfg_norho, keys, rhos,
+                                                  mesh=mesh)
+                else:
+                    raw = sim_mod._run_detail_flat(cfg_norho, keys, rhos)
         except Exception as e:
             log.error("bucket (n=%d eps=(%.2f,%.2f), %d points) failed "
                       "at dispatch: %s",
@@ -263,9 +273,9 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
     if out_dir:
         out_dir.mkdir(parents=True, exist_ok=True)
 
-    if gcfg.backend == "bucketed":
+    if gcfg.backend in ("bucketed", "bucketed-sharded"):
         by_i, timings, failures = _run_grid_bucketed(gcfg, design, master,
-                                                     out_dir)
+                                                     out_dir, mesh=mesh)
         _raise_if_failed(failures, len(design))
         detail_all = _assemble_details(design, by_i, gcfg.b)
         summ_all = summarize_grid(detail_all)

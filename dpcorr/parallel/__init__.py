@@ -16,6 +16,7 @@ invert, TPU-style (SURVEY.md §2.3):
 from dpcorr.parallel.mesh import rep_mesh, local_device_count  # noqa: F401
 from dpcorr.parallel.backend import (  # noqa: F401
     run_detail_sharded,
+    run_detail_flat_sharded,
     run_summary_sharded,
 )
 from dpcorr.parallel.multihost import (  # noqa: F401

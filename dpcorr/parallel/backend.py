@@ -74,6 +74,41 @@ def _summary_fn(cfg_norho: SimConfig, mesh: Mesh):
     return jax.jit(sharded)
 
 
+@lru_cache(maxsize=128)
+def _flat_fn(cfg_norho: SimConfig, mesh: Mesh):
+    """Compiled shard_map kernel over per-element (key, ρ) pairs — the
+    bucketed grid's flat (points × replications) axis sharded over the
+    ``rep`` mesh axis, composing the two parallel axes the reference keeps
+    separate (grid fan-out × within-task vectorization, SURVEY.md §2.3)."""
+
+    def local(keys, rhos):
+        return chunked_vmap(lambda k, r: sim_mod._one_rep(k, r, cfg_norho),
+                            (keys, rhos), cfg_norho.chunk_size)
+
+    sharded = shard_map(local, mesh=mesh,
+                        in_specs=(P("rep"), P("rep")), out_specs=P("rep"))
+    return jax.jit(sharded)
+
+
+def run_detail_flat_sharded(cfg_norho: SimConfig, keys: jax.Array,
+                            rhos: jax.Array, mesh: Mesh | None = None):
+    """Sharded twin of ``sim._run_detail_flat``: same per-element keys ⇒
+    bit-identical detail, with the flat axis split across the mesh. Pads
+    to a mesh-size multiple (padding reps recompute the first elements and
+    are truncated away)."""
+    mesh = mesh or rep_mesh()
+    n_shards = mesh.devices.size
+    total = keys.shape[0]
+    padded = _padded_b(total, n_shards)
+    if padded != total:
+        # modulo gather handles pad > total too (a tiny bucket on a big
+        # mesh — e.g. one uncached point at small b after a resume)
+        idx = jnp.arange(padded) % total
+        keys, rhos = keys[idx], rhos[idx]
+    out = _flat_fn(cfg_norho, mesh)(keys, rhos)
+    return tuple(a[:total] for a in out)
+
+
 def _prep(cfg: SimConfig, key, mesh: Mesh):
     n_shards = mesh.devices.size
     b_pad = _padded_b(cfg.b, n_shards)

@@ -76,7 +76,7 @@ def run_grid_host(gcfg: GridConfig, host_id: int, n_hosts: int) -> int:
     # keys fold the *global* design index i, so the result is
     # bit-identical to a single-host run of the full grid
     master = rng.master_key(gcfg.seed)
-    if gcfg.backend == "bucketed":
+    if gcfg.backend in ("bucketed", "bucketed-sharded"):
         _, _, failures = grid_mod._run_grid_bucketed(gcfg, mine, master,
                                                      out_dir)
         grid_mod._raise_if_failed(failures, len(mine))

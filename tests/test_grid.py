@@ -68,6 +68,24 @@ def test_bucketed_backend_bit_identical_to_local():
     assert (buck.timings["points"] == 2).all()
 
 
+def test_bucketed_sharded_bit_identical_to_local(devices):
+    """Both parallel axes composed — bucket kernels with the flat
+    (points × reps) axis split over the 8-device mesh — must still be
+    bit-identical to the local backend (per-element keys are the
+    identity; the mesh only changes layout)."""
+    loc = run_grid(GridConfig(**SMALL))
+    bs = run_grid(GridConfig(**SMALL, backend="bucketed-sharded"))
+    pd.testing.assert_frame_equal(loc.detail_all, bs.detail_all)
+    # 48 flat elements per bucket divides the 8-device mesh evenly; also
+    # cover a non-divisible axis (2 × 13 = 26 → pads to 32) and a
+    # smaller-than-mesh one (1 point × b=3 → pad 5 > total 3)
+    for cfg_kw in (dict(SMALL, b=13),
+                   dict(SMALL, b=3, rho_grid=(0.5,), eps_pairs=((1.0, 1.0),))):
+        loc_odd = run_grid(GridConfig(**cfg_kw))
+        bs_odd = run_grid(GridConfig(**cfg_kw, backend="bucketed-sharded"))
+        pd.testing.assert_frame_equal(loc_odd.detail_all, bs_odd.detail_all)
+
+
 def test_bucketed_resume_cache_interchangeable(tmp_path):
     """Bucketed and local backends share the per-point .npz cache."""
     gc_loc = GridConfig(**SMALL, out_dir=str(tmp_path))

@@ -82,8 +82,10 @@ def _flat_fn(cfg_norho: SimConfig, mesh: Mesh):
     separate (grid fan-out × within-task vectorization, SURVEY.md §2.3)."""
 
     def local(keys, rhos):
-        return chunked_vmap(lambda k, r: sim_mod._one_rep(k, r, cfg_norho),
-                            (keys, rhos), cfg_norho.chunk_size)
+        # delegate to the single source of truth for the flat kernel —
+        # the bit-identity contract with the unsharded backend depends on
+        # these bodies never diverging (jit composes inside shard_map)
+        return sim_mod._run_detail_flat(cfg_norho, keys, rhos)
 
     sharded = shard_map(local, mesh=mesh,
                         in_specs=(P("rep"), P("rep")), out_specs=P("rep"))

@@ -318,16 +318,16 @@ def main() -> None:
                                budget_s=cpu_budget)
         if out is not None:
             out["detail"]["degraded"] = "tpu-init-failed"
-            evidence = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                    "benchmarks", "results",
-                                    "r02_tpu_headline.json")
-            if os.path.exists(evidence):
+            evidence_rel = os.path.join("benchmarks", "results",
+                                        "r02_tpu_headline.json")
+            if os.path.exists(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    evidence_rel)):
                 # point the consumer at a healthy-chip measurement recorded
                 # earlier (repo-relative path; that file carries its own
                 # capture date/config — it documents what the chip did
                 # then, not a remeasurement of the current revision)
-                out["detail"]["recorded_tpu_evidence"] = \
-                    "benchmarks/results/r02_tpu_headline.json"
+                out["detail"]["recorded_tpu_evidence"] = evidence_rel
     if out is None:
         attempts.append(err)
         out = {"metric": METRIC, "value": 0.0, "unit": "reps/sec/chip",

@@ -14,7 +14,8 @@ grid step:
   moment sums;
 - **sign batch sums as an MXU matmul** against a static 0/1 block-
   aggregation matrix G[l, c] = 1{l//m' == c} — the (k, m)-reshape-mean
-  (vert-cor.R:131-140) becomes ``signs(R,128) @ G(128,128//m')``;
+  (vert-cor.R:131-140) becomes ``signs(R,128) @ G(128,128)`` — G's
+  columns beyond 128//m' are identically zero, keeping full-lane tiles;
 - per-batch Laplace noise, Σ T_j / Σ T_j² reduction; only the two scalars
   (η̂, sd T) leave the chip per replication.
 
@@ -63,11 +64,16 @@ def _pad_m(m: int) -> int:
 
 
 def _layout(n: int, eps1: float, eps2: float):
-    """(m, m', k, leftover, rows) for the padded lane-group layout."""
+    """(m, m', k, leftover, rows) for the padded lane-group layout.
+
+    ``rows`` is rounded up to a multiple of 8 so every kernel
+    intermediate is a full (8·r, 128) TPU tile — Mosaic handles aligned
+    shapes best (and the position masks make padding rows inert)."""
     m, k = batch_geometry(n, eps1, eps2)
     m_pad = _pad_m(m)
     leftover = n - k * m
     rows = -(-(k * m_pad + leftover) // LANES)
+    rows = -(-rows // 8) * 8
     return m, m_pad, k, leftover, rows
 
 
@@ -182,24 +188,29 @@ def _make_kernel(n: int, m: int, m_pad: int, k: int, leftover: int,
         else:
             x_c, y_c = x, y
 
-        # ---- sign batch sums on the MXU: (rows,128) @ G(128,g_cols) ----
-        # padding lanes inside a group must not leak into the batch sum
+        # ---- sign batch sums on the MXU: (rows,128) @ G(128,128) ----
+        # padding lanes inside a group must not leak into the batch sum;
+        # G's columns beyond g_cols are identically zero (l // m' never
+        # reaches them), so intermediates stay full 128-lane tiles
         bmask = batch_elem.astype(jnp.float32)
         sx = jnp.sign(x_c) * bmask
         sy = jnp.sign(y_c) * bmask
-        g = gmat_ref[:, :g_cols]
+        g = gmat_ref[...]
         xb = jnp.dot(sx, g, preferred_element_type=jnp.float32) / m
         yb = jnp.dot(sy, g, preferred_element_type=jnp.float32) / m
 
         # ---- per-batch Laplace noise (sens 2/m, vert-cor.R:143-146) ----
+        # full-width draws; the same uniforms land on the same live
+        # (row, col < g_cols) positions, dead columns are masked below
         lap_xy = _laplace_from_uniform(take((2 * rows, LANES)), 1.0)
-        xt = xb + lap_xy[:rows, :g_cols] * scale_x
-        yt = yb + lap_xy[rows:, :g_cols] * scale_y
+        xt = xb + lap_xy[:rows, :] * scale_x
+        yt = yb + lap_xy[rows:, :] * scale_y
 
         # ---- T_j = m·X̃_j·Ỹ_j over the k real batches ----
-        bidx = (jax.lax.broadcasted_iota(jnp.int32, (rows, g_cols), 0) * g_cols
-                + jax.lax.broadcasted_iota(jnp.int32, (rows, g_cols), 1))
-        t = jnp.where(bidx < k, m * xt * yt, 0.0)
+        rr = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
+        cc = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+        live = (cc < g_cols) & (rr * g_cols + cc < k)
+        t = jnp.where(live, m * xt * yt, 0.0)
         st = jnp.sum(t)
         st2 = jnp.sum(t * t)
 
@@ -223,7 +234,7 @@ def _ni_sign_pallas_sums(seeds: jax.Array, rho: jax.Array, n: int,
     # static 0/1 aggregation matrix: lane l feeds batch column l // m'
     gmat = jnp.asarray(
         (np.arange(LANES)[:, None] // m_pad) == np.arange(LANES)[None, :],
-        jnp.float32)  # padded to (128, 128); kernel slices [:, :g_cols]
+        jnp.float32)  # (128, 128); columns >= 128//m' are all zero
 
     # Mosaic requires every block's trailing two dims to be divisible by
     # (8, 128) or equal to the array's — so the grid axis is a *leading*

@@ -20,7 +20,7 @@ from jax import shard_map
 
 from dpcorr import sim as sim_mod
 from dpcorr.parallel.mesh import rep_mesh
-from dpcorr.sim import SimConfig, chunked_vmap
+from dpcorr.sim import SimConfig
 from dpcorr.utils import rng
 
 
@@ -33,8 +33,7 @@ def _detail_fn(cfg_norho: SimConfig, mesh: Mesh):
     """Compiled shard_map kernel: (padded keys, rho) -> detail tuple."""
 
     def local(keys, rho):
-        return chunked_vmap(lambda k: sim_mod._one_rep(k, rho, cfg_norho),
-                            keys, cfg_norho.chunk_size)
+        return sim_mod._detail_from_keys(cfg_norho, keys, rho)
 
     sharded = shard_map(local, mesh=mesh,
                         in_specs=(P("rep"), P()), out_specs=P("rep"))
@@ -51,8 +50,7 @@ def _summary_fn(cfg_norho: SimConfig, mesh: Mesh):
     """
 
     def local(keys, rho, b_real):
-        detail = chunked_vmap(lambda k: sim_mod._one_rep(k, rho, cfg_norho),
-                              keys, cfg_norho.chunk_size)
+        detail = sim_mod._detail_from_keys(cfg_norho, keys, rho)
         named = dict(zip(sim_mod.DETAIL_FIELDS, detail, strict=True))
         # padding mask: global rep index < b_real
         idx = jax.lax.axis_index("rep") * keys.shape[0] + jnp.arange(keys.shape[0])

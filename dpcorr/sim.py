@@ -222,10 +222,18 @@ def chunked_vmap(fn: Callable, args, chunk_size: int):
     return jax.tree.map(lambda a: a.reshape(n_chunks * chunk, *a.shape[2:])[:b], out)
 
 
+def _detail_from_keys(cfg: SimConfig, keys: jax.Array, rho: jax.Array):
+    """The one replication-batch body every backend runs: chunked vmap of
+    ``_one_rep`` over explicit per-rep keys at one traced ρ. Local, sharded
+    detail, and psum-summary paths all delegate here — the
+    bit-identity-across-backends contract is this function being the
+    single source of truth."""
+    return chunked_vmap(lambda k: _one_rep(k, rho, cfg), keys, cfg.chunk_size)
+
+
 @partial(jax.jit, static_argnums=(0,))
 def _run_detail_core(cfg: SimConfig, key: jax.Array, rho: jax.Array):
-    keys = rng.rep_keys(key, cfg.b)
-    return chunked_vmap(lambda k: _one_rep(k, rho, cfg), keys, cfg.chunk_size)
+    return _detail_from_keys(cfg, rng.rep_keys(key, cfg.b), rho)
 
 
 @partial(jax.jit, static_argnums=(0,))

@@ -318,11 +318,10 @@ def main() -> None:
                                budget_s=cpu_budget)
         if out is not None:
             out["detail"]["degraded"] = "tpu-init-failed"
-            evidence_rel = os.path.join("benchmarks", "results",
-                                        "r02_tpu_headline.json")
+            evidence_rel = "benchmarks/results/r02_tpu_headline.json"
             if os.path.exists(os.path.join(
                     os.path.dirname(os.path.abspath(__file__)),
-                    evidence_rel)):
+                    *evidence_rel.split("/"))):
                 # point the consumer at a healthy-chip measurement recorded
                 # earlier (repo-relative path; that file carries its own
                 # capture date/config — it documents what the chip did

@@ -37,7 +37,15 @@ def gen_gaussian(key: jax.Array, n: int, rho, mu=(0.0, 0.0), sigma=(1.0, 1.0)) -
 
 def gen_bernoulli(key: jax.Array, n: int, rho) -> jax.Array:
     """Correlated Bernoulli(0.5) pair with Corr(X,Y)=ρ via conditional
-    inversion: p11 = ¼+ρ/4, p01 = ¼−ρ/4 (vert-cor.R:78-98)."""
+    inversion: p11 = ¼+ρ/4, p01 = ¼−ρ/4 (vert-cor.R:78-98).
+
+    Note the reference defines this DGP but never wires it into a driver
+    (SURVEY.md Appendix A #7) — for good reason: the sign estimators'
+    arcsine link ρ = sin(πη/2) assumes Gaussianity (vert-cor.R:150-153),
+    so on Bernoulli data they are misspecified (measured at n=2000,
+    ρ=0.3, ε=(1,1), B=4096: NI bias +0.14, coverage 0.88; INT coverage
+    0.41). It is wired here (bench configs 2-3) as a robustness probe,
+    not a calibrated setting."""
     rho = jnp.asarray(rho, jnp.float32)
     u = jax.random.uniform(stream(key, "bernoulli/u"), (n,), jnp.float32)
     v = jax.random.uniform(stream(key, "bernoulli/v"), (n,), jnp.float32)

@@ -257,3 +257,49 @@ class TestDegenerateBatchGeometry:
         # coverage arithmetic then records False, not an error
         cover = (res.ci_low <= 0.3) & (0.3 <= res.ci_high)
         assert not bool(cover)
+
+
+class TestIntSignCiModes:
+    """The ci_mode knob (vert-cor.R:497-499) and the auto regime switch
+    (vert-cor.R:294-296)."""
+
+    def _run(self, n, eps_r, mode):
+        key = rng.master_key(3)
+        xy = gen_gaussian(rng.stream(key, "d"), n, jnp.float32(0.4))
+        return ci_int_signflip(key, xy[:, 0], xy[:, 1], 1.0, eps_r,
+                               mode=mode)
+
+    def test_auto_equals_forced_regime(self):
+        # √400·1.0 = 20 > 0.5 → auto ≡ normal; forced laplace differs
+        auto = self._run(400, 1.0, "auto")
+        normal = self._run(400, 1.0, "normal")
+        lap = self._run(400, 1.0, "laplace")
+        np.testing.assert_array_equal(np.asarray(auto.ci_low),
+                                      np.asarray(normal.ci_low))
+        assert float(auto.ci_low) != float(lap.ci_low)
+
+    def test_auto_picks_laplace_below_threshold(self):
+        # √400·0.02 = 0.4 < 0.5 → auto ≡ laplace (vert-cor.R:304-308)
+        auto = self._run(400, 0.02, "auto")
+        lap = self._run(400, 0.02, "laplace")
+        np.testing.assert_array_equal(np.asarray(auto.ci_low),
+                                      np.asarray(lap.ci_low))
+
+    def test_laplace_width_closed_form(self):
+        # fixed width (2/(nε_r))·ratio·log(1/α) in η-space, independent of
+        # the data beyond ρ̂ (vert-cor.R:304-308)
+        import math
+
+        # interior interval: width_eta ≈ 0.16 < 1 − |η̂| (a saturated
+        # [-1,1] CI would make this test vacuous)
+        n, eps_r, alpha = 4000, 0.02, 0.05
+        res = self._run(n, eps_r, "laplace")
+        e_s = math.exp(1.0)
+        width_eta = (2.0 / (n * eps_r)) * (e_s + 1) / (e_s - 1) \
+            * math.log(1.0 / alpha)
+        eta_hat = 1.0 - math.acos(float(res.rho_hat)) * 2.0 / math.pi
+        lo = math.sin(math.pi / 2.0 * max(eta_hat - width_eta, -1.0))
+        hi = math.sin(math.pi / 2.0 * min(eta_hat + width_eta, 1.0))
+        np.testing.assert_allclose(float(res.ci_low), lo, rtol=1e-5)
+        np.testing.assert_allclose(float(res.ci_high), hi, rtol=1e-5)
+

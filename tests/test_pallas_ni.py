@@ -115,3 +115,27 @@ def test_unnormalised_path():
     r = np.asarray(res.rho_hat)
     # data is already standard here, so estimates still center on ρ
     assert abs(r.mean() - RHO) < 0.05
+
+
+def test_layout_invariants_reference_grid():
+    """Every (n, ε) the reference grid can produce (vert-cor.R:488-494)
+    must yield a Mosaic-aligned layout: rows a multiple of 8 (full
+    sublane tiles), m' a power of two dividing 128, and enough positions
+    for the k·m batch elements plus the leftover tail."""
+    from dpcorr.ops.pallas_ni import LANES, _layout, use_ni_sign_pallas
+
+    n_grid = (1000, 1500, 2500, 4000, 6000, 9000, 10_000)
+    eps_pairs = ((0.5, 0.5), (1.0, 1.0), (1.5, 0.5))
+    for n in n_grid:
+        for e1, e2 in eps_pairs:
+            assert use_ni_sign_pallas(n, e1, e2), (n, e1, e2)
+            m, m_pad, k, leftover, rows = _layout(n, e1, e2)
+            assert rows % 8 == 0
+            assert m_pad & (m_pad - 1) == 0 and LANES % m_pad == 0
+            assert m <= m_pad <= 2 * m
+            assert k * m + leftover == n
+            assert rows * LANES >= k * m_pad + leftover
+            # uniform-row accounting matches the kernel's take() sequence
+            from dpcorr.ops.pallas_ni import n_uniform_rows
+
+            assert n_uniform_rows(n, e1, e2) == 4 * rows + 8

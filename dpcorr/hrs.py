@@ -226,7 +226,10 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
     ends, and CI-end quantiles (q10 of lows, q90 of highs) for NI and INT.
 
     Returns the per-ε summary frame the figures consume; the raw per-rep
-    table is attached as ``.attrs["runs"]``.
+    table is attached as ``.attrs["runs"]`` (note: pandas serializes
+    ``attrs`` into parquet metadata, so persist the two frames separately
+    — ``summ.attrs["runs"].to_parquet(...)`` and a plain-attrs copy of the
+    summary — rather than calling ``summ.to_parquet`` directly).
     """
     cols = load_panel(cfg.panel_path) if cols is None else cols
     _, age, bmi = extract_wave(cols, cfg.wave)

@@ -52,8 +52,21 @@ ALPHA = 0.05
 MAX_BLOCKS = 32
 
 # Per-platform knobs: (block_reps, vmap_chunk) sized so one block is a few
-# seconds of device time on the respective backend.
+# seconds of device time on the respective backend. Overridable for tuning
+# runs without editing: DPCORR_BENCH_BLOCK_REPS / DPCORR_BENCH_CHUNK.
 WORKER_SHAPE = {"tpu": (32 * 1024, 2048), "cpu": (2048, 256)}
+
+
+def _worker_shape(mode: str) -> tuple[int, int]:
+    block_reps, chunk = WORKER_SHAPE["tpu" if mode == "tpu-pallas" else mode]
+    if mode != "cpu":
+        # overrides tune the TPU paths only — a TPU-sized block inherited
+        # by the CPU fallback would blow through its kill timeout and cost
+        # the degraded measurement the fallback exists to provide
+        block_reps = int(os.environ.get("DPCORR_BENCH_BLOCK_REPS",
+                                        block_reps))
+        chunk = int(os.environ.get("DPCORR_BENCH_CHUNK", chunk))
+    return block_reps, chunk
 
 METRIC = "mc_reps_per_sec_chip_ni_sign_n10k"
 
@@ -85,7 +98,7 @@ def worker_main(mode: str, budget_s: float) -> None:
     from dpcorr.sim import chunked_vmap
     from dpcorr.utils import rng
 
-    block_reps, chunk = WORKER_SHAPE["tpu" if mode == "tpu-pallas" else mode]
+    block_reps, chunk = _worker_shape(mode)
 
     def _metrics(r):
         cover = ((RHO >= r.ci_low) & (RHO <= r.ci_high)).astype(jnp.float32)

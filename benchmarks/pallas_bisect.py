@@ -1,0 +1,286 @@
+"""Feature-level bisection of the NI Pallas kernel's Mosaic compile hang.
+
+Round-2 finding (docs/STATUS_r02.md): a minimal on-chip-PRNG kernel
+compiles and runs on the tunneled TPU in seconds, but the *full* fused
+kernel (`dpcorr.ops.pallas_ni`) hung the server-side Mosaic compile and
+wedged the backend for every subsequent process. This harness identifies
+the culprit increment by compiling a ladder of kernels, each adding one
+feature of the full kernel, under hard process-group-killed timeouts:
+
+    L1  prng       seed + prng_random_bits + sum
+    L2  boxmuller  + uniform conversion + Box-Muller (log/sqrt/cos/sin)
+    L3  genmask    + bivariate x,y + iota position masks
+    L4  center     + DP centering (laplace noise + masked moment sums)
+    L5  matmul     + sign + (rows,128)@(128,128) MXU aggregation
+    L6  full       the real kernel via ni_sign_pallas, b=8
+    L7  fullbig    the real kernel, b=4096 (bench-shaped grid)
+
+Orchestrator protocol (the tunnel is a shared, wedgeable resource):
+health-check → probe → on success next level; on timeout kill the
+process group, health-check again, and STOP — every higher level
+contains the culprit, and further compiles of it only risk re-wedging
+the backend. Results land in benchmarks/results/pallas_bisect.json.
+
+Run: python benchmarks/pallas_bisect.py            (orchestrator)
+     python benchmarks/pallas_bisect.py --level N  (one probe, in-proc)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, REPO)
+RESULTS = os.path.join(REPO, "benchmarks", "results", "pallas_bisect.json")
+
+N = int(os.environ.get("DPCORR_BISECT_N", 10_000))
+EPS1, EPS2, RHO = 1.0, 1.0, 0.5
+LEVELS = ["prng", "boxmuller", "genmask", "center", "matmul", "full",
+          "fullbig"]
+
+HEALTH_TIMEOUT = 240.0   # fresh backend init through the tunnel is ~60-90s
+PROBE_TIMEOUT = 330.0    # init + Mosaic compile + tiny run; hang >> this
+
+
+# --------------------------------------------------------------------------
+# Probe worker: compile + run ONE ladder level in this process.
+# --------------------------------------------------------------------------
+
+def probe_level(level: str) -> dict:
+    import math
+
+    import jax
+
+    if os.environ.get("DPCORR_BISECT_INTERPRET"):
+        # CPU smoke test. The axon site hook preloads jax at interpreter
+        # startup, so JAX_PLATFORMS in the environment is captured too
+        # late — only jax.config reliably keeps the tunnel out of the way.
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from dpcorr.ops.pallas_ni import (LANES, _laplace_from_uniform, _layout,
+                                      _uniform, ni_sign_pallas)
+
+    m, m_pad, k, leftover, rows = _layout(N, EPS1, EPS2)
+    t0 = time.perf_counter()
+
+    if level in ("full", "fullbig"):
+        if os.environ.get("DPCORR_BISECT_INTERPRET"):
+            # the real kernel's on-chip PRNG has no interpreter stand-in
+            # (ni_sign_pallas requires external uniforms off-TPU), and the
+            # ladder below already smoke-covers all of its pieces
+            return {"level": level, "ok": True,
+                    "skipped": "interpret smoke mode covers L1-L5 only"}
+        b = 4096 if level == "fullbig" else 8
+        seeds = jnp.arange(b, dtype=jnp.int32)
+        r = ni_sign_pallas(seeds, RHO, N, EPS1, EPS2, interpret=False)
+        finite = bool(jnp.all(jnp.isfinite(r.rho_hat))
+                      & jnp.all(jnp.isfinite(r.ci_low))
+                      & jnp.all(jnp.isfinite(r.ci_high)))
+        return {"level": level, "ok": True, "finite": finite,
+                "secs": round(time.perf_counter() - t0, 1),
+                "mean_rho_hat": round(float(jnp.mean(r.rho_hat)), 4)}
+
+    want = LEVELS.index(level)
+    l_clip = math.sqrt(2.0 * math.log(N))
+    two_pi = 2.0 * math.pi
+    import numpy as np
+    gmat_np = ((np.arange(LANES)[:, None] // m_pad)
+               == np.arange(LANES)[None, :]).astype(np.float32)
+
+    def kernel(seed_ref, gmat_ref, out_ref):
+        pltpu.prng_seed(seed_ref[0, 0, 0])
+        acc = jnp.float32(0.0)
+
+        bits1 = pltpu.prng_random_bits((rows, LANES))
+        bits2 = pltpu.prng_random_bits((rows, LANES))
+        if want == 0:  # L1: raw bits only
+            acc = (jnp.sum(bits1.astype(jnp.float32))
+                   + jnp.sum(bits2.astype(jnp.float32)))
+        else:
+            u1 = _uniform(bits1)
+            u2 = _uniform(bits2)
+            r = jnp.sqrt(-2.0 * jnp.log(u1))
+            z1 = r * jnp.cos(two_pi * u2)
+            z2 = r * jnp.sin(two_pi * u2)
+            if want == 1:  # L2: Box-Muller
+                acc = jnp.sum(z1) + jnp.sum(z2)
+        if want >= 2:  # L3: bivariate pair + position masks
+            rho = jnp.float32(RHO)
+            x = z1
+            y = rho * z1 + jnp.sqrt(1.0 - rho * rho) * z2
+            pos = (jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
+                   * LANES
+                   + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1))
+            batch_elem = (pos % m_pad < m) & (pos // m_pad < k)
+            in_leftover = (pos >= k * m_pad) & (pos < k * m_pad + leftover)
+            w = (batch_elem | in_leftover).astype(jnp.float32)
+            if want == 2:
+                acc = jnp.sum(x * w) + jnp.sum(y * w)
+        if want >= 3:  # L4: DP centering
+            lap4 = _laplace_from_uniform(
+                _uniform(pltpu.prng_random_bits((8, LANES))), 1.0)
+
+            def center(v, eps, mu_noise):
+                vc = jnp.clip(v, -l_clip, l_clip)
+                mu_p = (jnp.sum(vc * w) / N
+                        + mu_noise * 2.0 * l_clip / (N * (eps / 2.0)))
+                return vc - mu_p
+
+            x_c = center(x, EPS1, lap4[0, 0])
+            y_c = center(y, EPS2, lap4[1, 0])
+            if want == 3:
+                acc = jnp.sum(x_c * w) + jnp.sum(y_c * w)
+        if want >= 4:  # L5: sign + MXU aggregation matmul
+            bmask = batch_elem.astype(jnp.float32)
+            sx = jnp.sign(x_c) * bmask
+            sy = jnp.sign(y_c) * bmask
+            g = gmat_ref[...]
+            xb = jnp.dot(sx, g, preferred_element_type=jnp.float32) / m
+            yb = jnp.dot(sy, g, preferred_element_type=jnp.float32) / m
+            acc = jnp.sum(xb) + jnp.sum(yb)
+
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+        out_ref[0, 0, :] = jnp.where(lane == 0, acc, 0.0)[0, :]
+
+    b = 8
+    seeds = jnp.arange(b, dtype=jnp.int32).reshape(b, 1, 1)
+    # DPCORR_BISECT_INTERPRET=1: CPU shape/trace smoke test of the ladder
+    # itself (the interpreter stubs the PRNG to zeros, so values are NaN
+    # garbage — only "does it trace and execute" is checked off-TPU).
+    interpret = (pltpu.InterpretParams()
+                 if os.environ.get("DPCORR_BISECT_INTERPRET") else False)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((LANES, LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, LANES), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, 1, LANES), jnp.float32),
+        interpret=interpret,
+    )(seeds, jnp.asarray(gmat_np))
+    vals = out[:, 0, 0]
+    return {"level": level, "ok": True,
+            "finite": bool(jnp.all(jnp.isfinite(vals))),
+            "secs": round(time.perf_counter() - t0, 1),
+            "sample": round(float(vals[0]), 3)}
+
+
+# --------------------------------------------------------------------------
+# Orchestrator
+# --------------------------------------------------------------------------
+
+def _run(cmd: list[str], timeout_s: float):
+    """Run cmd in its own process group; kill the whole group on timeout.
+    Returns (rc | None-on-timeout, stdout, stderr, elapsed)."""
+    t0 = time.time()
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True,
+                         start_new_session=True)
+    try:
+        so, se = p.communicate(timeout=timeout_s)
+        return p.returncode, so, se, time.time() - t0
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        # drain whatever the probe printed before the kill — the hanging
+        # probe is exactly the one whose partial output matters
+        so, se = p.communicate()
+        return None, so or "", se or "", time.time() - t0
+
+
+def health_check() -> tuple[bool, float]:
+    code = ("import jax, jax.numpy as jnp; "
+            "x = jnp.ones((256, 256)); "
+            "print('HEALTH-OK', float((x @ x).sum()), jax.devices()[0])")
+    rc, so, _, dt = _run([sys.executable, "-c", code], HEALTH_TIMEOUT)
+    return rc == 0 and "HEALTH-OK" in so, dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--level", choices=LEVELS)
+    ap.add_argument("--start", default="prng", choices=LEVELS,
+                    help="first ladder level to probe (skip known-good)")
+    args = ap.parse_args()
+
+    if args.level:
+        print(json.dumps(probe_level(args.level)), flush=True)
+        return
+
+    report = {"config": {"n": N, "eps1": EPS1, "eps2": EPS2},
+              "probes": [], "culprit": None, "wedged": False}
+
+    ok, dt = health_check()
+    print(f"initial health: {'OK' if ok else 'FAILED'} ({dt:.0f}s)",
+          flush=True)
+    report["initial_health_s"] = round(dt, 1)
+    if not ok:
+        report["wedged"] = True
+        _write(report)
+        return
+
+    for level in LEVELS[LEVELS.index(args.start):]:
+        print(f"probe {level} ...", flush=True)
+        rc, so, se, dt = _run(
+            [sys.executable, os.path.abspath(__file__), "--level", level],
+            PROBE_TIMEOUT)
+        entry = {"level": level, "elapsed_s": round(dt, 1)}
+        if rc is None:
+            entry["result"] = "TIMEOUT (killed)"
+            if se.strip():
+                entry["stderr_tail"] = " | ".join(
+                    se.strip().splitlines()[-3:])[:400]
+        elif rc != 0:
+            entry["result"] = "ERROR"
+            entry["stderr_tail"] = " | ".join(
+                (se or "").strip().splitlines()[-3:])[:400]
+        else:
+            for line in reversed((so or "").strip().splitlines()):
+                try:
+                    entry["result"] = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            else:
+                entry["result"] = "NO-JSON"
+        report["probes"].append(entry)
+        print(f"  -> {entry['result']}", flush=True)
+
+        if rc != 0:  # timeout or error: identify culprit, verify health, stop
+            report["culprit"] = level
+            ok, dt = health_check()
+            report["post_hang_health"] = {"ok": ok, "secs": round(dt, 1)}
+            print(f"post-hang health: {'OK' if ok else 'WEDGED'} ({dt:.0f}s)",
+                  flush=True)
+            if not ok:
+                report["wedged"] = True
+            break
+    _write(report)
+
+
+def _write(report: dict) -> None:
+    with open(RESULTS, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"wrote {RESULTS}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Config-3-scale TPU comparison: bucketed grid, XLA kernels vs fused
+Pallas buckets (VERDICT r1 item 4 "done" criterion — show which buckets
+ran fused and the speedup).
+
+Runs the reference's full v1 grid (144 design points: 6n × 8ρ × 3ε-pairs,
+vert-cor.R:488-511) at its own B=250 twice on the live TPU through the
+bucketed backend — ``fused="off"`` (XLA `jit(vmap)` kernels) then
+``fused="auto"`` (eligible (n, ε) buckets through the fused on-chip-PRNG
+Pallas kernel) — and records wall-clocks, per-bucket fused flags, and
+grid-level statistical summaries of both runs.
+
+Run: python benchmarks/grid_fused_tpu.py [--b 250]
+Writes benchmarks/results/r02_grid_fused_tpu.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+RESULTS = {
+    "sign": os.path.join(REPO, "benchmarks", "results",
+                         "r02_grid_fused_tpu.json"),
+    "subg": os.path.join(REPO, "benchmarks", "results",
+                         "r02_grid_fused_subg_tpu.json"),
+}
+
+
+def _summ_stats(res):
+    s = res.summ_all
+    return {
+        "mean_coverage_NI": round(
+            float(s[s.method == "NI"]["coverage"].mean()), 4),
+        "mean_coverage_INT": round(
+            float(s[s.method == "INT"]["coverage"].mean()), 4),
+        "mean_mse_NI": round(float(s[s.method == "NI"]["mse"].mean()), 6),
+        "mean_mse_INT": round(float(s[s.method == "INT"]["mse"].mean()), 6),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--b", type=int, default=250)
+    ap.add_argument("--family", choices=["sign", "subg"], default="sign",
+                    help="sign: v1 Gaussian grid (vert-cor.R:488-511); "
+                         "subg: v2 bounded-factor grid "
+                         "(ver-cor-subG.R:245-269)")
+    args = ap.parse_args()
+
+    import jax
+
+    from dpcorr.grid import GridConfig, run_grid
+
+    dev = jax.devices()[0]
+    out = {"device": str(dev), "b": args.b, "family": args.family,
+           "runs": {}}
+    family_kw = ({} if args.family == "sign" else
+                 dict(n_grid=(2500, 4000, 6000, 9000, 12000),
+                      dgp="bounded_factor", use_subg=True))
+
+    # subG fusing is gated behind "all" (perf-neutral — GridConfig.fused);
+    # this script's job is to measure it, so force the fused arm per family
+    fused_mode = "auto" if args.family == "sign" else "all"
+    for fused in ("off", fused_mode):
+        gcfg = GridConfig(b=args.b, backend="bucketed", fused=fused,
+                          **family_kw)
+        t0 = time.perf_counter()
+        res = run_grid(gcfg)
+        wall = time.perf_counter() - t0
+        t = res.timings
+        n_points = int(t["points"].sum())
+        out["runs"][fused] = {
+            "wall_s": round(wall, 1),
+            "grid_reps_per_sec": round(float(
+                t["grid_reps_per_sec"].iloc[0]), 1),
+            "buckets": len(t),
+            "fused_buckets": int(t["fused"].astype(bool).sum()),
+            "points": n_points,
+            "total_reps": n_points * args.b,
+            **_summ_stats(res),
+        }
+        print(fused, "->", json.dumps(out["runs"][fused]), flush=True)
+
+    o, a = out["runs"]["off"], out["runs"][fused_mode]
+    out["fused_speedup_wall"] = round(o["wall_s"] / a["wall_s"], 3)
+    out["fused_speedup_rps"] = round(
+        a["grid_reps_per_sec"] / o["grid_reps_per_sec"], 3)
+    # both runs must look like the same calibrated construction
+    out["coverage_diff_NI"] = round(
+        abs(o["mean_coverage_NI"] - a["mean_coverage_NI"]), 4)
+    out["coverage_diff_INT"] = round(
+        abs(o["mean_coverage_INT"] - a["mean_coverage_INT"]), 4)
+
+    path = RESULTS[args.family]
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print("wrote", path, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -96,7 +96,7 @@ def cmd_grid(args):
     from dpcorr.grid import GridConfig
 
     gcfg = GridConfig(b=args.b or 250, seed=args.seed, backend=args.backend,
-                      out_dir=args.out)
+                      fused=args.fused, out_dir=args.out)
     _run_grid(args, gcfg, fig1_n=1500, fig1_eps=(1.5, 0.5))
 
 
@@ -106,7 +106,8 @@ def cmd_grid_subg(args):
     gcfg = GridConfig(
         n_grid=(2500, 4000, 6000, 9000, 12000),  # ver-cor-subG.R:245
         b=args.b or 250, dgp="bounded_factor", use_subg=True,
-        seed=args.seed, backend=args.backend, out_dir=args.out)
+        seed=args.seed, backend=args.backend, fused=args.fused,
+        out_dir=args.out)
     # the reference's subG fig1 slices n=6000 (ver-cor-subG.R:342)
     _run_grid(args, gcfg, fig1_n=6000, fig1_eps=(1.5, 0.5), family="subg")
 
@@ -200,6 +201,14 @@ def main(argv=None):
                            help="fan the grid out over this many worker "
                                 "processes (needs --out; see "
                                 "dpcorr.parallel.multihost)")
+            p.add_argument("--fused", default="off",
+                           choices=["off", "auto", "all"],
+                           help="run eligible (n, eps) buckets through the "
+                                "fused Pallas kernels (TPU + --backend "
+                                "bucketed only). auto: only where fused "
+                                "measures faster (the Gaussian sign pair, "
+                                "4.5x); all: also the subG pair (perf-"
+                                "neutral vs XLA, see GridConfig.fused)")
         p.set_defaults(fn=fn)
     args = ap.parse_args(argv)
     if args.platform:

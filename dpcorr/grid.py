@@ -59,6 +59,20 @@ class GridConfig:
     #: "bucketed-sharded" (bucket kernels with the flat point×rep axis
     #: split across the mesh — both parallel axes composed)
     backend: str = "local"
+    #: "off" | "auto" | "all": fused-Pallas bucket selection for the
+    #: bucketed backend (on-chip PRNG, whole replication in VMEM).
+    #: "auto" runs buckets through a fused kernel only where it measures
+    #: FASTER than the XLA kernel: the Gaussian sign pair
+    #: (ops/pallas_ni.py — 4.5× on the reference grid,
+    #: benchmarks/results/r02_grid_fused_tpu.json). "all" additionally
+    #: fuses every eligible bucket even where it is perf-neutral: the
+    #: subG grid pair (ops/pallas_subg.py — steady-state 0.98× of XLA
+    #: and slower to Mosaic-compile, r02_grid_fused_subg_tpu.json).
+    #: TPU-only; eligibility also needs det mixquant and m ≤ 128
+    #: (see _fused_bucket_ok). Fused results come from a different PRNG
+    #: stream family, so their resume caches are stamped separately and
+    #: never mix with XLA-path caches.
+    fused: str = "off"
     out_dir: str | None = None
     resume: bool = True
 
@@ -123,6 +137,45 @@ def _load_cached(path: Path | None, resume: bool, stamp: str):
     return None
 
 
+def _fused_bucket_ok(gcfg: GridConfig, cfg: SimConfig) -> str | None:
+    """Which fused Pallas kernel (if any) covers this (n, ε) bucket:
+    ``"sign"`` (Gaussian sign-estimator pair, ops/pallas_ni.py), ``"subg"``
+    (bounded-factor subG grid-variant pair, ops/pallas_subg.py), or None.
+    Gated on: opt-in (``fused`` in "auto"/"all" — "auto" selects only the
+    measured-faster sign kernel, "all" adds the perf-neutral subG kernel;
+    GridConfig.fused has the numbers), single-device bucketed backend,
+    real TPU, det mixquant (the closed-form quantile — the kernel emits
+    scalars, the per-CI MC variant draws from the key-tree the kernel
+    doesn't carry), and the kernel's (m ≤ 128, k ≥ 2) batch geometry."""
+    if gcfg.fused == "off" or gcfg.backend != "bucketed":
+        return None
+    if gcfg.fused not in ("auto", "all"):
+        raise ValueError(
+            f"fused must be 'off', 'auto' or 'all', got {gcfg.fused!r}")
+    if cfg.stream_n_chunk or cfg.mixquant_mode != "det":
+        return None
+    if cfg.use_subg:
+        # the real-data variant's randomized batch permutation has no
+        # in-kernel equivalent (pallas_subg.py docstring); fused subG is
+        # "all"-only — it measures perf-neutral vs XLA (GridConfig.fused)
+        if gcfg.fused != "all":
+            return None
+        if cfg.dgp != "bounded_factor" or cfg.subg_variant != "grid":
+            return None
+        kind = "subg"
+    elif cfg.dgp == "gaussian":
+        kind = "sign"
+    else:
+        return None
+    import jax
+
+    if jax.devices()[0].platform not in ("tpu", "axon"):
+        return None
+    from dpcorr.ops.pallas_ni import use_ni_sign_pallas
+
+    return kind if use_ni_sign_pallas(cfg.n, cfg.eps1, cfg.eps2) else None
+
+
 def _raise_if_failed(failures, n_points: int):
     """Aggregate fail-loud raise shared by all backends (SURVEY.md §5)."""
     if failures:
@@ -162,22 +215,68 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
         # aggregated RuntimeError is raised by run_grid at the end.
         try:
             cfg = gcfg.sim_config(rows[0]._asdict())
-            stamps = {int(r.i): _stamp(dataclasses.replace(
-                          cfg, rho=float(r.rho)))
-                      for r in rows}
+            fused = _fused_bucket_ok(gcfg, cfg)
             paths = {int(r.i): (_design_path(out_dir, int(r.i))
                                 if out_dir else None)
                      for r in rows}
-            to_run = []
-            for r in rows:
-                i = int(r.i)
-                cached = _load_cached(paths[i], gcfg.resume, stamps[i])
-                if cached is not None:
-                    details[i] = cached
-                else:
-                    to_run.append(r)
+
+            def mk_stamps(suffix: str):
+                return {int(r.i): _stamp(dataclasses.replace(
+                            cfg, rho=float(r.rho))) + suffix
+                        for r in rows}
+
+            def scan_cache(candidates, stamps):
+                to_run = []
+                for r in candidates:
+                    i = int(r.i)
+                    cached = _load_cached(paths[i], gcfg.resume, stamps[i])
+                    if cached is not None:
+                        details[i] = cached
+                    else:
+                        to_run.append(r)
+                return to_run
+
+            stamps = mk_stamps("|fused=pallas" if fused else "")
+            to_run = scan_cache(rows, stamps)
             raw = None
-            if to_run:
+            if to_run and fused:
+                try:
+                    seeds = jnp.concatenate([
+                        rng.pallas_seeds(rng.design_key(master, int(r.i)),
+                                         gcfg.b)
+                        for r in to_run])
+                    rhos = jnp.repeat(
+                        jnp.asarray([r.rho for r in to_run], jnp.float32),
+                        gcfg.b)
+                    args = dict(cfg.dgp_args)
+                    if fused == "subg":
+                        from dpcorr.ops import pallas_subg
+
+                        raw = pallas_subg.sim_detail_subg_pallas(
+                            seeds, rhos, cfg.n, cfg.eps1, cfg.eps2,
+                            eta1=cfg.eta1, eta2=cfg.eta2,
+                            alpha=cfg.alpha, interpret=False)
+                    else:
+                        from dpcorr.ops import pallas_ni
+
+                        raw = pallas_ni.sim_detail_pallas(
+                            seeds, rhos, cfg.n, cfg.eps1, cfg.eps2,
+                            mu=args.get("mu", (0.0, 0.0)),
+                            sigma=args.get("sigma", (1.0, 1.0)),
+                            alpha=cfg.alpha, ci_mode=cfg.ci_mode,
+                            normalise=cfg.normalise, interpret=False)
+                except Exception as e:
+                    # fused is best-effort: a lowering/compile failure on
+                    # this bucket's shape degrades to the XLA kernel (the
+                    # cache is re-scanned under the XLA stamps)
+                    log.warning(
+                        "fused kernel unavailable for bucket (n=%d "
+                        "eps=(%.2f,%.2f)): %s -- falling back to XLA",
+                        cfg.n, cfg.eps1, cfg.eps2, e)
+                    fused, raw = None, None
+                    stamps = mk_stamps("")
+                    to_run = scan_cache(to_run, stamps)
+            if to_run and raw is None:
                 keys = jnp.concatenate([
                     rng.rep_keys(rng.design_key(master, int(r.i)), gcfg.b)
                     for r in to_run])
@@ -198,7 +297,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             failures.extend((int(r.i), e) for r in rows
                             if int(r.i) not in details)
             continue
-        pending.append((rows, to_run, raw, stamps, paths,
+        pending.append((rows, to_run, raw, stamps, paths, fused,
                         time.perf_counter() - t0))
 
     # Phase 2 — fetch in dispatch order; device-side failures surface here.
@@ -208,7 +307,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
     # ``grid_reps_per_sec``, total reps over the whole two-phase wall clock.
     t_fetch0 = time.perf_counter()
     total_ran = 0
-    for rows, to_run, raw, stamps, paths, dispatch_s in pending:
+    for rows, to_run, raw, stamps, paths, fused, dispatch_s in pending:
         t0 = time.perf_counter()
         try:
             if to_run:
@@ -234,12 +333,12 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
         total_ran += ran
         timings.append({
             "n": rows[0].n, "eps1": rows[0].eps1, "eps2": rows[0].eps2,
-            "points": len(rows), "points_run": ran,
+            "points": len(rows), "points_run": ran, "fused": fused,
             "seconds": dispatch_s + fetch_s,
             "dispatch_s": dispatch_s, "fetch_s": fetch_s,
         })
     wall = (time.perf_counter() - t_fetch0) + sum(
-        t[5] for t in pending)  # fetch phase + all dispatch times
+        t[6] for t in pending)  # fetch phase + all dispatch times
     grid_rps = np.nan if not total_ran else total_ran * gcfg.b / wall
     for t in timings:
         t["grid_reps_per_sec"] = grid_rps
@@ -267,6 +366,14 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
     Per-task keys fold the design index into the master key — the moral
     equivalent of the reference's ``seed = 1e6 + i`` (vert-cor.R:531).
     """
+    if gcfg.fused not in ("off", "auto", "all"):
+        raise ValueError(
+            f"fused must be 'off', 'auto' or 'all', got {gcfg.fused!r}")
+    if gcfg.fused != "off" and gcfg.backend != "bucketed":
+        # fail fast: every other backend would silently never fuse
+        raise ValueError(
+            f"fused={gcfg.fused!r} requires backend='bucketed', "
+            f"got {gcfg.backend!r}")
     design = gcfg.design_points()
     master = rng.master_key(gcfg.seed)
     out_dir = Path(gcfg.out_dir) if gcfg.out_dir else None

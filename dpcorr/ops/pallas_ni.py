@@ -17,7 +17,13 @@ grid step:
   (vert-cor.R:131-140) becomes ``signs(R,128) @ G(128,128)`` — G's
   columns beyond 128//m' are identically zero, keeping full-lane tiles;
 - per-batch Laplace noise, Σ T_j / Σ T_j² reduction; only the two scalars
-  (η̂, sd T) leave the chip per replication.
+  (η̂, sd T) leave the chip per replication;
+- optionally (``compute_int``) the INT sign-flip estimator
+  (vert-cor.R:164-195) on the *same* in-kernel draw with its own fresh DP
+  centering noise — the grid's hot-loop body computes both estimators per
+  dataset (vert-cor.R:392-419) — adding one more scalar (η̂_INT) to the
+  output; :func:`sim_detail_pallas` turns the three scalars into the full
+  12-column detail row and is the bucketed grid backend's fused path.
 
 **Batch layout (any m ≤ 128).** Lanes are grouped into k groups of
 m' = next power of two ≥ m (so m' | 128 and groups never straddle a
@@ -103,6 +109,97 @@ def _rand_uniform(shape):
     return _uniform(pltpu.prng_random_bits(shape))
 
 
+# ---- scaffolding shared by every replication kernel (this module and
+# pallas_subg.py): seed words, uniform source, layout masks, aggregation
+# matrix, and the pallas_call shell. One copy — the lane-group mask and
+# BlockSpec rules are the easiest places for two kernels to drift apart.
+
+
+def _seed_words(seeds) -> jax.Array:
+    """(B,) or (B, 2) int32 → (B, 2) seed words. Two 32-bit words give the
+    on-chip PRNG a 2⁶⁴ seed space — a (B,) input is zero-extended (kept for
+    the bench's block-indexed seeds, which are collision-free by
+    construction; key-tree-derived seeds use both words, rng.pallas_seeds)."""
+    seeds = jnp.asarray(seeds, jnp.int32)
+    if seeds.ndim == 1:
+        seeds = jnp.stack([seeds, jnp.zeros_like(seeds)], axis=-1)
+    return seeds
+
+
+def _taker(external: bool, u_ref, seed_ref):
+    """The kernel's uniform source: external-mode cursor reads from the
+    HBM uniform block (CPU-testable path), on-chip mode seeds the hardware
+    PRNG from the two SMEM seed words and draws fresh bits per take()."""
+    if external:
+        cursor = [0]
+
+        def take(shape):
+            r0 = cursor[0]
+            cursor[0] += shape[0]
+            return u_ref[0, pl.ds(r0, shape[0]), :]
+    else:
+        pltpu.prng_seed(seed_ref[0, 0, 0], seed_ref[0, 0, 1])
+
+        def take(shape):
+            return _rand_uniform(shape)
+
+    return take
+
+
+def _position_masks(rows: int, m: int, m_pad: int, k: int, leftover: int):
+    """(batch_elem, w) over the padded lane-group layout: position p holds
+    batch element (group p//m', offset p%m' < m), a leftover observation
+    (k·m' ≤ p < k·m'+leftover), or pure padding. ``w`` masks exactly the n
+    real observations (float), ``batch_elem`` the k·m estimator inputs
+    (bool)."""
+    pos = (jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0) * LANES
+           + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1))
+    batch_elem = (pos % m_pad < m) & (pos // m_pad < k)
+    in_leftover = (pos >= k * m_pad) & (pos < k * m_pad + leftover)
+    return batch_elem, (batch_elem | in_leftover).astype(jnp.float32)
+
+
+def _gmat(m_pad: int) -> jax.Array:
+    """Static 0/1 aggregation matrix: lane l feeds batch column l // m'
+    (columns ≥ 128//m' are identically zero — full-lane tiles)."""
+    return jnp.asarray(
+        (np.arange(LANES)[:, None] // m_pad) == np.arange(LANES)[None, :],
+        jnp.float32)
+
+
+def _replication_call(kernel, b: int, seeds2: jax.Array, rho_b: jax.Array,
+                      gmat: jax.Array, u_rows: int | None,
+                      uniforms: jax.Array | None, interpret: bool):
+    """One-replication-per-grid-step pallas_call shell. Mosaic requires
+    every block's trailing two dims to be divisible by (8, 128) or equal
+    to the array's — so the grid axis is a *leading* third dim everywhere
+    and each block's last two dims equal the array's. Out layout:
+    (b, 1, LANES) with the kernel's scalars in the leading lanes."""
+    in_specs = [
+        pl.BlockSpec((1, 1, 2), lambda i: (i, 0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((LANES, LANES), lambda i: (0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    inputs = [seeds2.reshape(b, 1, 2), rho_b.reshape(b, 1, 1), gmat]
+    if uniforms is not None:
+        in_specs.append(pl.BlockSpec((1, u_rows, LANES),
+                                     lambda i: (i, 0, 0),
+                                     memory_space=pltpu.VMEM))
+        inputs.append(uniforms.reshape(b, u_rows, LANES))
+    return pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, LANES), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, 1, LANES), jnp.float32),
+        # TPU interpret mode runs the kernel on CPU (pltpu.prng_* stubs
+        # return zeros there — external uniforms cover testing)
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(*inputs)
+
+
 def _laplace_from_uniform(u, scale):
     """Inverse-CDF Laplace(0, scale) — the reference's own sampler
     (real-data-sims.R:58-61) on centered u−½ ∈ (−½, ½)."""
@@ -110,21 +207,30 @@ def _laplace_from_uniform(u, scale):
     return -scale * jnp.sign(c) * jnp.log1p(-2.0 * jnp.abs(c))
 
 
-def n_uniform_rows(n: int, eps1: float = 1.0, eps2: float = 1.0) -> int:
+def n_uniform_rows(n: int, eps1: float = 1.0, eps2: float = 1.0,
+                   compute_int: bool = False) -> int:
     """Rows of (·, 128) uniforms one replication consumes (external mode):
-    u1 + u2 (rows each) + 8 standardization rows + 2·rows batch noise.
+    u1 + u2 (rows each) + 8 standardization rows + 2·rows batch noise,
+    plus (``compute_int``) 8 INT-standardization/Z rows + rows flip draws.
     ``rows`` depends on the ε-pair through the padded lane-group layout."""
     *_, rows = _layout(n, eps1, eps2)
-    return 4 * rows + 8
+    return 4 * rows + 8 + (rows + 8 if compute_int else 0)
 
 
 def _make_kernel(n: int, m: int, m_pad: int, k: int, leftover: int,
                  rows: int, eps1: float, eps2: float,
-                 mu, sigma, normalise: bool, external_uniforms: bool):
+                 mu, sigma, normalise: bool, external_uniforms: bool,
+                 compute_int: bool = False):
     g_cols = LANES // m_pad
     l_clip = math.sqrt(2.0 * math.log(n))
     scale_x = 2.0 / (m * eps1)
     scale_y = 2.0 / (m * eps2)
+    # INT sign-flip constants (vert-cor.R:170-191): sender = larger ε
+    eps_s, eps_r = max(eps1, eps2), min(eps1, eps2)
+    e_s = math.exp(eps_s)
+    p_keep = e_s / (e_s + 1.0)
+    c_eta = (e_s + 1.0) / (n * (e_s - 1.0))
+    scale_z = 2.0 * (e_s + 1.0) / (n * (e_s - 1.0) * eps_r)
 
     def kernel(seed_ref, rho_ref, gmat_ref, *rest):
         if external_uniforms:
@@ -132,20 +238,11 @@ def _make_kernel(n: int, m: int, m_pad: int, k: int, leftover: int,
             # zeros, so uniforms come from HBM and only the on-chip PRNG
             # is untested off-TPU
             u_ref, out_ref = rest
-            cursor = [0]
-
-            def take(shape):
-                r0 = cursor[0]
-                cursor[0] += shape[0]
-                return u_ref[0, pl.ds(r0, shape[0]), :]
         else:
-            (out_ref,) = rest
-            pltpu.prng_seed(seed_ref[0, 0, 0])
+            u_ref, (out_ref,) = None, rest
+        take = _taker(external_uniforms, u_ref, seed_ref)
 
-            def take(shape):
-                return _rand_uniform(shape)
-
-        rho = rho_ref[0, 0]
+        rho = rho_ref[0, 0, 0]
 
         # ---- generate: Box–Muller pair → 2×2 Cholesky (dgp.py:_bvn) ----
         u1 = take((rows, LANES))
@@ -156,33 +253,24 @@ def _make_kernel(n: int, m: int, m_pad: int, k: int, leftover: int,
         x = mu[0] + sigma[0] * z1
         y = mu[1] + sigma[1] * (rho * z1 + jnp.sqrt(1.0 - rho * rho) * z2)
 
-        # position masks over the padded lane-group layout: position p holds
-        # batch element (group p//m', offset p%m' < m), a leftover
-        # observation (k·m' ≤ p < k·m'+leftover), or pure padding
-        pos = (jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0) * LANES
-               + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1))
-        batch_elem = ((pos % m_pad < m) & (pos // m_pad < k))
-        in_leftover = (pos >= k * m_pad) & (pos < k * m_pad + leftover)
-        # moment mask: exactly the n real observations (vert-cor.R:322-348
+        # moment mask w: exactly the n real observations (vert-cor.R:322-348
         # standardizes over all n, estimator uses the first k·m)
-        w = (batch_elem | in_leftover).astype(jnp.float32)
+        batch_elem, w = _position_masks(rows, m, m_pad, k, leftover)
+
+        def center(v, eps, mu_noise):
+            # priv_standardize (vert-cor.R:322-348): clip, DP mean + DP
+            # 2nd moment (ε/2 each), standardize. Signs only need
+            # x − μ (σ_priv > 0), so the division is dropped and the DP
+            # 2nd moment (which the budget still pays for, ε/2) never
+            # needs to be materialized here.
+            vc = jnp.clip(v, -l_clip, l_clip)
+            eps_half = eps / 2.0
+            mu_p = (jnp.sum(vc * w) / n
+                    + mu_noise * 2.0 * l_clip / (n * eps_half))
+            return vc - mu_p
 
         if normalise:
-            # priv_standardize both sides (vert-cor.R:322-348): clip, DP
-            # mean + DP 2nd moment (ε/2 each), standardize. Signs only
-            # need x − μ (σ > 0), so the division is dropped.
             lap4 = _laplace_from_uniform(take((8, LANES)), 1.0)
-
-            def center(v, eps, mu_noise):
-                # sign((clip(v) − μ_priv)/σ_priv) = sign(clip(v) − μ_priv)
-                # since σ_priv > 0, so the DP 2nd moment (which the budget
-                # still pays for, ε/2) never needs to be materialized here
-                vc = jnp.clip(v, -l_clip, l_clip)
-                eps_half = eps / 2.0
-                mu_p = (jnp.sum(vc * w) / n
-                        + mu_noise * 2.0 * l_clip / (n * eps_half))
-                return vc - mu_p
-
             x_c = center(x, eps1, lap4[0, 0])
             y_c = center(y, eps2, lap4[1, 0])
         else:
@@ -214,57 +302,57 @@ def _make_kernel(n: int, m: int, m_pad: int, k: int, leftover: int,
         st = jnp.sum(t)
         st2 = jnp.sum(t * t)
 
+        if compute_int:
+            # ---- INT sign-flip on the same draw (vert-cor.R:164-195):
+            # the grid computes BOTH estimators per replication from one
+            # dataset (vert-cor.R:392-419), each with its own fresh DP
+            # centering noise (ci_NI/ci_INT both call priv_standardize,
+            # vert-cor.R:211-215, 268-273) ----
+            lap_i = _laplace_from_uniform(take((8, LANES)), 1.0)
+            if normalise:
+                x_i = center(x, eps1, lap_i[0, 0])
+                y_i = center(y, eps2, lap_i[1, 0])
+            else:
+                x_i, y_i = x, y
+            # randomized response: keep w.p. e^εs/(e^εs+1) (vert-cor.R:174)
+            flips = jnp.where(take((rows, LANES)) < p_keep, 1.0, -1.0)
+            core = flips * jnp.sign(x_i) * jnp.sign(y_i) * w
+            # debias + one receiver Laplace draw (vert-cor.R:186-191)
+            eta_int = c_eta * jnp.sum(core) + lap_i[2, 0] * scale_z
+        else:
+            eta_int = jnp.float32(0.0)
+
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
-        out_ref[0, 0, :] = jnp.where(lane == 0, st,
-                                     jnp.where(lane == 1, st2, 0.0))[0, :]
+        out_ref[0, 0, :] = jnp.where(
+            lane == 0, st,
+            jnp.where(lane == 1, st2,
+                      jnp.where(lane == 2, eta_int, 0.0)))[0, :]
 
     return kernel
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
+@partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
 def _ni_sign_pallas_sums(seeds: jax.Array, rho: jax.Array, n: int,
                          eps1: float, eps2: float, mu, sigma,
                          normalise: bool, interpret: bool,
+                         compute_int: bool = False,
                          uniforms: jax.Array | None = None):
+    seeds = _seed_words(seeds)
     b = seeds.shape[0]
     m, m_pad, k, leftover, rows = _layout(n, eps1, eps2)
     external = uniforms is not None
     kernel = _make_kernel(n, m, m_pad, k, leftover, rows, eps1, eps2,
-                          tuple(mu), tuple(sigma), normalise, external)
-    # static 0/1 aggregation matrix: lane l feeds batch column l // m'
-    gmat = jnp.asarray(
-        (np.arange(LANES)[:, None] // m_pad) == np.arange(LANES)[None, :],
-        jnp.float32)  # (128, 128); columns >= 128//m' are all zero
-
-    # Mosaic requires every block's trailing two dims to be divisible by
-    # (8, 128) or equal to the array's — so the grid axis is a *leading*
-    # third dim everywhere and each block's last two dims equal the array's.
-    in_specs = [
-        pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0), memory_space=pltpu.SMEM),
-        pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
-        pl.BlockSpec((LANES, LANES), lambda i: (0, 0),
-                     memory_space=pltpu.VMEM),
-    ]
-    inputs = [seeds.reshape(b, 1, 1), rho.reshape(1, 1), gmat]
-    if external:
-        u_rows = n_uniform_rows(n, eps1, eps2)
-        in_specs.append(pl.BlockSpec((1, u_rows, LANES),
-                                     lambda i: (i, 0, 0),
-                                     memory_space=pltpu.VMEM))
-        inputs.append(uniforms.reshape(b, u_rows, LANES))
-
-    out = pl.pallas_call(
-        kernel,
-        grid=(b,),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, LANES), lambda i: (i, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, 1, LANES), jnp.float32),
-        # TPU interpret mode runs the kernel on CPU (pltpu.prng_* stubs
-        # return zeros there — external uniforms cover testing)
-        interpret=pltpu.InterpretParams() if interpret else False,
-    )(*inputs)
-    return out[:, 0, 0], out[:, 0, 1]
+                          tuple(mu), tuple(sigma), normalise, external,
+                          compute_int)
+    # ρ rides a per-replication SMEM scalar like the seed, so one compiled
+    # kernel serves a whole shape bucket's ρ-sweep (the bucketed grid
+    # flattens (point × rep) pairs; scalar ρ callers broadcast).
+    rho = jnp.broadcast_to(jnp.asarray(rho, jnp.float32), (b,))
+    u_rows = (n_uniform_rows(n, eps1, eps2, compute_int) if external
+              else None)
+    out = _replication_call(kernel, b, seeds, rho, _gmat(m_pad), u_rows,
+                            uniforms, interpret)
+    return out[:, 0, 0], out[:, 0, 1], out[:, 0, 2]
 
 
 def ni_sign_pallas(seeds: jax.Array, rho, n: int, eps1: float, eps2: float,
@@ -293,10 +381,17 @@ def ni_sign_pallas(seeds: jax.Array, rho, n: int, eps1: float, eps2: float,
             "on-chip PRNG is only live on real TPU (the interpreter stubs "
             "pltpu.prng_random_bits to zeros) — pass `uniforms` with shape "
             f"(B, {n_uniform_rows(n, eps1, eps2)}, {LANES}) off-TPU")
-    st, st2 = _ni_sign_pallas_sums(
+    st, st2, _ = _ni_sign_pallas_sums(
         jnp.asarray(seeds, jnp.int32), jnp.float32(rho), n, eps1, eps2,
         tuple(mu), tuple(sigma), normalise, interpret, uniforms=uniforms)
+    return _ni_result(st, st2, k, alpha)
 
+
+def _ni_result(st: jax.Array, st2: jax.Array, k: int,
+               alpha: float) -> CorrResult:
+    """NI estimate + CI from the kernel's (ΣT_j, ΣT_j²) scalars — the same
+    η-space clamp-then-sine construction as ``ci_ni_signbatch``
+    (vert-cor.R:249-254)."""
     eta_hat = st / k
     var_t = jnp.maximum((st2 - k * eta_hat * eta_hat) / (k - 1), 0.0)
     rho_hat = jnp.sin(jnp.pi * eta_hat / 2.0)
@@ -304,3 +399,61 @@ def ni_sign_pallas(seeds: jax.Array, rho, n: int, eps1: float, eps2: float,
     lo = jnp.sin(jnp.pi / 2.0 * jnp.maximum(eta_hat - half, -1.0))
     hi = jnp.sin(jnp.pi / 2.0 * jnp.minimum(eta_hat + half, 1.0))
     return CorrResult(rho_hat, lo, hi)
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+def _sim_detail_jit(seeds, rhos, n: int, eps1: float, eps2: float,
+                    mu, sigma, alpha: float, ci_mode: str,
+                    normalise: bool, interpret: bool, uniforms=None):
+    from dpcorr.models.estimators.int_sign import interval_from_rho
+    from dpcorr.sim import _metrics_row
+
+    _, k = batch_geometry(n, eps1, eps2)
+    st, st2, eta_int = _ni_sign_pallas_sums(
+        seeds, rhos, n, eps1, eps2, mu, sigma, normalise, interpret,
+        True, uniforms=uniforms)
+    ni = _ni_result(st, st2, k, alpha)
+    rho_hat_int = jnp.sin(jnp.pi * eta_int / 2.0)
+    eps_s, eps_r = max(eps1, eps2), min(eps1, eps2)
+    # det mixquant only: the closed-form quantile needs no key (the grid's
+    # fused path is gated on mixquant_mode="det")
+    it = interval_from_rho(None, rho_hat_int, n, eps_s, eps_r, alpha,
+                           ci_mode, "det")
+    return _metrics_row(ni, it, rhos)
+
+
+def sim_detail_pallas(seeds: jax.Array, rhos, n: int, eps1: float,
+                      eps2: float, mu=(0.0, 0.0), sigma=(1.0, 1.0),
+                      alpha: float = 0.05, ci_mode: str = "auto",
+                      normalise: bool = True,
+                      interpret: bool | None = None,
+                      uniforms: jax.Array | None = None) -> tuple:
+    """Whole-replication fused simulation: one kernel pass generates the
+    data on-chip and computes BOTH the NI sign-batch sums and the INT
+    sign-flip η̂ from it (the reference's hot-loop body computes both
+    estimators per dataset, vert-cor.R:392-419), then the CI constructions
+    run as scalar XLA ops. Returns the 12-tuple in
+    :data:`dpcorr.sim.DETAIL_FIELDS` order — drop-in for
+    ``sim._run_detail_flat`` where :func:`use_ni_sign_pallas` allows
+    (Gaussian DGP, det mixquant; the bucketed grid backend's ``fused``
+    mode is the consumer).
+
+    ``rhos``: scalar or (B,) per-replication ρ (the bucketed grid flattens
+    design points × replications).
+    """
+    m, k = batch_geometry(n, eps1, eps2)
+    if not use_ni_sign_pallas(n, eps1, eps2):
+        raise ValueError(
+            f"fused kernel needs m <= {LANES} and k >= 2, got m={m}, k={k}; "
+            f"use the XLA path (see use_ni_sign_pallas)")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if interpret and uniforms is None:
+        raise ValueError(
+            "on-chip PRNG is only live on real TPU — pass `uniforms` with "
+            f"shape (B, {n_uniform_rows(n, eps1, eps2, True)}, {LANES}) "
+            "off-TPU")
+    return _sim_detail_jit(jnp.asarray(seeds, jnp.int32),
+                           jnp.asarray(rhos, jnp.float32), n, eps1, eps2,
+                           tuple(mu), tuple(sigma), float(alpha), ci_mode,
+                           normalise, interpret, uniforms=uniforms)

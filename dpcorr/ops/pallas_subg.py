@@ -1,0 +1,238 @@
+"""Pallas TPU kernel: one fully-fused sub-Gaussian replication (v2 grid).
+
+The subG grid's hot-loop body (ver-cor-subG.R:174-198) per replication:
+generate a ``bounded_factor`` pair (ver-cor-subG.R:141-154), run the NI
+clipped-batch estimator (ver-cor-subG.R:25-62) and the INT clipped
+estimator (ver-cor-subG.R:67-108) on it. This kernel runs all of it inside
+VMEM on one grid step, mirroring the sign-estimator kernel
+(:mod:`dpcorr.ops.pallas_ni`, whose layout/PRNG helpers it shares):
+
+- **bounded-factor DGP on-chip**: X = U+E₁, Y = U+E₂ from three uniform
+  planes scaled by √(3ρ) / √(3(1−ρ)) — ρ rides a per-replication SMEM
+  scalar, so one compiled kernel serves a bucket's whole ρ-sweep;
+- **NI clipped-batch**: clip at ±λᵢ = λ_n(n, ηᵢ), batch means as the same
+  MXU matmul against the 0/1 aggregation matrix, per-batch Laplace
+  (scale 2λ/(m·ε)), Σ T_j / Σ T_j²  (ver-cor-subG.R:33-52);
+- **INT clipped**: sender clips at λ_s and releases per-sample
+  ``clip(X)+Lap(2λ_s/ε_s)`` (local DP), receiver multiplies by its own
+  *unclipped* variable (grid-variant semantics), clips the product at λ_r,
+  and adds one central draw (ver-cor-subG.R:87-97); the kernel emits
+  Σ Uc / Σ Uc² and ρ̂_INT.
+
+Five scalars leave the chip per replication; the CI constructions (normal
+for NI, det-mixquant ``grid_interval`` for INT) run as scalar XLA ops in
+:func:`sim_detail_subg_pallas`, which returns the full 12-column detail
+row — the bucketed grid backend's fused path for ``use_subg`` buckets
+(``subg_variant="grid"`` only: the real-data variant's randomized batch
+permutation has no in-kernel equivalent).
+
+Like the sign kernel, estimates are distribution-identical to the XLA
+estimators but draw from the on-chip PRNG — acceptance is statistical
+(SURVEY.md §5 RNG), validated in ``tests/test_pallas_subg.py``.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import ndtri
+
+from dpcorr.models.estimators.common import CorrResult, batch_geometry
+from dpcorr.ops.lambdas import lambda_int_n, lambda_n
+from dpcorr.ops.pallas_ni import (
+    LANES,
+    _gmat,
+    _laplace_from_uniform,
+    _layout,
+    _position_masks,
+    _replication_call,
+    _seed_words,
+    _taker,
+    use_ni_sign_pallas,
+)
+
+
+def use_subg_pallas(n: int, eps1: float, eps2: float) -> bool:
+    """Same geometry envelope as the sign kernel: m ≤ 128 lanes, k ≥ 2."""
+    return use_ni_sign_pallas(n, eps1, eps2)
+
+
+def n_uniform_rows_subg(n: int, eps1: float = 1.0, eps2: float = 1.0) -> int:
+    """(·, 128) uniform rows per replication in external mode: 3·rows DGP
+    planes + 2·rows NI batch noise + rows INT sender noise + 8 central."""
+    *_, rows = _layout(n, eps1, eps2)
+    return 6 * rows + 8
+
+
+def _lambdas(n: int, eps1: float, eps2: float, eta1: float, eta2: float):
+    """All four static clip thresholds as Python floats, evaluated OUTSIDE
+    any jit trace (the λ rules are jnp formulas — lambdas.py — and inside a
+    trace even scalar constants stage into tracers)."""
+    lam1 = float(lambda_n(n, eta1))  # ver-cor-subG.R:33-34
+    lam2 = float(lambda_n(n, eta2))
+    sender_is_x = eps1 >= eps2       # ver-cor-subG.R:76-81
+    eta_s, eta_r = (eta1, eta2) if sender_is_x else (eta2, eta1)
+    lam_s, lam_r = (float(v) for v in
+                    lambda_int_n(n, eta_s=eta_s, eta_r=eta_r,
+                                 eps_s=max(eps1, eps2)))
+    return lam1, lam2, lam_s, lam_r
+
+
+def _make_kernel(n: int, m: int, m_pad: int, k: int, leftover: int,
+                 rows: int, eps1: float, eps2: float,
+                 lams: tuple, external_uniforms: bool):
+    g_cols = LANES // m_pad
+    lam1, lam2, lam_s, lam_r = lams
+    scale_x = 2.0 * lam1 / (m * eps1)
+    scale_y = 2.0 * lam2 / (m * eps2)
+    # INT roles: larger ε sends (ver-cor-subG.R:76-81) — static
+    sender_is_x = eps1 >= eps2
+    eps_s, eps_r = (eps1, eps2) if sender_is_x else (eps2, eps1)
+    sender_scale = 2.0 * lam_s / eps_s
+    central_scale = 2.0 * lam_r / (n * eps_r)
+
+    def kernel(seed_ref, rho_ref, gmat_ref, *rest):
+        if external_uniforms:
+            u_ref, out_ref = rest
+        else:
+            u_ref, (out_ref,) = None, rest
+        take = _taker(external_uniforms, u_ref, seed_ref)
+
+        rho = rho_ref[0, 0, 0]
+
+        # ---- bounded-factor DGP (ver-cor-subG.R:141-154) ----
+        c_u = jnp.sqrt(3.0 * rho)
+        c_e = jnp.sqrt(3.0 * (1.0 - rho))
+        uu = (2.0 * take((rows, LANES)) - 1.0) * c_u
+        e1 = (2.0 * take((rows, LANES)) - 1.0) * c_e
+        e2 = (2.0 * take((rows, LANES)) - 1.0) * c_e
+        x = uu + e1
+        y = uu + e2
+
+        batch_elem, w = _position_masks(rows, m, m_pad, k, leftover)
+        bmask = batch_elem.astype(jnp.float32)
+
+        # ---- NI clipped-batch sums on the MXU (ver-cor-subG.R:33-52) ----
+        xc = jnp.clip(x, -lam1, lam1) * bmask
+        yc = jnp.clip(y, -lam2, lam2) * bmask
+        g = gmat_ref[...]
+        xb = jnp.dot(xc, g, preferred_element_type=jnp.float32) / m
+        yb = jnp.dot(yc, g, preferred_element_type=jnp.float32) / m
+        lap_xy = _laplace_from_uniform(take((2 * rows, LANES)), 1.0)
+        xt = xb + lap_xy[:rows, :] * scale_x
+        yt = yb + lap_xy[rows:, :] * scale_y
+        rr = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
+        cc = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+        live = (cc < g_cols) & (rr * g_cols + cc < k)
+        t = jnp.where(live, m * xt * yt, 0.0)
+        st = jnp.sum(t)
+        st2 = jnp.sum(t * t)
+
+        # ---- INT clipped (grid variant, ver-cor-subG.R:87-97): sender
+        # local-DP release × receiver's *unclipped* variable ----
+        xs, xo = (x, y) if sender_is_x else (y, x)
+        sc = jnp.clip(xs, -lam_s, lam_s)
+        lap_send = _laplace_from_uniform(take((rows, LANES)), 1.0)
+        u_prod = (sc + lap_send * sender_scale) * xo
+        uc = jnp.clip(u_prod, -lam_r, lam_r) * w  # all n real obs
+        sum_uc = jnp.sum(uc)
+        sumsq_uc = jnp.sum(uc * uc)
+        lap8 = _laplace_from_uniform(take((8, LANES)), 1.0)
+        rho_int = sum_uc / n + lap8[0, 0] * central_scale
+
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+        out = jnp.where(lane == 0, st,
+                        jnp.where(lane == 1, st2,
+                                  jnp.where(lane == 2, sum_uc,
+                                            jnp.where(lane == 3, sumsq_uc,
+                                                      jnp.where(lane == 4,
+                                                                rho_int,
+                                                                0.0)))))
+        out_ref[0, 0, :] = out[0, :]
+
+    return kernel
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+def _subg_pallas_sums(seeds: jax.Array, rho: jax.Array, n: int,
+                      eps1: float, eps2: float, lams: tuple,
+                      interpret: bool, uniforms: jax.Array | None = None):
+    seeds = _seed_words(seeds)
+    b = seeds.shape[0]
+    m, m_pad, k, leftover, rows = _layout(n, eps1, eps2)
+    external = uniforms is not None
+    kernel = _make_kernel(n, m, m_pad, k, leftover, rows, eps1, eps2,
+                          lams, external)
+    rho = jnp.broadcast_to(jnp.asarray(rho, jnp.float32), (b,))
+    u_rows = n_uniform_rows_subg(n, eps1, eps2) if external else None
+    out = _replication_call(kernel, b, seeds, rho, _gmat(m_pad), u_rows,
+                            uniforms, interpret)
+    return tuple(out[:, 0, j] for j in range(5))
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+def _sim_detail_subg_jit(seeds, rhos, n: int, eps1: float, eps2: float,
+                         lams: tuple, alpha: float,
+                         interpret: bool, uniforms=None):
+    from dpcorr.models.estimators.int_subg import grid_interval
+    from dpcorr.sim import _metrics_row
+
+    m, k = batch_geometry(n, eps1, eps2)
+    st, st2, sum_uc, sumsq_uc, rho_int = _subg_pallas_sums(
+        seeds, rhos, n, eps1, eps2, lams, interpret,
+        uniforms=uniforms)
+
+    # NI: ρ̂ = η̂ (no sine link), normal CI, ρ-space clamp
+    # (ver-cor-subG.R:51-59)
+    rho_ni = st / k
+    var_t = jnp.maximum((st2 - k * rho_ni * rho_ni) / (k - 1), 0.0)
+    se = jnp.sqrt(var_t) / math.sqrt(k)
+    crit = ndtri(1.0 - alpha / 2.0)
+    ni = CorrResult(rho_ni, jnp.maximum(rho_ni - crit * se, -1.0),
+                    jnp.minimum(rho_ni + crit * se, 1.0))
+
+    # INT: det-mixquant grid interval from (ρ̂, sd(Uc))
+    # (ver-cor-subG.R:99-104)
+    mean_uc = sum_uc / n
+    sd_uc = jnp.sqrt(jnp.maximum(
+        (sumsq_uc - n * mean_uc * mean_uc) / (n - 1), 0.0))
+    eps_r = min(eps1, eps2)
+    lam_r = lams[3]
+    central_scale = 2.0 * lam_r / (n * eps_r)
+    it = grid_interval(None, rho_int, sd_uc, n, eps_r, central_scale,
+                       alpha, "det")
+    return _metrics_row(ni, it, rhos)
+
+
+def sim_detail_subg_pallas(seeds: jax.Array, rhos, n: int, eps1: float,
+                           eps2: float, eta1: float = 1.0,
+                           eta2: float = 1.0, alpha: float = 0.05,
+                           interpret: bool | None = None,
+                           uniforms: jax.Array | None = None) -> tuple:
+    """Fused subG replication batch → 12-tuple in
+    :data:`dpcorr.sim.DETAIL_FIELDS` order (drop-in for
+    ``sim._run_detail_flat`` on ``use_subg`` grid-variant buckets with the
+    ``bounded_factor`` DGP and det mixquant).
+
+    ``rhos``: scalar or (B,) per-replication ρ.
+    """
+    m, k = batch_geometry(n, eps1, eps2)
+    if not use_subg_pallas(n, eps1, eps2):
+        raise ValueError(
+            f"fused kernel needs m <= {LANES} and k >= 2, got m={m}, k={k}; "
+            f"use the XLA path (see use_subg_pallas)")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if interpret and uniforms is None:
+        raise ValueError(
+            "on-chip PRNG is only live on real TPU — pass `uniforms` with "
+            f"shape (B, {n_uniform_rows_subg(n, eps1, eps2)}, {LANES}) "
+            "off-TPU")
+    lams = _lambdas(n, eps1, eps2, float(eta1), float(eta2))
+    return _sim_detail_subg_jit(jnp.asarray(seeds, jnp.int32),
+                                jnp.asarray(rhos, jnp.float32), n,
+                                eps1, eps2, lams,
+                                float(alpha), interpret, uniforms=uniforms)

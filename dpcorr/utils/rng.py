@@ -68,6 +68,21 @@ def rep_keys(key: jax.Array, n_reps: int) -> jax.Array:
     return jax.vmap(lambda b: jax.random.fold_in(key, b))(jnp.arange(n_reps))
 
 
+def pallas_seeds(key: jax.Array, n_reps: int) -> jax.Array:
+    """Per-replication (n_reps, 2) int32 seed words for the on-chip
+    (Pallas) hardware PRNG, derived from the key-tree so fused-kernel runs
+    keep the same determinism contract (master seed → design point → this
+    array). Two words give a 2⁶⁴ seed space — a single-word draw would hit
+    birthday duplicates at campaign scale (≈256 expected colliding pairs
+    among 2²⁰ draws from 2³¹), silently repeating replications. The
+    kernel's counter PRNG is a different stream family from threefry —
+    results are reproducible but not bit-comparable to the XLA path
+    (grid.py stamps fused results separately)."""
+    return jax.random.randint(stream(key, "pallas/seeds"), (n_reps, 2),
+                              jnp.iinfo(jnp.int32).min,
+                              jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+
+
 def stream(key: jax.Array, name: str) -> jax.Array:
     """Named substream: stable across code movement, unlike split() order.
 

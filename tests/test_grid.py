@@ -136,3 +136,66 @@ def test_summarize_grid_pure_function():
     ni = s[s["method"] == "NI"].iloc[0]
     assert ni["coverage"] == 0.75
     np.testing.assert_allclose(ni["bias"], 0.0, atol=1e-12)
+
+
+# ---- fused (Pallas) bucket selection ----
+
+def test_fused_bucket_eligibility(monkeypatch):
+    """_fused_bucket_ok gates the Pallas kernel on platform, backend,
+    estimator family, DGP, mixquant mode, and batch geometry."""
+    import dataclasses
+
+    import jax
+
+    from dpcorr import grid as g
+
+    gc = GridConfig(**SMALL, backend="bucketed", fused="auto")
+    cfg = gc.sim_config({"n": 1000, "rho": 0.5, "eps1": 1.0, "eps2": 1.0})
+
+    # CPU platform (the test env) → never eligible
+    assert not g._fused_bucket_ok(gc, cfg)
+
+    class _FakeTpu:
+        platform = "tpu"
+
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_FakeTpu()])
+    assert g._fused_bucket_ok(gc, cfg) == "sign"
+    assert not g._fused_bucket_ok(dataclasses.replace(gc, fused="off"), cfg)
+    assert not g._fused_bucket_ok(
+        dataclasses.replace(gc, backend="bucketed-sharded"), cfg)
+    assert not g._fused_bucket_ok(gc, dataclasses.replace(cfg, dgp="bernoulli"))
+    assert not g._fused_bucket_ok(
+        gc, dataclasses.replace(cfg, mixquant_mode="mc"))
+    # m = ceil(8/(0.05·0.05)) = 3200 > 128 lanes
+    assert not g._fused_bucket_ok(
+        gc, dataclasses.replace(cfg, eps1=0.05, eps2=0.05))
+    # subG: fused only under "all" (perf-neutral vs XLA — GridConfig.fused)
+    # and only for the grid-variant bounded-factor pair
+    gc_all = dataclasses.replace(gc, fused="all")
+    subg = dataclasses.replace(cfg, use_subg=True, dgp="bounded_factor")
+    assert not g._fused_bucket_ok(gc, subg)  # "auto" never fuses subG
+    assert g._fused_bucket_ok(gc_all, subg) == "subg"
+    assert g._fused_bucket_ok(gc_all, cfg) == "sign"  # "all" ⊇ "auto"
+    assert not g._fused_bucket_ok(
+        gc_all, dataclasses.replace(subg, subg_variant="real"))
+    assert not g._fused_bucket_ok(
+        gc_all, dataclasses.replace(subg, dgp="mix_gaussian"))
+    assert not g._fused_bucket_ok(
+        gc_all, dataclasses.replace(cfg, use_subg=True))  # gaussian + subG
+    with pytest.raises(ValueError, match="fused"):
+        g._fused_bucket_ok(dataclasses.replace(gc, fused="bogus"), cfg)
+
+
+def test_fused_auto_on_cpu_matches_off(tmp_path):
+    """fused="auto" on a CPU host must be a no-op: every bucket is
+    ineligible, results and caches stay bit-identical to fused="off"."""
+    off = run_grid(GridConfig(**SMALL, backend="bucketed"))
+    auto = run_grid(GridConfig(**SMALL, backend="bucketed", fused="auto",
+                               out_dir=str(tmp_path)))
+    pd.testing.assert_frame_equal(off.detail_all, auto.detail_all)
+    assert not auto.timings["fused"].any()
+    # cache stamps carry no fused tag → a fused="off" resume hits them
+    res = run_grid(GridConfig(**SMALL, backend="bucketed",
+                              out_dir=str(tmp_path)))
+    assert res.timings["points_run"].sum() == 0
+    pd.testing.assert_frame_equal(off.detail_all, res.detail_all)

@@ -139,3 +139,79 @@ def test_layout_invariants_reference_grid():
             from dpcorr.ops.pallas_ni import n_uniform_rows
 
             assert n_uniform_rows(n, e1, e2) == 4 * rows + 8
+
+
+# ---- fused NI+INT simulation kernel (sim_detail_pallas) ----
+
+def _uniforms_int(key, n, b, eps1=1.0, eps2=1.0):
+    return jax.random.uniform(
+        key, (b, n_uniform_rows(n, eps1, eps2, compute_int=True), 128),
+        jnp.float32, minval=1e-7, maxval=1.0 - 1e-7)
+
+
+def test_fused_sim_detail_statistics():
+    """The fused whole-replication kernel (NI + INT on one in-kernel draw,
+    the hot-loop body vert-cor.R:392-419) must reproduce the XLA
+    simulator's detail statistics within MC error."""
+    from dpcorr.ops.pallas_ni import sim_detail_pallas
+    from dpcorr.sim import DETAIL_FIELDS
+
+    b = 512
+    u = _uniforms_int(rng.master_key(21), N, b)
+    raw = sim_detail_pallas(np.arange(b, dtype=np.int32), RHO, N, 1.0, 1.0,
+                            uniforms=u)
+    d = dict(zip(DETAIL_FIELDS, [np.asarray(a) for a in raw], strict=True))
+    xla = run_sim_one(SimConfig(n=N, rho=RHO, eps1=1.0, eps2=1.0,
+                                b=b)).summary
+    for a in d.values():
+        assert np.isfinite(a).all()
+    assert abs(d["ni_hat"].mean() - RHO - xla["NI"]["bias"]) < 0.05
+    assert abs(d["ni_cover"].mean() - xla["NI"]["coverage"]) < 0.06
+    assert abs(d["int_hat"].mean() - RHO - xla["INT"]["bias"]) < 0.05
+    assert abs(d["int_cover"].mean() - xla["INT"]["coverage"]) < 0.06
+    assert 0.5 < d["int_se2"].mean() / xla["INT"]["mse"] < 2.0
+    # det-mixquant CI width is a near-deterministic function of η̂ —
+    # the two PRNG streams must land on the same construction
+    assert 0.9 < d["int_ci_len"].mean() / xla["INT"]["ci_length"] < 1.1
+    assert (d["int_low"] <= d["int_up"]).all()
+    assert (d["ni_low"] <= d["ni_up"]).all()
+
+
+def test_fused_sim_detail_per_rep_rho():
+    """ρ rides per-replication (the bucketed grid flattens points × reps):
+    reps at ρ=0 and ρ=0.8 inside one call must center on their own ρ."""
+    from dpcorr.ops.pallas_ni import sim_detail_pallas
+    from dpcorr.sim import DETAIL_FIELDS
+
+    b = 256
+    rhos = np.concatenate([np.zeros(b), np.full(b, 0.8)]).astype(np.float32)
+    u = _uniforms_int(rng.master_key(22), N, 2 * b)
+    raw = sim_detail_pallas(np.arange(2 * b, dtype=np.int32), rhos,
+                            N, 1.0, 1.0, uniforms=u)
+    d = dict(zip(DETAIL_FIELDS, [np.asarray(a) for a in raw], strict=True))
+    assert abs(d["ni_hat"][:b].mean() - 0.0) < 0.05
+    assert abs(d["ni_hat"][b:].mean() - 0.8) < 0.05
+    assert abs(d["int_hat"][:b].mean() - 0.0) < 0.06
+    assert abs(d["int_hat"][b:].mean() - 0.8) < 0.06
+
+
+def test_fused_int_laplace_regime():
+    """√n·ε_r ≤ 0.5 switches the INT CI to the pure-Laplace tail bound
+    (vert-cor.R:294-308); the fused kernel must land in the same regime
+    and produce the same (η-deterministic) width as the XLA path."""
+    from dpcorr.ops.pallas_ni import sim_detail_pallas, use_ni_sign_pallas
+    from dpcorr.sim import DETAIL_FIELDS
+
+    eps1, eps2 = 5.0, 0.015   # m=107 ≤ 128; √1024·0.015 = 0.48 < 0.5
+    assert use_ni_sign_pallas(N, eps1, eps2)
+    b = 384
+    u = _uniforms_int(rng.master_key(23), N, b, eps1, eps2)
+    raw = sim_detail_pallas(np.arange(b, dtype=np.int32), RHO, N,
+                            eps1, eps2, uniforms=u)
+    d = dict(zip(DETAIL_FIELDS, [np.asarray(a) for a in raw], strict=True))
+    xla = run_sim_one(SimConfig(n=N, rho=RHO, eps1=eps1, eps2=eps2,
+                                b=b)).summary["INT"]
+    assert np.isfinite(d["int_hat"]).all()
+    assert 0.9 < d["int_ci_len"].mean() / xla["ci_length"] < 1.1
+    # coverage SE ≈ 0.018 per stream at b=384 → |diff| bound ≈ 3·√2·SE
+    assert abs(d["int_cover"].mean() - xla["coverage"]) < 0.08

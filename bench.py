@@ -51,10 +51,15 @@ RHO = 0.5
 ALPHA = 0.05
 MAX_BLOCKS = 32
 
-# Per-platform knobs: (block_reps, vmap_chunk) sized so one block is a few
-# seconds of device time on the respective backend. Overridable for tuning
-# runs without editing: DPCORR_BENCH_BLOCK_REPS / DPCORR_BENCH_CHUNK.
-WORKER_SHAPE = {"tpu": (32 * 1024, 2048), "cpu": (2048, 256)}
+# Per-platform knobs: (block_reps, vmap_chunk). The TPU shape is the
+# measured sweet spot of the 2026-07-30 block-scaling sweep
+# (benchmarks/results/r02_tpu_headline.json "block_scaling"): each block
+# fetch pays ~0.2s of remote-tunnel latency, so small blocks measure the
+# tunnel, not the chip — 2^19 reps/block reached 982k reps/sec (235x
+# baseline) with stable coverage; 2^20 exceeded the worker timeout through
+# the tunnel. Overridable for tuning runs without editing:
+# DPCORR_BENCH_BLOCK_REPS / DPCORR_BENCH_CHUNK.
+WORKER_SHAPE = {"tpu": (512 * 1024, 16384), "cpu": (2048, 256)}
 
 
 def _worker_shape(mode: str) -> tuple[int, int]:

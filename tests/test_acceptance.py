@@ -90,3 +90,31 @@ def test_checked_in_table_meets_criteria(path):
                 assert row.get("tol_reason"), row["point"]
             assert abs(cov - table["nominal"]) <= max(envelope, tol), (
                 f"{row['point']}/{meth}: coverage {cov}")
+
+
+def test_fused_campaign_table_meets_criteria():
+    """The fused (on-chip-PRNG Pallas) kernels' own B=2²⁰ hardware
+    campaign (`benchmarks/results/r02_fused_acceptance.json`,
+    benchmarks/fused_acceptance_tpu.py) must sit at nominal within the
+    same 1e-3 + MC-SE envelope as the XLA table — except INT subG, whose
+    construction under-covers at finite n by design (the XLA acceptance
+    table's subg_factor attribution; ≈0.94 at B=10⁶ even at ε=(1,1))."""
+    path = RESULTS_DIR / "r02_fused_acceptance.json"
+    if not path.exists():
+        pytest.skip("no fused campaign table checked in")
+    table = json.loads(path.read_text())
+    nominal = table["nominal"]
+    fams = table["families"]
+    for fam in ("sign", "subg"):
+        assert fams[fam]["B"] >= 1_000_000
+    def envelope(fam):
+        return 1e-3 + 3.5 * fams[fam]["mc_se"]
+
+    assert abs(fams["sign"]["coverage_NI"] - nominal) <= envelope("sign")
+    assert abs(fams["sign"]["coverage_INT"] - nominal) <= envelope("sign")
+    assert abs(fams["subg"]["coverage_NI"] - nominal) <= envelope("subg")
+    # intrinsic finite-n under-coverage of the INT subG construction: at
+    # or below nominal (within MC error above), never below the band the
+    # XLA campaign measured
+    assert (0.93 <= fams["subg"]["coverage_INT"]
+            <= nominal + envelope("subg"))

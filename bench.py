@@ -127,7 +127,9 @@ def worker_main(mode: str, budget_s: float) -> None:
 
         seeds = block_idx * n_reps + jnp.arange(n_reps, dtype=jnp.int32)
         r = ni_sign_pallas(seeds, RHO, N, EPS1, EPS2, alpha=ALPHA,
-                           interpret=False)
+                           interpret=False,
+                           gauss=os.environ.get("DPCORR_BENCH_PALLAS_GAUSS",
+                                                "boxmuller"))
         se2, cover, ci_len = _metrics(r)
         return jnp.mean(se2), jnp.mean(cover), jnp.mean(ci_len)
 

@@ -200,6 +200,49 @@ def _replication_call(kernel, b: int, seeds2: jax.Array, rho_b: jax.Array,
     )(*inputs)
 
 
+def _ndtri_inline(p):
+    """Inverse standard-normal CDF as an in-kernel rational polynomial
+    (Acklam's algorithm; ~1.15e-9 relative in f64, but the kernel runs
+    f32 where cancellation near the central/tail seam |z|≈1.97 brings
+    the max abs error to ~3e-4 — same order as Box–Muller's own f32
+    rounding, and the draws only feed sign/clip estimators whose 1e-3
+    coverage criterion is insensitive at that scale).
+    ``jax.scipy.special.ndtri`` lowers with captured f32 coefficient
+    *tables*, which a pallas kernel cannot close over — these scalar
+    literals fold into the kernel. Central branch costs two
+    ~5-term polynomial chains; the tail branch's log+sqrt run on all lanes
+    (SIMD ``where`` evaluates both sides), so the saving vs Box–Muller is
+    cos+sin, not the log."""
+    q = p - 0.5
+    r = q * q
+    central = (q * (((((-3.969683028665376e+01 * r
+                        + 2.209460984245205e+02) * r
+                       - 2.759285104469687e+02) * r
+                      + 1.383577518672690e+02) * r
+                     - 3.066479806614716e+01) * r
+                    + 2.506628277459239e+00)
+               / (((((-5.447609879822406e+01 * r
+                      + 1.615858368580409e+02) * r
+                     - 1.556989798598866e+02) * r
+                    + 6.680131188771972e+01) * r
+                   - 1.328068155288572e+01) * r + 1.0))
+    # lower tail on min(p, 1-p), mirrored by sign
+    pt = jnp.minimum(p, 1.0 - p)
+    s = jnp.sqrt(-2.0 * jnp.log(pt))
+    tail = ((((((-7.784894002430293e-03 * s
+                 - 3.223964580411365e-01) * s
+                - 2.400758277161838e+00) * s
+               - 2.549732539343734e+00) * s
+              + 4.374664141464968e+00) * s
+             + 2.938163982698783e+00)
+            / ((((7.784695709041462e-03 * s
+                  + 3.224671290700398e-01) * s
+                 + 2.445134137142996e+00) * s
+                + 3.754408661907416e+00) * s + 1.0))
+    tail = jnp.where(q < 0.0, tail, -tail)
+    return jnp.where(jnp.abs(q) <= 0.5 - 0.02425, central, tail)
+
+
 def _laplace_from_uniform(u, scale):
     """Inverse-CDF Laplace(0, scale) — the reference's own sampler
     (real-data-sims.R:58-61) on centered u−½ ∈ (−½, ½)."""
@@ -220,7 +263,7 @@ def n_uniform_rows(n: int, eps1: float = 1.0, eps2: float = 1.0,
 def _make_kernel(n: int, m: int, m_pad: int, k: int, leftover: int,
                  rows: int, eps1: float, eps2: float,
                  mu, sigma, normalise: bool, external_uniforms: bool,
-                 compute_int: bool = False):
+                 compute_int: bool = False, gauss: str = "boxmuller"):
     g_cols = LANES // m_pad
     l_clip = math.sqrt(2.0 * math.log(n))
     scale_x = 2.0 / (m * eps1)
@@ -244,12 +287,20 @@ def _make_kernel(n: int, m: int, m_pad: int, k: int, leftover: int,
 
         rho = rho_ref[0, 0, 0]
 
-        # ---- generate: Box–Muller pair → 2×2 Cholesky (dgp.py:_bvn) ----
+        # ---- generate: standard-normal planes → 2×2 Cholesky
+        # (dgp.py:_bvn). Two exact samplers, selectable because the
+        # kernel is VPU-bound on this step: "boxmuller" costs
+        # log+sqrt+cos+sin per pair, "ndtri" one inverse-CDF per normal
+        # (same uniform consumption, so external-mode tests cover both).
         u1 = take((rows, LANES))
         u2 = take((rows, LANES))
-        r = jnp.sqrt(-2.0 * jnp.log(u1))
-        z1 = r * jnp.cos(_TWO_PI * u2)
-        z2 = r * jnp.sin(_TWO_PI * u2)
+        if gauss == "ndtri":
+            z1 = _ndtri_inline(u1)
+            z2 = _ndtri_inline(u2)
+        else:
+            r = jnp.sqrt(-2.0 * jnp.log(u1))
+            z1 = r * jnp.cos(_TWO_PI * u2)
+            z2 = r * jnp.sin(_TWO_PI * u2)
         x = mu[0] + sigma[0] * z1
         y = mu[1] + sigma[1] * (rho * z1 + jnp.sqrt(1.0 - rho * rho) * z2)
 
@@ -331,11 +382,12 @@ def _make_kernel(n: int, m: int, m_pad: int, k: int, leftover: int,
     return kernel
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
+@partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
 def _ni_sign_pallas_sums(seeds: jax.Array, rho: jax.Array, n: int,
                          eps1: float, eps2: float, mu, sigma,
                          normalise: bool, interpret: bool,
                          compute_int: bool = False,
+                         gauss: str = "boxmuller",
                          uniforms: jax.Array | None = None):
     seeds = _seed_words(seeds)
     b = seeds.shape[0]
@@ -343,7 +395,7 @@ def _ni_sign_pallas_sums(seeds: jax.Array, rho: jax.Array, n: int,
     external = uniforms is not None
     kernel = _make_kernel(n, m, m_pad, k, leftover, rows, eps1, eps2,
                           tuple(mu), tuple(sigma), normalise, external,
-                          compute_int)
+                          compute_int, gauss)
     # ρ rides a per-replication SMEM scalar like the seed, so one compiled
     # kernel serves a whole shape bucket's ρ-sweep (the bucketed grid
     # flattens (point × rep) pairs; scalar ρ callers broadcast).
@@ -359,6 +411,7 @@ def ni_sign_pallas(seeds: jax.Array, rho, n: int, eps1: float, eps2: float,
                    mu=(0.0, 0.0), sigma=(1.0, 1.0), alpha: float = 0.05,
                    normalise: bool = True,
                    interpret: bool | None = None,
+                   gauss: str = "boxmuller",
                    uniforms: jax.Array | None = None) -> CorrResult:
     """Fused generate+estimate for a whole replication batch.
 
@@ -374,6 +427,10 @@ def ni_sign_pallas(seeds: jax.Array, rho, n: int, eps1: float, eps2: float,
         raise ValueError(
             f"fused kernel needs m <= {LANES} and k >= 2, got m={m}, k={k}; "
             f"use the XLA path (see use_ni_sign_pallas)")
+    if gauss not in ("boxmuller", "ndtri"):
+        # a typo must not silently select the wrong sampler
+        raise ValueError(f"gauss must be 'boxmuller' or 'ndtri', "
+                         f"got {gauss!r}")
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     if interpret and uniforms is None:
@@ -383,8 +440,18 @@ def ni_sign_pallas(seeds: jax.Array, rho, n: int, eps1: float, eps2: float,
             f"(B, {n_uniform_rows(n, eps1, eps2)}, {LANES}) off-TPU")
     st, st2, _ = _ni_sign_pallas_sums(
         jnp.asarray(seeds, jnp.int32), jnp.float32(rho), n, eps1, eps2,
-        tuple(mu), tuple(sigma), normalise, interpret, uniforms=uniforms)
-    return _ni_result(st, st2, k, alpha)
+        tuple(mu), tuple(sigma), normalise, interpret, False, gauss,
+        uniforms=uniforms)
+    # jitted tail: eagerly dispatching the ~50 ops inside ndtri after an
+    # interpret-mode pallas_call contends with the interpreter's
+    # io_callback machinery and can stall for minutes (observed in-suite)
+    return CorrResult(*_ni_result_jit(st, st2, k, float(alpha)))
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _ni_result_jit(st, st2, k: int, alpha: float):
+    r = _ni_result(st, st2, k, alpha)
+    return r.rho_hat, r.ci_low, r.ci_high
 
 
 def _ni_result(st: jax.Array, st2: jax.Array, k: int,
@@ -401,17 +468,18 @@ def _ni_result(st: jax.Array, st2: jax.Array, k: int,
     return CorrResult(rho_hat, lo, hi)
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+@partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10, 11))
 def _sim_detail_jit(seeds, rhos, n: int, eps1: float, eps2: float,
                     mu, sigma, alpha: float, ci_mode: str,
-                    normalise: bool, interpret: bool, uniforms=None):
+                    normalise: bool, interpret: bool,
+                    gauss: str = "boxmuller", uniforms=None):
     from dpcorr.models.estimators.int_sign import interval_from_rho
     from dpcorr.sim import _metrics_row
 
     _, k = batch_geometry(n, eps1, eps2)
     st, st2, eta_int = _ni_sign_pallas_sums(
         seeds, rhos, n, eps1, eps2, mu, sigma, normalise, interpret,
-        True, uniforms=uniforms)
+        True, gauss, uniforms=uniforms)
     ni = _ni_result(st, st2, k, alpha)
     rho_hat_int = jnp.sin(jnp.pi * eta_int / 2.0)
     eps_s, eps_r = max(eps1, eps2), min(eps1, eps2)
@@ -427,6 +495,7 @@ def sim_detail_pallas(seeds: jax.Array, rhos, n: int, eps1: float,
                       alpha: float = 0.05, ci_mode: str = "auto",
                       normalise: bool = True,
                       interpret: bool | None = None,
+                      gauss: str = "boxmuller",
                       uniforms: jax.Array | None = None) -> tuple:
     """Whole-replication fused simulation: one kernel pass generates the
     data on-chip and computes BOTH the NI sign-batch sums and the INT
@@ -446,6 +515,9 @@ def sim_detail_pallas(seeds: jax.Array, rhos, n: int, eps1: float,
         raise ValueError(
             f"fused kernel needs m <= {LANES} and k >= 2, got m={m}, k={k}; "
             f"use the XLA path (see use_ni_sign_pallas)")
+    if gauss not in ("boxmuller", "ndtri"):
+        raise ValueError(f"gauss must be 'boxmuller' or 'ndtri', "
+                         f"got {gauss!r}")
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     if interpret and uniforms is None:
@@ -456,4 +528,4 @@ def sim_detail_pallas(seeds: jax.Array, rhos, n: int, eps1: float,
     return _sim_detail_jit(jnp.asarray(seeds, jnp.int32),
                            jnp.asarray(rhos, jnp.float32), n, eps1, eps2,
                            tuple(mu), tuple(sigma), float(alpha), ci_mode,
-                           normalise, interpret, uniforms=uniforms)
+                           normalise, interpret, gauss, uniforms=uniforms)

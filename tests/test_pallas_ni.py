@@ -215,3 +215,24 @@ def test_fused_int_laplace_regime():
     assert 0.9 < d["int_ci_len"].mean() / xla["ci_length"] < 1.1
     # coverage SE ≈ 0.018 per stream at b=384 → |diff| bound ≈ 3·√2·SE
     assert abs(d["int_cover"].mean() - xla["coverage"]) < 0.08
+
+
+def test_ndtri_gauss_variant_statistics():
+    """The inverse-CDF normal sampler (gauss="ndtri") is exact like
+    Box-Muller and consumes the same uniform planes — estimates must match
+    the default variant's statistics within MC error."""
+    b = 512
+    u = _uniforms(rng.master_key(41), N, b)
+    bm = ni_sign_pallas(np.arange(b, dtype=np.int32), RHO, N, 1.0, 1.0,
+                        uniforms=u)
+    nd = ni_sign_pallas(np.arange(b, dtype=np.int32), RHO, N, 1.0, 1.0,
+                        gauss="ndtri", uniforms=u)
+    r_bm, r_nd = np.asarray(bm.rho_hat), np.asarray(nd.rho_hat)
+    assert np.isfinite(r_nd).all()
+    assert abs(r_nd.mean() - r_bm.mean()) < 0.03
+    assert 0.5 < r_nd.var() / r_bm.var() < 2.0
+    cov_bm = np.mean((RHO >= np.asarray(bm.ci_low))
+                     & (RHO <= np.asarray(bm.ci_high)))
+    cov_nd = np.mean((RHO >= np.asarray(nd.ci_low))
+                     & (RHO <= np.asarray(nd.ci_high)))
+    assert abs(cov_nd - cov_bm) < 0.06

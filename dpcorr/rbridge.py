@@ -23,7 +23,8 @@ def run_design_rows(rows: Sequence[Mapping], b: int = 250,
                     dgp: str = "gaussian", use_subg: bool = False,
                     alpha: float = 0.05, normalise: bool = True,
                     ci_mode: str = "auto",
-                    backend: str = "local") -> pd.DataFrame:
+                    backend: str = "local",
+                    fused: str = "off") -> pd.DataFrame:
     """Run design-grid rows and return the replicate-level detail frame.
 
     ``rows``: list of ``{"n": .., "rho": .., "eps1": .., "eps2": ..}`` —
@@ -36,6 +37,15 @@ def run_design_rows(rows: Sequence[Mapping], b: int = 250,
     """
     master = rng.master_key(int(seed))
 
+    # same fail-fast contract as grid.run_grid: a typo'd or silently
+    # inapplicable fused value must not run the wrong path
+    if fused not in ("off", "auto", "all"):
+        raise ValueError(
+            f"fused must be 'off', 'auto' or 'all', got {fused!r}")
+    if fused != "off" and backend != "bucketed":
+        raise ValueError(
+            f"fused={fused!r} requires backend='bucketed', got {backend!r}")
+
     if backend == "bucketed":
         # the grid speedup (one kernel per (n, ε) shape bucket, ρ traced,
         # dispatch-ahead) — reachable from R, bit-identical per point to
@@ -45,7 +55,7 @@ def run_design_rows(rows: Sequence[Mapping], b: int = 250,
         gcfg = grid_mod.GridConfig(
             b=int(b), alpha=float(alpha), dgp=dgp, use_subg=bool(use_subg),
             normalise=bool(normalise), ci_mode=ci_mode, seed=int(seed),
-            backend="bucketed")
+            backend="bucketed", fused=fused)
         design = pd.DataFrame(
             [{"i": i, "n": int(r["n"]), "rho": float(r["rho"]),
               "eps1": float(r["eps1"]), "eps2": float(r["eps2"])}

@@ -67,3 +67,17 @@ def test_run_design_rows_deterministic():
     # different master seed → different draws
     c = rbridge.run_design_rows(rows, b=8, seed=7)
     assert not np.allclose(a.ni_hat, c.ni_hat)
+
+
+def test_fused_validation_fail_fast():
+    """The bridge mirrors run_grid's fused fail-fast contract (a typo'd
+    or non-bucketed fused request must raise, not silently run XLA)."""
+    import pytest
+
+    from dpcorr.rbridge import run_design_rows
+
+    rows = [{"n": 400, "rho": 0.5, "eps1": 1.0, "eps2": 1.0}]
+    with pytest.raises(ValueError, match="fused"):
+        run_design_rows(rows, b=4, backend="local", fused="auto")
+    with pytest.raises(ValueError, match="fused"):
+        run_design_rows(rows, b=4, backend="bucketed", fused="Auto")

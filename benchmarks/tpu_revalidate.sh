@@ -1,0 +1,53 @@
+#!/bin/bash
+# Tunnel-recovery watcher + queued TPU validations (2026-07-30 session).
+#
+# The remote TPU tunnel intermittently wedges under sustained load
+# (docs/STATUS_r02.md "Ops note"). This script polls a bounded health
+# probe and, on recovery, runs the validations queued behind the wedge:
+#
+#   1. `python bench.py` at the new shipped defaults (block_reps=2^19) —
+#      revalidates the 235x headline on the current revision, including
+#      the refactored kernels (two-word seeds, shared scaffolding).
+#   2. Pallas gauss A/B: the tpu-pallas worker with Box-Muller vs the
+#      inline-ndtri sampler, same budget — settles whether the VPU-bound
+#      generate step is cheaper as an inverse-CDF polynomial.
+#   3. A --b 8 fused CLI grid smoke (end-to-end grid wiring on-chip).
+#
+# Results land in /tmp/tpu_revalidate/; summarized on stdout.
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=/tmp/tpu_revalidate
+mkdir -p "$OUT"
+
+probe() {
+  timeout 150 python -c \
+    "import jax, jax.numpy as jnp; print(float((jnp.ones((128,128))@jnp.ones((128,128))).sum()))" \
+    >/dev/null 2>&1
+}
+
+for i in $(seq 1 120); do
+  if probe; then
+    echo "tunnel healthy at attempt $i ($(date -u +%H:%M:%SZ))"
+
+    echo "== 1. bench.py at shipped defaults =="
+    timeout 1200 python bench.py 2>/dev/null | tail -1 | tee "$OUT/bench_default.json"
+
+    echo "== 2. pallas gauss A/B (worker-only, budget 20s each) =="
+    timeout 900 python bench.py --worker tpu-pallas --budget 20 2>/dev/null \
+      | tail -1 | tee "$OUT/pallas_boxmuller.json"
+    DPCORR_BENCH_PALLAS_GAUSS=ndtri \
+      timeout 900 python bench.py --worker tpu-pallas --budget 20 2>/dev/null \
+      | tail -1 | tee "$OUT/pallas_ndtri.json"
+
+    echo "== 3. fused CLI grid smoke (--b 8) =="
+    timeout 900 python -m dpcorr grid --backend bucketed --fused auto --b 8 \
+      2>/dev/null | tail -2 | tee "$OUT/grid_fused_smoke.txt"
+
+    echo "revalidation complete ($(date -u +%H:%M:%SZ))"
+    exit 0
+  fi
+  sleep 110
+done
+echo "tunnel never recovered within the polling window"
+exit 1

@@ -199,3 +199,16 @@ def test_fused_auto_on_cpu_matches_off(tmp_path):
                               out_dir=str(tmp_path)))
     assert res.timings["points_run"].sum() == 0
     pd.testing.assert_frame_equal(off.detail_all, res.detail_all)
+
+
+def test_fused_dispatch_failure_falls_back_to_xla(monkeypatch):
+    """If the fused kernel fails at dispatch (here: Pallas lowering is
+    unavailable on CPU), the bucket must fall back to the XLA kernel and
+    produce results bit-identical to fused="off"."""
+    from dpcorr import grid as g
+
+    monkeypatch.setattr(g, "_fused_bucket_ok", lambda gcfg, cfg: "sign")
+    auto = run_grid(GridConfig(**SMALL, backend="bucketed", fused="auto"))
+    off = run_grid(GridConfig(**SMALL, backend="bucketed"))
+    pd.testing.assert_frame_equal(auto.detail_all, off.detail_all)
+    assert not auto.timings["fused"].astype(bool).any()

@@ -15,10 +15,21 @@
 #
 # Results land in /tmp/tpu_revalidate/; summarized on stdout.
 
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 OUT=/tmp/tpu_revalidate
 mkdir -p "$OUT"
+FAILED=0
+
+step() {  # step <name> <cmd...>: run, record status, keep going
+  local name=$1; shift
+  if "$@"; then
+    echo "-- $name: OK"
+  else
+    echo "-- $name: FAILED (rc=$?)"
+    FAILED=$((FAILED + 1))
+  fi
+}
 
 probe() {
   timeout 150 python -c \
@@ -31,21 +42,30 @@ for i in $(seq 1 120); do
     echo "tunnel healthy at attempt $i ($(date -u +%H:%M:%SZ))"
 
     echo "== 1. bench.py at shipped defaults =="
-    timeout 1200 python bench.py 2>/dev/null | tail -1 | tee "$OUT/bench_default.json"
+    step bench_default bash -c \
+      'timeout 1200 python bench.py 2>"'$OUT'/bench_default.err" \
+       | tail -1 | tee "'$OUT'/bench_default.json" | grep -q "reps_per_sec"'
 
     echo "== 2. pallas gauss A/B (worker-only, budget 20s each) =="
-    timeout 900 python bench.py --worker tpu-pallas --budget 20 2>/dev/null \
-      | tail -1 | tee "$OUT/pallas_boxmuller.json"
-    DPCORR_BENCH_PALLAS_GAUSS=ndtri \
-      timeout 900 python bench.py --worker tpu-pallas --budget 20 2>/dev/null \
-      | tail -1 | tee "$OUT/pallas_ndtri.json"
+    step pallas_boxmuller bash -c \
+      'timeout 900 python bench.py --worker tpu-pallas --budget 20 \
+       2>"'$OUT'/pallas_bm.err" | tail -1 \
+       | tee "'$OUT'/pallas_boxmuller.json" | grep -q "reps_per_sec"'
+    step pallas_ndtri bash -c \
+      'DPCORR_BENCH_PALLAS_GAUSS=ndtri \
+       timeout 900 python bench.py --worker tpu-pallas --budget 20 \
+       2>"'$OUT'/pallas_nd.err" | tail -1 \
+       | tee "'$OUT'/pallas_ndtri.json" | grep -q "reps_per_sec"'
 
     echo "== 3. fused CLI grid smoke (--b 8) =="
-    timeout 900 python -m dpcorr grid --backend bucketed --fused auto --b 8 \
-      2>/dev/null | tail -2 | tee "$OUT/grid_fused_smoke.txt"
+    step grid_fused_smoke bash -c \
+      'timeout 900 python -m dpcorr grid --backend bucketed --fused auto \
+       --b 8 2>"'$OUT'/grid.err" | tail -2 \
+       | tee "'$OUT'/grid_fused_smoke.txt" | grep -q "INT"'
 
-    echo "revalidation complete ($(date -u +%H:%M:%SZ))"
-    exit 0
+    cat "$OUT"/*.json 2>/dev/null
+    echo "revalidation finished ($(date -u +%H:%M:%SZ)): $((4 - FAILED))/4 steps OK"
+    exit $FAILED
   fi
   sleep 110
 done

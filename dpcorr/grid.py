@@ -137,6 +137,18 @@ def _load_cached(path: Path | None, resume: bool, stamp: str):
     return None
 
 
+def validate_fused(fused: str, backend: str) -> None:
+    """Shared fail-fast for the fused knob (run_grid and the R bridge):
+    a typo'd value or a silently-never-fusing backend must raise before
+    any work is dispatched."""
+    if fused not in ("off", "auto", "all"):
+        raise ValueError(
+            f"fused must be 'off', 'auto' or 'all', got {fused!r}")
+    if fused != "off" and backend != "bucketed":
+        raise ValueError(
+            f"fused={fused!r} requires backend='bucketed', got {backend!r}")
+
+
 def _fused_bucket_ok(gcfg: GridConfig, cfg: SimConfig) -> str | None:
     """Which fused Pallas kernel (if any) covers this (n, ε) bucket:
     ``"sign"`` (Gaussian sign-estimator pair, ops/pallas_ni.py), ``"subg"``
@@ -147,11 +159,9 @@ def _fused_bucket_ok(gcfg: GridConfig, cfg: SimConfig) -> str | None:
     real TPU, det mixquant (the closed-form quantile — the kernel emits
     scalars, the per-CI MC variant draws from the key-tree the kernel
     doesn't carry), and the kernel's (m ≤ 128, k ≥ 2) batch geometry."""
+    validate_fused(gcfg.fused, "bucketed")  # pure value check here
     if gcfg.fused == "off" or gcfg.backend != "bucketed":
         return None
-    if gcfg.fused not in ("auto", "all"):
-        raise ValueError(
-            f"fused must be 'off', 'auto' or 'all', got {gcfg.fused!r}")
     if cfg.stream_n_chunk or cfg.mixquant_mode != "det":
         return None
     if cfg.use_subg:
@@ -248,7 +258,6 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                     rhos = jnp.repeat(
                         jnp.asarray([r.rho for r in to_run], jnp.float32),
                         gcfg.b)
-                    args = dict(cfg.dgp_args)
                     if fused == "subg":
                         from dpcorr.ops import pallas_subg
 
@@ -259,6 +268,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                     else:
                         from dpcorr.ops import pallas_ni
 
+                        args = dict(cfg.dgp_args)
                         raw = pallas_ni.sim_detail_pallas(
                             seeds, rhos, cfg.n, cfg.eps1, cfg.eps2,
                             mu=args.get("mu", (0.0, 0.0)),
@@ -366,14 +376,7 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
     Per-task keys fold the design index into the master key — the moral
     equivalent of the reference's ``seed = 1e6 + i`` (vert-cor.R:531).
     """
-    if gcfg.fused not in ("off", "auto", "all"):
-        raise ValueError(
-            f"fused must be 'off', 'auto' or 'all', got {gcfg.fused!r}")
-    if gcfg.fused != "off" and gcfg.backend != "bucketed":
-        # fail fast: every other backend would silently never fuse
-        raise ValueError(
-            f"fused={gcfg.fused!r} requires backend='bucketed', "
-            f"got {gcfg.backend!r}")
+    validate_fused(gcfg.fused, gcfg.backend)
     design = gcfg.design_points()
     master = rng.master_key(gcfg.seed)
     out_dir = Path(gcfg.out_dir) if gcfg.out_dir else None

@@ -37,21 +37,16 @@ def run_design_rows(rows: Sequence[Mapping], b: int = 250,
     """
     master = rng.master_key(int(seed))
 
+    from dpcorr import grid as grid_mod
+
     # same fail-fast contract as grid.run_grid: a typo'd or silently
     # inapplicable fused value must not run the wrong path
-    if fused not in ("off", "auto", "all"):
-        raise ValueError(
-            f"fused must be 'off', 'auto' or 'all', got {fused!r}")
-    if fused != "off" and backend != "bucketed":
-        raise ValueError(
-            f"fused={fused!r} requires backend='bucketed', got {backend!r}")
+    grid_mod.validate_fused(fused, backend)
 
     if backend == "bucketed":
         # the grid speedup (one kernel per (n, ε) shape bucket, ρ traced,
         # dispatch-ahead) — reachable from R, bit-identical per point to
         # the local path (both fold design_key(master, i))
-        from dpcorr import grid as grid_mod
-
         gcfg = grid_mod.GridConfig(
             b=int(b), alpha=float(alpha), dgp=dgp, use_subg=bool(use_subg),
             normalise=bool(normalise), ci_mode=ci_mode, seed=int(seed),

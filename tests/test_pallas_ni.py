@@ -236,3 +236,24 @@ def test_ndtri_gauss_variant_statistics():
     cov_nd = np.mean((RHO >= np.asarray(nd.ci_low))
                      & (RHO <= np.asarray(nd.ci_high)))
     assert abs(cov_nd - cov_bm) < 0.06
+
+
+def test_ndtri_inline_properties():
+    """The in-kernel inverse-normal-CDF (scalar-literal Acklam polynomial)
+    must agree with jax.scipy.special.ndtri over the kernel's uniform
+    range, be antisymmetric, and be monotone."""
+    from jax.scipy.special import ndtri as ndtri_ref
+
+    from dpcorr.ops.pallas_ni import _ndtri_inline
+
+    u = np.linspace(2.0**-24, 1.0 - 2.0**-24, 200_001).astype(np.float32)
+    mine = np.asarray(_ndtri_inline(jnp.asarray(u)))
+    ref = np.asarray(ndtri_ref(jnp.asarray(u)))
+    assert np.isfinite(mine).all()
+    # f32 cancellation near the central/tail seam bounds the error ~3e-4
+    assert np.abs(mine - ref).max() < 5e-4
+    sym = np.asarray(_ndtri_inline(jnp.asarray(1.0 - u)))
+    assert np.abs(mine + sym).max() < 5e-4
+    # monotone up to the f32 discontinuity at the central/tail seam
+    # (measured −2.7e-4 at u≈0.9757, same order as the accuracy bound)
+    assert (np.diff(mine) >= -5e-4).all()

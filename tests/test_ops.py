@@ -188,3 +188,21 @@ class TestPrivCenter:
         cent = priv_center(kz, x, 1.0, 2.5)
         np.testing.assert_array_equal(np.sign(np.asarray(full)),
                                       np.sign(np.asarray(cent)))
+
+
+def test_pallas_seeds_contract():
+    """Key-tree-derived on-chip seed words: (n, 2) int32, deterministic
+    per key, distinct across design points, and collision-free in the
+    2-word space at campaign scale (the 1-word birthday problem was a
+    real defect — rng.pallas_seeds docstring)."""
+    import numpy as np
+
+    k0 = rng.design_key(rng.master_key(), 0)
+    k1 = rng.design_key(rng.master_key(), 1)
+    s0 = np.asarray(rng.pallas_seeds(k0, 4096))
+    assert s0.shape == (4096, 2) and s0.dtype == np.int32
+    np.testing.assert_array_equal(s0, np.asarray(rng.pallas_seeds(k0, 4096)))
+    assert not np.array_equal(s0, np.asarray(rng.pallas_seeds(k1, 4096)))
+    # all 2-word seeds unique within a draw (2^64 space)
+    pairs = {tuple(row) for row in s0.tolist()}
+    assert len(pairs) == 4096

@@ -59,6 +59,9 @@ MAX_BLOCKS = 32
 # baseline) with stable coverage; 2^20 exceeded the worker timeout through
 # the tunnel. Overridable for tuning runs without editing:
 # DPCORR_BENCH_BLOCK_REPS / DPCORR_BENCH_CHUNK.
+# The CPU fallback shape is measured-optimal too (2026-07-30 sweep on this
+# image: 2048/256 → 2282 reps/s; 4096/512 → 1955; 8192/1024 → 1527 —
+# bigger chunks thrash CPU caches, the opposite of the TPU trend).
 WORKER_SHAPE = {"tpu": (512 * 1024, 16384), "cpu": (2048, 256)}
 
 

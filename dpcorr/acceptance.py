@@ -122,8 +122,12 @@ POINTS: tuple[AccPoint, ...] = (
              {"n": 4000, "rho": 0.5, "eps1": 1.0, "eps2": 1.0,
               "dgp": "bounded_factor", "use_subg": True,
               "subg_variant": "real"},
+             both_mixquant=True,
              ),  # measured exactly calibrated at B=1e6: NI 0.95046,
-                 # INT 0.95016 (r02 campaign) — no tolerance needed
+                 # INT 0.95016 (r02 campaign) — no tolerance needed.
+                 # The MC twin here runs at the real-data script's
+                 # nsim=2000 (real-data-sims.R:161-164), not the grid
+                 # scripts' 1000 — ci_int_subg's variant-aware default.
     AccPoint("subg_small_n", "λ_r log-n branch: log 300 < 6 "
              "(ver-cor-subG.R:5)", {"n": 300, "rho": 0.4, "eps1": 2.0,
                                     "eps2": 0.5, "dgp": "bounded_factor",
@@ -272,8 +276,10 @@ def build_table(rows: list[dict], alpha: float = 0.05,
             "det (exact quantile) sits within MC SE of nominal where the "
             "construction is calibrated, while the faithful mc mode is "
             "consistently lower — the gap is the downward bias of the "
-            "reference's nsim=1000 order-statistic quantile "
-            "(vert-cor.R:44-56), i.e. the reference's own MC noise, not a "
+            "reference's finite-nsim order-statistic quantile (nsim=1000 "
+            "in the grid scripts, vert-cor.R:44-56; 2000 in the real-data "
+            "script, real-data-sims.R:161-164), i.e. the reference's own "
+            "MC noise, not a "
             "det-mode error; set mixquant_mode='mc' for strict "
             "construction fidelity")
     return table

@@ -38,7 +38,7 @@ _CSTAR_MAX = 1e6  # sd(Uc)→0 sends c*→∞; a huge finite c* yields width →
 
 def grid_interval(key: jax.Array, rho_hat: jax.Array, sd_uc: jax.Array,
                   n: int, eps_r: float, central_scale, alpha: float,
-                  mixquant_mode: str) -> CorrResult:
+                  mixquant_mode: str, mixquant_nsim: int = 1000) -> CorrResult:
     """Grid-variant (v1) CI given ρ̂ and sd(Uc) (ver-cor-subG.R:99-104),
     shared by the materialized and streaming estimators: se includes the
     central-noise variance term; ρ-space clamp."""
@@ -46,7 +46,8 @@ def grid_interval(key: jax.Array, rho_hat: jax.Array, sd_uc: jax.Array,
     p = 1.0 - alpha / 2.0
     se_norm = jnp.sqrt(sd_uc**2 + 2.0 * central_scale**2)
     cstar = jnp.minimum(2.0 / (jnp.sqrt(float(n)) * sd_safe * eps_r), _CSTAR_MAX)
-    q = (mixquant_mc(stream(key, "int_subg/mixquant"), cstar, p) if mixquant_mode == "mc"
+    q = (mixquant_mc(stream(key, "int_subg/mixquant"), cstar, p,
+                     nsim=mixquant_nsim) if mixquant_mode == "mc"
          else mixquant(cstar, p))
     width = q * se_norm / jnp.sqrt(float(n))
     lo = jnp.maximum(rho_hat - width, -1.0)
@@ -61,10 +62,19 @@ def ci_int_subg(key: jax.Array, x: jax.Array, y: jax.Array,
                 variant: str = "grid",
                 lambda_sender=None, lambda_other=None, lambda_receiver=None,
                 delta_clip: float | None = None,
-                mixquant_mode: str = "det") -> CorrResult:
-    """One-round interactive clipped DP correlation estimate + mixture CI."""
+                mixquant_mode: str = "det",
+                mixquant_nsim: int | None = None) -> CorrResult:
+    """One-round interactive clipped DP correlation estimate + mixture CI.
+
+    ``mixquant_nsim`` sets the MC draw count when ``mixquant_mode="mc"``;
+    the default follows the reference per variant — 1000 for the grid
+    script's mixquant (ver-cor-subG.R:10) and **2000** for the real-data
+    script's (real-data-sims.R:161-164).
+    """
     if variant not in ("grid", "real"):
         raise ValueError(f"variant must be 'grid' or 'real', got {variant!r}")
+    if mixquant_nsim is None:
+        mixquant_nsim = 2000 if variant == "real" else 1000
     n = x.shape[0]
 
     # Roles: larger ε sends (ver-cor-subG.R:76-81) — static.
@@ -114,7 +124,8 @@ def ci_int_subg(key: jax.Array, x: jax.Array, y: jax.Array,
         aux["delta_clip"] = delta_clip
     if variant == "grid":
         return grid_interval(key, rho_hat, sd_uc, n, eps_r, central_scale,
-                             alpha, mixquant_mode)._replace(aux=aux)
+                             alpha, mixquant_mode,
+                             mixquant_nsim=mixquant_nsim)._replace(aux=aux)
     else:
         # sampling-only se + explicit sd==0 degenerate branch
         # (real-data-sims.R:237-242)
@@ -122,7 +133,8 @@ def ci_int_subg(key: jax.Array, x: jax.Array, y: jax.Array,
         p = 1.0 - alpha / 2.0
         cstar = jnp.minimum(2.0 * lam_r / (jnp.sqrt(float(n)) * sd_safe * eps_r),
                             _CSTAR_MAX)
-        q = (mixquant_mc(stream(key, "int_subg/mixquant"), cstar, p) if mixquant_mode == "mc"
+        q = (mixquant_mc(stream(key, "int_subg/mixquant"), cstar, p,
+                         nsim=mixquant_nsim) if mixquant_mode == "mc"
              else mixquant(cstar, p))
         width_mix = q * sd_uc / jnp.sqrt(float(n))
         width_deg = ndtri(p) * jnp.sqrt(2.0) * central_scale

@@ -212,6 +212,32 @@ class TestIntSubg:
         with pytest.raises(ValueError):
             ci_int_subg(KEY, x, y, 1.0, 1.0, variant="v3")
 
+    def test_mc_nsim_defaults_per_variant(self, monkeypatch):
+        """mc-mode draw counts follow the reference per variant: 1000 for
+        the grid script's mixquant (ver-cor-subG.R:10), 2000 for the
+        real-data script's (real-data-sims.R:161-164); explicit
+        ``mixquant_nsim`` overrides both."""
+        from dpcorr.models.estimators import int_subg as mod
+
+        seen = []
+        real_mc = mod.mixquant_mc
+
+        def spy(key, c, p, nsim=1000):
+            seen.append(nsim)
+            return real_mc(key, c, p, nsim=nsim)
+
+        monkeypatch.setattr(mod, "mixquant_mc", spy)
+        x, y = _data(n=1000)
+        ci_int_subg(KEY, x, y, 2.0, 1.0, variant="grid",
+                    mixquant_mode="mc")
+        ci_int_subg(KEY, x, y, 2.0, 1.0, variant="real",
+                    lambda_sender=2.0, lambda_other=1.5,
+                    mixquant_mode="mc")
+        ci_int_subg(KEY, x, y, 2.0, 1.0, variant="real",
+                    lambda_sender=2.0, lambda_other=1.5,
+                    mixquant_mode="mc", mixquant_nsim=500)
+        assert seen == [1000, 2000, 500]
+
     def test_aux_lambdas_and_delta(self):
         """λ_sender/λ_other/λ_receiver/δ extras (real-data-sims.R:244-252)."""
         x, y = _data(n=1000)

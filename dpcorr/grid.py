@@ -115,8 +115,17 @@ def _design_path(out_dir: Path, i: int) -> Path:
 def _stamp(cfg: SimConfig) -> str:
     """Cache-validity stamp: the exact SimConfig plus the process PRNG
     implementation — rbg- and threefry-generated results are different
-    numbers and a resume must never mix them."""
-    return f"{cfg!r}|prng={rng.impl_tag()}"
+    numbers and a resume must never mix them.
+
+    mc-mode real-variant runs additionally stamp the mixquant draw count:
+    ``ci_int_subg``'s default moved 1000 → 2000 for ``variant="real"``
+    (the reference's real-data-sims.R:161-164 count), and a resume must
+    not mix pre-move cached points with post-move fresh ones."""
+    stamp = f"{cfg!r}|prng={rng.impl_tag()}"
+    if cfg.mixquant_mode == "mc" and getattr(cfg, "subg_variant",
+                                             "grid") == "real":
+        stamp += "|mixquant_nsim=2000"
+    return stamp
 
 
 def _run_point(gcfg: GridConfig, cfg: SimConfig, key, mesh):
@@ -179,6 +188,12 @@ def _fused_bucket_ok(gcfg: GridConfig, cfg: SimConfig) -> str | None:
         return None
     import jax
 
+    # "Pallas-capable TPU" in practice means two platform strings: "tpu"
+    # (a directly-attached chip) and "axon" (the same chip behind the
+    # remote-tunnel transport this image uses — jax.devices() reports the
+    # tunnel's platform name, but lowering/Mosaic behave as on "tpu"; the
+    # fused-kernel hardware results in GridConfig.fused were measured
+    # through it). Anything else (cpu, gpu) has no Mosaic backend.
     if jax.devices()[0].platform not in ("tpu", "axon"):
         return None
     from dpcorr.ops.pallas_ni import use_ni_sign_pallas
@@ -210,6 +225,22 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
     import jax.numpy as jnp
 
     details, timings, failures = {}, [], []
+
+    def xla_dispatch(cfg, to_run):
+        """The XLA bucket dispatch — single source for phase 1 and the
+        fetch-time fused fallback, so both stay bit-identical to
+        fused="off" by construction."""
+        keys = jnp.concatenate([
+            rng.rep_keys(rng.design_key(master, int(r.i)), gcfg.b)
+            for r in to_run])
+        rhos = jnp.repeat(jnp.asarray([r.rho for r in to_run], jnp.float32),
+                          gcfg.b)
+        cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
+        if gcfg.backend == "bucketed-sharded":
+            from dpcorr.parallel import run_detail_flat_sharded
+
+            return run_detail_flat_sharded(cfg_norho, keys, rhos, mesh=mesh)
+        return sim_mod._run_detail_flat(cfg_norho, keys, rhos)
 
     # Phase 1 — dispatch every bucket without fetching: jit dispatch is
     # asynchronous, so bucket j executes on-device while bucket j+1 is still
@@ -287,19 +318,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                     stamps = mk_stamps("")
                     to_run = scan_cache(to_run, stamps)
             if to_run and raw is None:
-                keys = jnp.concatenate([
-                    rng.rep_keys(rng.design_key(master, int(r.i)), gcfg.b)
-                    for r in to_run])
-                rhos = jnp.repeat(jnp.asarray([r.rho for r in to_run],
-                                              jnp.float32), gcfg.b)
-                cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
-                if gcfg.backend == "bucketed-sharded":
-                    from dpcorr.parallel import run_detail_flat_sharded
-
-                    raw = run_detail_flat_sharded(cfg_norho, keys, rhos,
-                                                  mesh=mesh)
-                else:
-                    raw = sim_mod._run_detail_flat(cfg_norho, keys, rhos)
+                raw = xla_dispatch(cfg, to_run)
         except Exception as e:
             log.error("bucket (n=%d eps=(%.2f,%.2f), %d points) failed "
                       "at dispatch: %s",
@@ -307,7 +326,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             failures.extend((int(r.i), e) for r in rows
                             if int(r.i) not in details)
             continue
-        pending.append((rows, to_run, raw, stamps, paths, fused,
+        pending.append((rows, to_run, raw, stamps, paths, fused, cfg,
                         time.perf_counter() - t0))
 
     # Phase 2 — fetch in dispatch order; device-side failures surface here.
@@ -317,11 +336,40 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
     # ``grid_reps_per_sec``, total reps over the whole two-phase wall clock.
     t_fetch0 = time.perf_counter()
     total_ran = 0
-    for rows, to_run, raw, stamps, paths, fused, dispatch_s in pending:
+    for rows, to_run, raw, stamps, paths, fused, cfg, dispatch_s in pending:
         t0 = time.perf_counter()
         try:
             if to_run:
-                raw = [np.asarray(a) for a in raw]  # completion barrier
+                try:
+                    raw = [np.asarray(a) for a in raw]  # completion barrier
+                except Exception as e:
+                    if not fused:
+                        raise
+                    # fused stays best-effort at the fetch barrier too: a
+                    # kernel error that only surfaces at np.asarray (device
+                    # execution, not lowering) degrades this bucket to the
+                    # XLA kernel, mirroring the dispatch-time fallback —
+                    # including the re-scan under XLA stamps
+                    log.warning(
+                        "fused bucket (n=%d eps=(%.2f,%.2f)) failed at "
+                        "fetch: %s -- retrying via XLA", cfg.n, cfg.eps1,
+                        cfg.eps2, e)
+                    fused = None
+                    stamps = {int(r.i): _stamp(dataclasses.replace(
+                        cfg, rho=float(r.rho))) for r in to_run}
+                    still = []
+                    for r in to_run:
+                        i = int(r.i)
+                        cached = _load_cached(paths[i], gcfg.resume,
+                                              stamps[i])
+                        if cached is not None:
+                            details[i] = cached
+                        else:
+                            still.append(r)
+                    to_run = still
+                    raw = ([np.asarray(a)
+                            for a in xla_dispatch(cfg, to_run)]
+                           if to_run else None)
                 for j, r in enumerate(to_run):
                     i = int(r.i)
                     sl = slice(j * gcfg.b, (j + 1) * gcfg.b)
@@ -348,7 +396,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             "dispatch_s": dispatch_s, "fetch_s": fetch_s,
         })
     wall = (time.perf_counter() - t_fetch0) + sum(
-        t[6] for t in pending)  # fetch phase + all dispatch times
+        t[7] for t in pending)  # fetch phase + all dispatch times
     grid_rps = np.nan if not total_ran else total_ran * gcfg.b / wall
     for t in timings:
         t["grid_reps_per_sec"] = grid_rps

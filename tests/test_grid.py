@@ -212,3 +212,46 @@ def test_fused_dispatch_failure_falls_back_to_xla(monkeypatch):
     off = run_grid(GridConfig(**SMALL, backend="bucketed"))
     pd.testing.assert_frame_equal(auto.detail_all, off.detail_all)
     assert not auto.timings["fused"].astype(bool).any()
+
+
+def test_stamp_encodes_real_mc_mixquant_nsim():
+    """The real-variant mc-mode nsim default moved 1000 → 2000
+    (real-data-sims.R:161-164); pre-move caches must not resume into
+    post-move runs, so the stamp encodes the draw count for exactly the
+    configs the default touches."""
+    import dataclasses
+
+    from dpcorr import grid as g
+
+    cfg = GridConfig(**SMALL).sim_config(
+        {"n": 200, "rho": 0.0, "eps1": 1.0, "eps2": 1.0})
+    mc_real = dataclasses.replace(cfg, mixquant_mode="mc",
+                                  subg_variant="real", use_subg=True,
+                                  dgp="bounded_factor")
+    assert "mixquant_nsim=2000" in g._stamp(mc_real)
+    assert "mixquant_nsim" not in g._stamp(cfg)
+    assert "mixquant_nsim" not in g._stamp(
+        dataclasses.replace(mc_real, mixquant_mode="det"))
+    assert "mixquant_nsim" not in g._stamp(
+        dataclasses.replace(mc_real, subg_variant="grid"))
+
+
+def test_fused_fetch_failure_falls_back_to_xla(monkeypatch):
+    """A fused kernel whose error only surfaces at the phase-2 fetch
+    barrier (device execution, not lowering) must also degrade the bucket
+    to the XLA kernel, bit-identical to fused="off" (ADVICE r2)."""
+    from dpcorr import grid as g
+    from dpcorr.ops import pallas_ni
+
+    class _LazyBoom:
+        def __array__(self, *a, **k):
+            raise RuntimeError("simulated device-side kernel failure")
+
+    monkeypatch.setattr(g, "_fused_bucket_ok", lambda gcfg, cfg: "sign")
+    monkeypatch.setattr(  # dispatch succeeds; fetch (np.asarray) explodes
+        pallas_ni, "sim_detail_pallas",
+        lambda *a, **k: [_LazyBoom() for _ in range(12)])
+    auto = run_grid(GridConfig(**SMALL, backend="bucketed", fused="auto"))
+    off = run_grid(GridConfig(**SMALL, backend="bucketed"))
+    pd.testing.assert_frame_equal(auto.detail_all, off.detail_all)
+    assert not auto.timings["fused"].astype(bool).any()

@@ -35,7 +35,63 @@ import pandas as pd
 
 from dpcorr.grid import GridConfig, GridResult, run_grid
 
-__all__ = ["grid_slice", "run_grid_host", "run_grid_multihost"]
+__all__ = ["grid_slice", "run_grid_host", "run_grid_multihost",
+           "init_distributed", "run_grid_process"]
+
+
+def init_distributed(coordinator_address: str, num_processes: int,
+                     process_id: int, platform: str | None = None,
+                     local_device_count: int | None = None) -> None:
+    """Opt-in ``jax.distributed`` runtime init (SURVEY.md §2.3: multi-host
+    DCN fan-out).
+
+    On a real pod the launcher supplies the arguments (or JAX infers them
+    from the TPU environment and they can all be None); the local
+    multi-process CPU cluster test supplies localhost ones, with
+    ``platform="cpu"`` and a per-process ``local_device_count`` so each
+    worker contributes virtual CPU devices to the global cluster. Must run
+    before any JAX backend initializes — platform/device-count config
+    cannot change afterwards.
+    """
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if local_device_count:
+        jax.config.update("jax_num_cpu_devices", local_device_count)
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def run_grid_process(gcfg: GridConfig) -> GridResult | None:
+    """SPMD multi-host grid entry: every process of an initialized
+    ``jax.distributed`` job calls this with the SAME config (the standard
+    multi-controller pattern — one program, all workers).
+
+    Host identity comes from the runtime (``jax.process_index`` /
+    ``jax.process_count``), not from caller-passed ids; per-host compute is
+    pinned to this host's addressable devices (a local ``rep`` mesh for the
+    sharded backends, local default device otherwise); a global-device
+    barrier closes the fan-out; then process 0 assembles the merged result
+    from the shared cache and returns it (other processes return None).
+    """
+    import jax
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh
+
+    host, n_hosts = jax.process_index(), jax.process_count()
+    local = jax.local_devices()
+    mesh = Mesh(local, axis_names=("rep",))
+    with jax.default_device(local[0]):
+        run_grid_host(gcfg, host, n_hosts, mesh=mesh)
+    # the only cross-host synchronization the problem has (SURVEY.md §2.5):
+    # everyone's cache writes must land before rank 0 merges
+    multihost_utils.sync_global_devices("dpcorr/grid-fanout-complete")
+    if host != 0:
+        return None
+    with jax.default_device(local[0]):
+        return run_grid(dataclasses.replace(gcfg, resume=True), mesh=mesh)
 
 
 def grid_slice(design: pd.DataFrame, host_id: int,
@@ -52,13 +108,17 @@ def grid_slice(design: pd.DataFrame, host_id: int,
     return take.sort_values("i").reset_index(drop=True)
 
 
-def run_grid_host(gcfg: GridConfig, host_id: int, n_hosts: int) -> int:
+def run_grid_host(gcfg: GridConfig, host_id: int, n_hosts: int,
+                  mesh=None) -> int:
     """Run this host's slice into the shared npz cache; returns the number
     of design points this host owned. ``gcfg.out_dir`` must be set (it is
     the only channel between hosts). ``gcfg.backend`` is honored — each
     host runs its buckets through the bucketed kernel, or its rows through
     the local/sharded per-point path (replications over this host's own
-    device mesh)."""
+    device mesh). ``mesh`` (for the sharded backends) must span only
+    devices this host can address — under a ``jax.distributed`` runtime
+    that is ``jax.local_devices()``, which :func:`run_grid_process` wires
+    up."""
     if not gcfg.out_dir:
         raise ValueError("multi-host execution needs a shared out_dir")
     design = gcfg.design_points()
@@ -78,7 +138,7 @@ def run_grid_host(gcfg: GridConfig, host_id: int, n_hosts: int) -> int:
     master = rng.master_key(gcfg.seed)
     if gcfg.backend in ("bucketed", "bucketed-sharded"):
         _, _, failures = grid_mod._run_grid_bucketed(gcfg, mine, master,
-                                                     out_dir)
+                                                     out_dir, mesh=mesh)
         grid_mod._raise_if_failed(failures, len(mine))
         return len(mine)
 
@@ -92,7 +152,7 @@ def run_grid_host(gcfg: GridConfig, host_id: int, n_hosts: int) -> int:
             continue
         try:
             res = grid_mod._run_point(gcfg, cfg,
-                                      rng.design_key(master, i), None)
+                                      rng.design_key(master, i), mesh)
             np.savez(path, config_stamp=stamp,
                      **{k: np.asarray(v) for k, v in res.detail.items()})
         except Exception as e:
@@ -103,7 +163,9 @@ def run_grid_host(gcfg: GridConfig, host_id: int, n_hosts: int) -> int:
 
 def run_grid_multihost(gcfg: GridConfig, n_hosts: int = 2,
                        python: str | None = None,
-                       platform: str | None = None) -> GridResult:
+                       platform: str | None = None,
+                       distributed: bool = False,
+                       local_device_count: int | None = None) -> GridResult:
     """Fan the grid out over ``n_hosts`` local worker processes, then
     assemble the merged result from the shared cache.
 
@@ -114,17 +176,38 @@ def run_grid_multihost(gcfg: GridConfig, n_hosts: int = 2,
     platform (the site hook ignores JAX_PLATFORMS env, so workers apply it
     via config.update — see ``_worker_main``); leave ``None`` on a real
     pod, where each worker should claim its own chips.
+
+    ``distributed=True`` upgrades the workers from independent subprocesses
+    to a real ``jax.distributed`` cluster: the parent picks a coordinator
+    port, each worker calls :func:`init_distributed` and then the SPMD
+    entry :func:`run_grid_process`, so host identity and slicing come from
+    ``jax.process_index()``/``process_count()`` and the fan-out closes with
+    a global-device barrier — the exact program shape a multi-host pod
+    runs, exercised as a local multi-process CPU cluster
+    (``local_device_count`` virtual devices per worker).
     """
     if not gcfg.out_dir:
         raise ValueError("multi-host execution needs a shared out_dir")
     env = dict(os.environ)
     if platform:
         env["DPCORR_HOST_PLATFORM"] = platform
+    dist = None
+    if distributed:
+        import socket
+
+        with socket.socket() as s:  # free port for the coordinator service
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        dist = {"coordinator": f"127.0.0.1:{port}",
+                "num_processes": n_hosts,
+                "local_device_count": local_device_count}
     procs = []
     for h in range(n_hosts):
         spec = {"host_id": h, "n_hosts": n_hosts,
                 "gcfg": {f.name: getattr(gcfg, f.name)
                          for f in dataclasses.fields(gcfg)}}
+        if dist:
+            spec["dist"] = {**dist, "process_id": h}
         procs.append(subprocess.Popen(
             [python or sys.executable, "-m", "dpcorr.parallel.multihost"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
@@ -134,7 +217,7 @@ def run_grid_multihost(gcfg: GridConfig, n_hosts: int = 2,
         procs[-1].stdin.write(json.dumps(spec))
         procs[-1].stdin.close()
         procs[-1].stdin = None
-    errs = []
+    errs, reports = [], []
     for h, p in enumerate(procs):
         # communicate() drains stdout+stderr together — a worker that fills
         # one pipe can never deadlock the join
@@ -142,12 +225,32 @@ def run_grid_multihost(gcfg: GridConfig, n_hosts: int = 2,
         if p.returncode != 0:
             tail = err.strip().splitlines()[-3:]
             errs.append(f"host {h}: rc={p.returncode}: " + " | ".join(tail))
+        else:
+            # tolerant scan (as bench._run_worker): a stray non-JSON line
+            # on a worker's stdout must not cost the finished grid
+            for line in reversed(out.strip().splitlines()):
+                try:
+                    rep = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rep, dict) and "host_id" in rep:
+                    reports.append(rep)
+                    break
     if errs:
         raise RuntimeError(f"{len(errs)}/{n_hosts} hosts failed: "
                            + "; ".join(errs)[:800])
+    if dist:
+        # the cluster facts must agree with what we launched: every worker
+        # saw the full process set, and exactly rank 0 merged
+        bad = [r for r in reports if r["process_count"] != n_hosts]
+        if bad or sum(r["merged"] for r in reports) != 1:
+            raise RuntimeError(
+                f"distributed cluster inconsistent: {reports!r}")
     # assemble from the (now complete) shared cache — pure cache hits even
     # when the caller disabled resume for the compute itself
-    return run_grid(dataclasses.replace(gcfg, resume=True))
+    res = run_grid(dataclasses.replace(gcfg, resume=True))
+    res.timings.attrs["hosts"] = reports
+    return res
 
 
 def _worker_main() -> None:
@@ -155,12 +258,17 @@ def _worker_main() -> None:
     # interpreter start regardless of JAX_PLATFORMS; a post-import
     # config.update is the only override that sticks, so honor the
     # requested worker platform here, before any backend initializes.
+    spec = json.loads(sys.stdin.read())
     platform = os.environ.get("DPCORR_HOST_PLATFORM")
-    if platform:
+    dist = spec.get("dist")
+    if dist:
+        init_distributed(dist["coordinator"], dist["num_processes"],
+                         dist["process_id"], platform=platform,
+                         local_device_count=dist.get("local_device_count"))
+    elif platform:
         import jax
 
         jax.config.update("jax_platforms", platform)
-    spec = json.loads(sys.stdin.read())
     gd = spec["gcfg"]
     # JSON round-trips tuples as lists; GridConfig fields tolerate
     # sequences, and SimConfig.__post_init__ freezes dgp_args recursively
@@ -168,8 +276,20 @@ def _worker_main() -> None:
     for k in ("n_grid", "rho_grid"):
         gd[k] = tuple(gd[k])
     gcfg = GridConfig(**gd)
-    owned = run_grid_host(gcfg, spec["host_id"], spec["n_hosts"])
-    print(json.dumps({"host_id": spec["host_id"], "points": owned}))
+    if dist:
+        import jax
+
+        res = run_grid_process(gcfg)
+        print(json.dumps({
+            "host_id": jax.process_index(),
+            "process_count": jax.process_count(),
+            "global_devices": len(jax.devices()),
+            "local_devices": len(jax.local_devices()),
+            "merged": res is not None,
+        }))
+    else:
+        owned = run_grid_host(gcfg, spec["host_id"], spec["n_hosts"])
+        print(json.dumps({"host_id": spec["host_id"], "points": owned}))
 
 
 if __name__ == "__main__":

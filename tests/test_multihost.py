@@ -67,3 +67,28 @@ def test_multihost_local_backend_honored(tmp_path, monkeypatch):
 def test_multihost_requires_out_dir():
     with pytest.raises(ValueError, match="out_dir"):
         run_grid_multihost(GridConfig(**GCFG), n_hosts=2)
+
+
+def test_distributed_cluster_matches_single_host(tmp_path, monkeypatch):
+    """VERDICT r2 #7: the fan-out over a *real* ``jax.distributed``
+    runtime — a local 2-process CPU cluster (2 virtual devices per worker,
+    4 global) where each worker derives its slice from
+    ``jax.process_index()``/``process_count()``, runs the sharded bucketed
+    backend over its local mesh, and rank 0 merges after the global
+    barrier. Results must be bit-identical to the plain single-host grid."""
+    monkeypatch.setenv("DPCORR_HOST_PLATFORM", "cpu")
+    gcfg = GridConfig(**GCFG, backend="bucketed-sharded",
+                      out_dir=str(tmp_path / "dist"))
+    res = run_grid_multihost(gcfg, n_hosts=2, distributed=True,
+                             local_device_count=2)
+    hosts = sorted(res.timings.attrs["hosts"], key=lambda r: r["host_id"])
+    assert [h["host_id"] for h in hosts] == [0, 1]
+    assert all(h["process_count"] == 2 for h in hosts)
+    assert all(h["global_devices"] == 4 for h in hosts)
+    assert all(h["local_devices"] == 2 for h in hosts)
+    assert [h["merged"] for h in hosts] == [True, False]
+    ref = run_grid(GridConfig(**GCFG))  # single host, no cache
+    for col in ref.detail_all.columns:
+        np.testing.assert_array_equal(res.detail_all[col].to_numpy(),
+                                      ref.detail_all[col].to_numpy(),
+                                      err_msg=col)

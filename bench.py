@@ -79,6 +79,78 @@ def _worker_shape(mode: str) -> tuple[int, int]:
 METRIC = "mc_reps_per_sec_chip_ni_sign_n10k"
 
 
+def make_metrics_fn():
+    """Per-rep metrics (se², cover, ci_len) at the bench design point."""
+    import jax.numpy as jnp
+
+    def _metrics(r):
+        cover = ((RHO >= r.ci_low) & (RHO <= r.ci_high)).astype(jnp.float32)
+        return (r.rho_hat - RHO) ** 2, cover, r.ci_high - r.ci_low
+
+    return _metrics
+
+
+def make_xla_block(chunk: int):
+    """The headline XLA kernel: (master key, n_reps) → mean metrics over
+    n_reps replications of the north-star workload (generate n=10k
+    Gaussian pair → NI sign-batch estimate + CI → metrics). Shared by the
+    bench worker and the roofline instrumentation
+    (``benchmarks/roofline.py``) so both always measure the same program.
+    """
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from dpcorr.models.dgp import gen_gaussian
+    from dpcorr.models.estimators import ci_ni_signbatch
+    from dpcorr.sim import chunked_vmap
+    from dpcorr.utils import rng
+
+    _metrics = make_metrics_fn()
+
+    def _one_rep(key):
+        xy = gen_gaussian(rng.stream(key, "dgp"), N, jnp.float32(RHO))
+        return _metrics(ci_ni_signbatch(rng.stream(key, "ni"),
+                                        xy[:, 0], xy[:, 1],
+                                        EPS1, EPS2, alpha=ALPHA))
+
+    @partial(jax.jit, static_argnums=(1,))
+    def _xla_block(key, n_reps: int):
+        keys = rng.rep_keys(key, n_reps)
+        se2, cover, ci_len = chunked_vmap(_one_rep, keys, chunk)
+        return jnp.mean(se2), jnp.mean(cover), jnp.mean(ci_len)
+
+    return _xla_block
+
+
+def measure_steady_state(run_block, args_for, block_reps: int,
+                         budget_s: float):
+    """Compile, calibrate one block's wall-clock, then dispatch ~budget
+    worth of blocks asynchronously and drain once (the host-fetch of the
+    scalars is the only reliable completion barrier through the remote-TPU
+    tunnel). Returns (reps_per_sec, mean metrics). Shared by the bench
+    workers and ``benchmarks/roofline.py`` so a measured reps/sec always
+    means the same protocol."""
+
+    def _fetch(out):
+        return tuple(float(x) for x in out)
+
+    _fetch(run_block(args_for(0), block_reps))  # compile + warm
+    t0 = time.perf_counter()
+    _fetch(run_block(args_for(1), block_reps))
+    dt1 = time.perf_counter() - t0
+    n_blocks = max(1, min(MAX_BLOCKS, int(budget_s / dt1)))
+
+    t0 = time.perf_counter()
+    futs = [run_block(args_for(2 + i), block_reps)
+            for i in range(n_blocks)]
+    outs = [_fetch(f) for f in futs]
+    elapsed = time.perf_counter() - t0
+    means = tuple(sum(o[j] for o in outs) / len(outs) for j in range(3))
+    return n_blocks * block_reps / elapsed, means
+
+
 # --------------------------------------------------------------------------
 # Worker: the actual measurement. Runs in a subprocess; prints one JSON line.
 # --------------------------------------------------------------------------
@@ -101,28 +173,11 @@ def worker_main(mode: str, budget_s: float) -> None:
 
     import jax.numpy as jnp
 
-    from dpcorr.models.dgp import gen_gaussian
-    from dpcorr.models.estimators import ci_ni_signbatch
-    from dpcorr.sim import chunked_vmap
     from dpcorr.utils import rng
 
     block_reps, chunk = _worker_shape(mode)
-
-    def _metrics(r):
-        cover = ((RHO >= r.ci_low) & (RHO <= r.ci_high)).astype(jnp.float32)
-        return (r.rho_hat - RHO) ** 2, cover, r.ci_high - r.ci_low
-
-    def _one_rep(key):
-        xy = gen_gaussian(rng.stream(key, "dgp"), N, jnp.float32(RHO))
-        return _metrics(ci_ni_signbatch(rng.stream(key, "ni"),
-                                        xy[:, 0], xy[:, 1],
-                                        EPS1, EPS2, alpha=ALPHA))
-
-    @partial(jax.jit, static_argnums=(1,))
-    def _xla_block(key, n_reps: int):
-        keys = rng.rep_keys(key, n_reps)
-        se2, cover, ci_len = chunked_vmap(_one_rep, keys, chunk)
-        return jnp.mean(se2), jnp.mean(cover), jnp.mean(ci_len)
+    _metrics = make_metrics_fn()
+    _xla_block = make_xla_block(chunk)
 
     @partial(jax.jit, static_argnums=(1,))
     def _pallas_block(block_idx, n_reps: int):
@@ -136,27 +191,9 @@ def worker_main(mode: str, budget_s: float) -> None:
         se2, cover, ci_len = _metrics(r)
         return jnp.mean(se2), jnp.mean(cover), jnp.mean(ci_len)
 
-    def _fetch(out):
-        """Host-fetch the scalars — the only reliable completion barrier
-        through the remote-TPU tunnel."""
-        return tuple(float(x) for x in out)
-
     def _measure(run_block, args_for):
-        """Compile, calibrate one block, then dispatch ~budget worth of
-        blocks asynchronously and drain once."""
-        _fetch(run_block(args_for(0), block_reps))  # compile + warm
-        t0 = time.perf_counter()
-        _fetch(run_block(args_for(1), block_reps))
-        dt1 = time.perf_counter() - t0
-        n_blocks = max(1, min(MAX_BLOCKS, int(budget_s / dt1)))
-
-        t0 = time.perf_counter()
-        futs = [run_block(args_for(2 + i), block_reps)
-                for i in range(n_blocks)]
-        outs = [_fetch(f) for f in futs]
-        elapsed = time.perf_counter() - t0
-        means = tuple(sum(o[j] for o in outs) / len(outs) for j in range(3))
-        return n_blocks * block_reps / elapsed, means
+        return measure_steady_state(run_block, args_for, block_reps,
+                                    budget_s)
 
     key = rng.master_key()
 
@@ -304,6 +341,38 @@ def _run_worker(mode: str, timeout_s: float, budget_s: float):
     return None, f"{mode} worker: exited 0 but printed no measurement JSON"
 
 
+def _health_probe(timeout_s: float = 150.0) -> bool:
+    """Bounded TPU-liveness probe in a throwaway process group (the same
+    one-matmul check ``benchmarks/tpu_revalidate.sh`` polls with). Its
+    verdict picks the first tpu worker's leash: a probe that *succeeds*
+    proves the tunnel is alive, so a slow init/compile afterwards deserves
+    patience rather than a kill (the r02 round lost its headline to two
+    worker timeouts on a tunnel that was merely slow); a probe that fails
+    keeps the short timeout so a wedged tunnel degrades to CPU quickly."""
+    code = ("import jax, jax.numpy as jnp; "
+            "assert jax.devices()[0].platform in ('tpu', 'axon'), "
+            "jax.devices()[0].platform; "  # a CPU fallback is NOT healthy
+            "print(float((jnp.ones((128,128))@jnp.ones((128,128))).sum()))")
+    try:
+        p = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL,
+                             start_new_session=True)
+        p.communicate(timeout=timeout_s)
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        p.wait()
+        return False
+    except Exception:
+        return False
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--worker", choices=["tpu", "tpu-pallas", "cpu"],
@@ -320,8 +389,12 @@ def main() -> None:
     # Attempt 1: TPU, full budget, XLA path only. Init alone can take
     # minutes through the tunnel; the timeout bounds init + compile + the
     # measurement and scales with the requested budget so a long --budget
-    # isn't killed mid-measurement.
-    out, err = _run_worker("tpu", timeout_s=420 + 2.5 * args.budget,
+    # isn't killed mid-measurement. A positive health probe extends the
+    # leash (tunnel alive ⇒ timeouts would only kill slow-but-working
+    # runs); a negative one keeps it short for a fast CPU degrade.
+    healthy = _health_probe()
+    first_base = 900 if healthy else 420
+    out, err = _run_worker("tpu", timeout_s=first_base + 2.5 * args.budget,
                            budget_s=args.budget)
     if out is None:
         attempts.append(err)
@@ -357,6 +430,8 @@ def main() -> None:
                "detail": {"degraded": "all-paths-failed"}}
     if attempts:
         out.setdefault("detail", {})["attempts"] = attempts
+    out.setdefault("detail", {})["tunnel_health_probe"] = (
+        "ok" if healthy else "failed")
     print(json.dumps(out), flush=True)
     sys.exit(0)
 

@@ -1,0 +1,121 @@
+"""MFU/roofline measurement of the headline kernel (VERDICT r2 #4).
+
+Instruments the exact program ``bench.py`` measures (``bench.make_xla_block``)
+with three independent lenses and writes one JSON artifact:
+
+1. XLA ``cost_analysis`` of the compiled block → FLOPs / bytes per rep as
+   the compiler counts them (post-fusion);
+2. the analytic hand model (``dpcorr.utils.roofline.analytic_rep_model``)
+   as a sanity bound;
+3. a short steady-state throughput measurement → achieved FLOP/s and B/s
+   as %-of-peak for the platform's chip (``ChipPeaks``).
+
+Optionally captures a ``jax.profiler`` trace of a few blocks
+(``--trace DIR``) — the checked-in trace PERFORMANCE.md cites.
+
+Usage::
+
+    python -m benchmarks.roofline [--block 65536] [--chunk 16384]
+        [--budget 10] [--trace benchmarks/results/trace_r03]
+        [--out benchmarks/results/r03_roofline.json]
+
+Runs on any platform (peaks table degrades to an order-of-magnitude CPU
+estimate off-TPU; the artifact records which chip model applied).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from pathlib import Path
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--block", type=int, default=None,
+                    help="reps per dispatched block (default: platform "
+                         "bench shape)")
+    ap.add_argument("--chunk", type=int, default=None)
+    ap.add_argument("--budget", type=float, default=10.0)
+    ap.add_argument("--trace", type=str, default=None,
+                    help="capture a jax.profiler trace into this dir")
+    ap.add_argument("--out", type=str,
+                    default="benchmarks/results/r03_roofline.json")
+    ap.add_argument("--platform", type=str, default=None,
+                    help="force a JAX platform (e.g. 'cpu'); the image's "
+                         "site hook ignores JAX_PLATFORMS env, so an "
+                         "in-process config.update is the only override")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import bench
+    from dpcorr.utils import rng
+    from dpcorr.utils.roofline import (analytic_rep_model, peaks_for,
+                                       summarize, xla_cost)
+
+    platform = jax.devices()[0].platform
+    is_tpu = platform in ("tpu", "axon")
+    # the bench worker's shape resolution, env overrides included — the
+    # artifact must describe the same compiled program as the headline
+    block, chunk = bench._worker_shape("tpu" if is_tpu else "cpu")
+    block = args.block or block
+    chunk = args.chunk or chunk
+
+    fn = bench.make_xla_block(chunk)
+    key = rng.master_key()
+
+    # --- lens 1: the compiler's own count of the compiled block ---------
+    cost = xla_cost(fn, rng.design_key(key, 0), block)
+    per_rep = {"flops": cost["flops"] / block, "bytes": cost["bytes"] / block}
+
+    # --- lens 2: analytic hand model ------------------------------------
+    model = analytic_rep_model(bench.N, bench.EPS1, bench.EPS2)
+
+    # --- lens 3: steady-state throughput (the bench's own protocol) -----
+    rps, _ = bench.measure_steady_state(
+        fn, lambda i: rng.design_key(key, i), block, args.budget)
+
+    peaks = peaks_for(platform)
+    # the compiler count is the headline work model; fall back to the
+    # analytic model when cost_analysis is empty on this backend
+    flops_per_rep = per_rep["flops"] or model["flops_per_rep"]
+    bytes_per_rep = per_rep["bytes"] or model["bytes_per_rep_floor"]
+    summary = summarize(rps, flops_per_rep, bytes_per_rep, peaks)
+
+    out = {
+        "metric": "roofline_ni_sign_n10k",
+        "device": str(jax.devices()[0]),
+        "platform": platform,
+        "block_reps": block,
+        "chunk": chunk,
+        "xla_cost_per_rep": per_rep,
+        "analytic_model": model,
+        "xla_vs_analytic_flops_ratio": (
+            round(per_rep["flops"] / model["flops_per_rep"], 2)
+            if per_rep["flops"] else None),
+        "summary": summary,
+        "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+    if args.trace:
+        tdir = Path(args.trace)
+        tdir.mkdir(parents=True, exist_ok=True)
+        with jax.profiler.trace(str(tdir)):
+            futs = [fn(rng.design_key(key, 100 + i), block)
+                    for i in range(3)]
+            for f in futs:
+                tuple(float(x) for x in f)
+        out["trace_dir"] = str(tdir)
+
+    Path(args.out).parent.mkdir(parents=True, exist_ok=True)
+    Path(args.out).write_text(json.dumps(out, indent=1))
+    print(json.dumps(out["summary"] | {"out": args.out}))
+
+
+if __name__ == "__main__":
+    main()

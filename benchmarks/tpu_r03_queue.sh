@@ -1,0 +1,96 @@
+#!/bin/bash
+# Round-3 TPU validation queue (supersedes tpu_revalidate.sh's r02 queue).
+#
+# VERDICT r2 ordering contract: bank the headline FIRST, quarantine
+# anything that has ever wedged the tunnel (limit probes, new Mosaic
+# features) to AFTER it. Steps, in order:
+#
+#   1. `python bench.py` at shipped defaults -> the 235x headline on the
+#      current (post-refactor) kernels. THE round-3 deliverable.
+#   2. Roofline + profiler trace of the same kernel (VERDICT r2 #4).
+#   3. Pallas gauss A/B (boxmuller vs ndtri) -> decides the kernel default.
+#   4. Fused CLI grid smoke (--b 8) -> end-to-end grid wiring on-chip.
+#   5. BASELINE config 5 stress: streaming subG at n=10^6 on the chip
+#      (VERDICT r2 #2) via benchmarks.run_all --configs 5.
+#   6. Full 5-config suite incl. HRS bootstrap (VERDICT r2 #3) -- longest,
+#      last, so a mid-run wedge costs the least.
+#
+# Results land in /tmp/tpu_r03/; summarized on stdout.
+
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+OUT=/tmp/tpu_r03
+mkdir -p "$OUT"
+FAILED=0
+TOTAL=0
+
+step() {  # step <name> <cmd...>: run, record status, keep going
+  local name=$1; shift
+  TOTAL=$((TOTAL + 1))
+  if "$@"; then
+    echo "-- $name: OK ($(date -u +%H:%M:%SZ))"
+  else
+    echo "-- $name: FAILED (rc=$?) ($(date -u +%H:%M:%SZ))"
+    FAILED=$((FAILED + 1))
+  fi
+}
+
+probe() {
+  timeout 150 python -c \
+    "import jax; assert jax.devices()[0].platform in ('tpu','axon'); import jax.numpy as jnp; print(float((jnp.ones((128,128))@jnp.ones((128,128))).sum()))" \
+    >/dev/null 2>&1
+}
+
+for i in $(seq 1 200); do
+  if probe; then
+    echo "tunnel healthy at attempt $i ($(date -u +%H:%M:%SZ))"
+
+    echo "== 1. bench.py at shipped defaults (the headline) =="
+    step bench_default bash -c \
+      'timeout 1800 python bench.py 2>"'$OUT'/bench_default.err" \
+       | tail -1 | tee "'$OUT'/bench_default.json" | grep -q "reps_per_sec"'
+
+    echo "== 2. roofline + trace (same kernel) =="
+    step roofline bash -c \
+      'timeout 1200 python -m benchmarks.roofline --budget 15 \
+       --trace benchmarks/results/trace_r03 \
+       --out benchmarks/results/r03_roofline.json \
+       2>"'$OUT'/roofline.err" | tail -1 | grep -q reps_per_sec'
+
+    echo "== 3. pallas gauss A/B (worker-only, budget 20s each) =="
+    step pallas_boxmuller bash -c \
+      'timeout 900 python bench.py --worker tpu-pallas --budget 20 \
+       2>"'$OUT'/pallas_bm.err" | tail -1 \
+       | tee "'$OUT'/pallas_boxmuller.json" | grep -q "reps_per_sec"'
+    step pallas_ndtri bash -c \
+      'DPCORR_BENCH_PALLAS_GAUSS=ndtri \
+       timeout 900 python bench.py --worker tpu-pallas --budget 20 \
+       2>"'$OUT'/pallas_nd.err" | tail -1 \
+       | tee "'$OUT'/pallas_ndtri.json" | grep -q "reps_per_sec"'
+
+    echo "== 4. fused CLI grid smoke (--b 8) =="
+    step grid_fused_smoke bash -c \
+      'timeout 900 python -m dpcorr grid --backend bucketed --fused auto \
+       --b 8 2>"'$OUT'/grid.err" | tail -2 \
+       | tee "'$OUT'/grid_fused_smoke.txt" | grep -q "INT"'
+
+    echo "== 5. BASELINE config 5 stress (streaming n=10^6) =="
+    step config5 bash -c \
+      'timeout 3000 python -m benchmarks.run_all --config 5 \
+       2>"'$OUT'/config5.err" \
+       | tee benchmarks/results/r03_tpu_config5.jsonl | tail -3'
+
+    echo "== 6. full 5-config suite, BASELINE rep counts (longest, last) =="
+    step suite bash -c \
+      'timeout 7200 python -m benchmarks.run_all --full \
+       2>"'$OUT'/suite.err" \
+       | tee benchmarks/results/r03_tpu_suite.jsonl | tail -3'
+
+    cat "$OUT"/*.json 2>/dev/null
+    echo "r03 queue finished ($(date -u +%H:%M:%SZ)): $((TOTAL - FAILED))/$TOTAL steps OK"
+    exit $FAILED
+  fi
+  sleep 110
+done
+echo "tunnel never recovered within the polling window"
+exit 1

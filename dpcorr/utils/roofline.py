@@ -1,0 +1,144 @@
+"""Roofline / hardware-utilization accounting for the bench kernels.
+
+The reference publishes no performance model at all (SURVEY.md §6); the
+BASELINE metric is MC replications/sec/chip. This module turns a measured
+reps/sec into *%-of-peak* numbers so the throughput can be judged against
+what the chip could possibly do:
+
+- **Work model**: per-replication FLOPs and HBM bytes, two ways —
+  (a) XLA's own cost analysis of the compiled headline kernel
+  (``Compiled.cost_analysis()``; the compiler's count of the program it
+  actually emitted, post-fusion), and (b) an analytic hand count of the
+  math (:func:`analytic_rep_model`) with reference citations, as a sanity
+  bound on (a).
+- **Peaks**: per-chip ceilings for the units this workload can use. The
+  MC simulation has no large matmuls — its FLOPs are elementwise PRNG,
+  transforms, and reductions, i.e. **VPU** work (the MXU ceiling is
+  listed only to show how far this workload class sits from it), and its
+  memory traffic is the per-rep (n, 2) sample table streaming through HBM
+  when XLA materializes it between fusions.
+
+The classification (VPU-bound vs HBM-bound) falls out of the achieved
+fractions; ``benchmarks/roofline.py`` runs the measurement and writes the
+JSON artifact PERFORMANCE.md cites.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipPeaks:
+    """Per-chip ceilings in SI units (FLOP/s, B/s)."""
+
+    name: str
+    mxu_bf16_flops: float  #: systolic-array peak (bf16 inputs, f32 acc)
+    vpu_f32_flops: float   #: elementwise f32 peak (the relevant one here)
+    hbm_bytes: float       #: HBM streaming bandwidth
+    note: str = ""
+
+
+#: TPU v5 lite (v5e) — the chip behind this image's tunnel. MXU/HBM are
+#: the public figures (197 bf16 TFLOP/s, 819 GB/s; jax-ml.github.io/
+#: scaling-book rooflines chapter). The VPU peak is an *estimate* from the
+#: architecture: 8 sublanes x 128 lanes x 4 ALUs x ~0.94 GHz ~= 3.9e12
+#: f32 FLOP/s — labeled as such in every artifact that uses it.
+TPU_V5E = ChipPeaks(
+    name="tpu-v5e",
+    mxu_bf16_flops=1.97e14,
+    vpu_f32_flops=3.9e12,
+    hbm_bytes=8.19e11,
+    note="MXU/HBM public; VPU estimated 8x128 lanes x 4 ALUs x 0.94 GHz",
+)
+
+#: Honest CPU stand-in so the script degrades meaningfully off-TPU: one
+#: modern x86 core ~ 1e11 f32 FLOP/s (AVX-512 FMA at ~3 GHz), ~2e10 B/s
+#: effective per-core stream bandwidth. Order-of-magnitude only.
+CPU_CORE = ChipPeaks(
+    name="cpu-core",
+    mxu_bf16_flops=1e11,
+    vpu_f32_flops=1e11,
+    hbm_bytes=2e10,
+    note="order-of-magnitude single-core estimate",
+)
+
+
+def peaks_for(platform: str) -> ChipPeaks:
+    return TPU_V5E if platform in ("tpu", "axon") else CPU_CORE
+
+
+def xla_cost(jitted_fn, *args, **static) -> dict:
+    """FLOPs / bytes-accessed of the compiled program, per XLA.
+
+    ``Compiled.cost_analysis()`` returns the compiler's properties dict
+    (key spellings vary across versions: ``flops``, ``bytes accessed``).
+    Returns ``{"flops": float, "bytes": float}``; zero values mean the
+    entry is absent on this backend (e.g. an opaque custom call — Pallas
+    kernels are invisible to this analysis; use the analytic model there).
+    """
+    compiled = jitted_fn.lower(*args, **static).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax: one dict per program
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    return {"flops": float(ca.get("flops", 0.0)),
+            "bytes": float(ca.get("bytes accessed",
+                                  ca.get("bytes_accessed", 0.0)))}
+
+
+def analytic_rep_model(n: int, eps1: float, eps2: float) -> dict:
+    """Hand count of one bench replication (FLOPs and minimal HBM bytes).
+
+    One rep of the north-star workload (bench.py: vert-cor.R:392-419 at
+    n=10k) does, per sample unless noted:
+
+    - **PRNG**: 2 uniforms (threefry-2x32: ~24 rounds of ~3 int-ops on
+      2 words ≈ 150 ops per 2x32-bit block ⇒ ~75/word) + key derivation
+      amortized; counted as integer "FLOPs" since they occupy the same
+      VPU issue slots.
+    - **generate** (models/dgp.py:29-35, closed-form 2x2 Cholesky of
+      MASS::mvrnorm vert-cor.R:72): 2 normals via Box-Muller (log, sqrt,
+      sincos ~ 30 flops) + 3 flops combine.
+    - **standardize** (ops/standardize.py, priv_standardize
+      vert-cor.R:322-348): clip (2), two moment-sum passes fused to one
+      (2), center-only subtract (1) x 2 vars ~= 10.
+    - **sign-batch estimate** (ni_sign.py:41-48, vert-cor.R:118-156):
+      sign (1), batch-mean add (1) x 2 vars; per-batch Laplace noise +
+      products are O(k) << n.
+    - **CI** (vert-cor.R:233-254): O(k) — negligible.
+
+    HBM floor: XLA materializes the (n, 2) f32 sample table between the
+    generate and estimate fusions (write + read = 16 B/sample); everything
+    else lives in registers/VMEM.
+    """
+    per_sample = (2 * 75) + 30 + 3 + 10 + 2 + 2  # ~197
+    flops = per_sample * n
+    m = min(max(math.ceil(8.0 / (eps1 * eps2)), 1), n)
+    k = max(n // m, 1)
+    return {
+        "flops_per_rep": float(flops),
+        "bytes_per_rep_floor": float(2 * n * 4 * 2),  # write+read (n,2) f32
+        "per_sample_flops": per_sample,
+        "batch_geometry": {"m": m, "k": k},
+    }
+
+
+def summarize(reps_per_sec: float, flops_per_rep: float,
+              bytes_per_rep: float, peaks: ChipPeaks) -> dict:
+    """Achieved rates and %-of-peak; classify the binding resource."""
+    fl = reps_per_sec * flops_per_rep
+    by = reps_per_sec * bytes_per_rep
+    frac_vpu = fl / peaks.vpu_f32_flops
+    frac_hbm = by / peaks.hbm_bytes
+    return {
+        "reps_per_sec": reps_per_sec,
+        "achieved_flops_per_sec": fl,
+        "achieved_bytes_per_sec": by,
+        "pct_of_vpu_peak": round(100 * frac_vpu, 1),
+        "pct_of_mxu_bf16_peak": round(100 * fl / peaks.mxu_bf16_flops, 2),
+        "pct_of_hbm_peak": round(100 * frac_hbm, 1),
+        "bound": ("vpu" if frac_vpu >= frac_hbm else "hbm"),
+        "peaks": dataclasses.asdict(peaks),
+    }

@@ -16,22 +16,24 @@ import pytest
 import bench
 
 
-def _run_main(monkeypatch, capsys, responses):
+def _run_main(monkeypatch, capsys, responses, healthy=True):
     """Drive bench.main() with a scripted _run_worker; return parsed JSON."""
-    calls = []
+    calls, timeouts = [], []
 
     def fake_run_worker(mode, timeout_s, budget_s):
         calls.append(mode)
+        timeouts.append(timeout_s)
         out, err = responses[len(calls) - 1]
         return out, err
 
     monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
+    monkeypatch.setattr(bench, "_health_probe", lambda: healthy)
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     with pytest.raises(SystemExit) as exc:
         bench.main()
     assert exc.value.code == 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
-    return json.loads(line), calls
+    return json.loads(line), calls, timeouts
 
 
 def _good():
@@ -56,7 +58,7 @@ CPU = {"metric": bench.METRIC, "value": 1700.0, "unit": "reps/sec/chip",
 
 
 def test_tpu_first_try(monkeypatch, capsys):
-    out, calls = _run_main(monkeypatch, capsys, [
+    out, calls, _ = _run_main(monkeypatch, capsys, [
         (_good(), None),
         (_pallas(), None),
     ])
@@ -70,7 +72,7 @@ def test_tpu_first_try(monkeypatch, capsys):
 
 def test_pallas_probe_failure_keeps_xla_number(monkeypatch, capsys):
     """A hung/killed pallas probe must never cost the XLA measurement."""
-    out, calls = _run_main(monkeypatch, capsys, [
+    out, calls, _ = _run_main(monkeypatch, capsys, [
         (_good(), None),
         (None, "tpu-pallas worker: timeout after 465s"),
     ])
@@ -81,7 +83,7 @@ def test_pallas_probe_failure_keeps_xla_number(monkeypatch, capsys):
 
 
 def test_pallas_insane_stats_rejected(monkeypatch, capsys):
-    out, calls = _run_main(monkeypatch, capsys, [
+    out, calls, _ = _run_main(monkeypatch, capsys, [
         (_good(), None),
         (_pallas(coverage=0.70), None),  # NaN-ish kernel: wrong coverage
     ])
@@ -92,13 +94,13 @@ def test_pallas_insane_stats_rejected(monkeypatch, capsys):
 
 def test_skip_pallas_env(monkeypatch, capsys):
     monkeypatch.setenv("DPCORR_BENCH_SKIP_PALLAS", "1")
-    out, calls = _run_main(monkeypatch, capsys, [(_good(), None)])
+    out, calls, _ = _run_main(monkeypatch, capsys, [(_good(), None)])
     assert calls == ["tpu"]
     assert "DPCORR_BENCH_SKIP_PALLAS" in out["detail"]["pallas_skipped"]
 
 
 def test_tpu_retry_succeeds(monkeypatch, capsys):
-    out, calls = _run_main(monkeypatch, capsys, [
+    out, calls, _ = _run_main(monkeypatch, capsys, [
         (None, "tpu worker: timeout after 480s"),
         (_good(), None),
         (None, "tpu-pallas worker: rc=1: boom"),
@@ -109,7 +111,7 @@ def test_tpu_retry_succeeds(monkeypatch, capsys):
 
 
 def test_cpu_fallback_degraded(monkeypatch, capsys):
-    out, calls = _run_main(monkeypatch, capsys, [
+    out, calls, _ = _run_main(monkeypatch, capsys, [
         (None, "tpu worker: timeout after 480s"),
         (None, "tpu worker: timeout after 300s"),
         (dict(CPU), None),
@@ -120,8 +122,24 @@ def test_cpu_fallback_degraded(monkeypatch, capsys):
     assert len(out["detail"]["attempts"]) == 2
 
 
+def test_health_probe_sets_first_leash(monkeypatch, capsys):
+    """VERDICT r2 #1: a live tunnel earns the first tpu worker a longer
+    timeout (slow-but-working init must not be killed); a dead probe keeps
+    the short leash so a wedged tunnel degrades fast. The probe verdict is
+    recorded in the artifact either way."""
+    out, _, t_ok = _run_main(monkeypatch, capsys,
+                             [(_good(), None), (_pallas(), None)],
+                             healthy=True)
+    assert out["detail"]["tunnel_health_probe"] == "ok"
+    out, _, t_bad = _run_main(monkeypatch, capsys,
+                              [(_good(), None), (_pallas(), None)],
+                              healthy=False)
+    assert out["detail"]["tunnel_health_probe"] == "failed"
+    assert t_ok[0] > t_bad[0] >= 420
+
+
 def test_total_failure_still_valid_json(monkeypatch, capsys):
-    out, calls = _run_main(monkeypatch, capsys, [
+    out, calls, _ = _run_main(monkeypatch, capsys, [
         (None, "tpu worker: timeout after 480s"),
         (None, "tpu worker: rc=1: boom"),
         (None, "cpu worker: rc=1: boom"),

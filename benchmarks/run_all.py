@@ -142,13 +142,18 @@ def config5(full: bool, b_override=None):
     n = 1_000_000
     b = b_override or (256 if full else 32)
     target = 1_000_000  # BASELINE.md: 1M reps
+    # Replication vmap width: CPU caches want it small (b//8 measured best
+    # on this image); a TPU wants wide blocks — (chunk, 65536, 2) f32 at
+    # chunk=32 is ~17 MB resident per lax.map step, nowhere near HBM.
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    chunk_size = min(b, 32) if on_tpu else max(2, b // 8)
     # λ_n(n, η) = min(2η√(log n), 2√3) caps at 2√3 for every η ≳ 0.47 at
     # n=1e6 (ver-cor-subG.R:1), so sweep the region where the clip binds.
     for eta in (0.1, 0.25, 0.5):
         cfg = SimConfig(n=n, rho=0.5, eps1=1.0, eps2=1.0, b=b,
                         dgp="bounded_factor", use_subg=True,
                         eta1=eta, eta2=eta, stream_n_chunk=65536,
-                        chunk_size=max(2, b // 8))
+                        chunk_size=chunk_size)
         res, dt = _timed_sim(cfg)
         rps = b / dt
         _emit(5, f"stress_n1e6_subg_eta{eta}_reps_per_sec", rps,
@@ -157,6 +162,23 @@ def config5(full: bool, b_override=None):
                   "projected_1M_reps_hours": round(target / rps / 3600, 2),
                   "ni": {k: round(v, 5)
                          for k, v in res.summary["NI"].items()},
+                  "int": {k: round(v, 5)
+                          for k, v in res.summary["INT"].items()},
+                  # Coverage at stress scale is a CONSTRUCTION property,
+                  # recorded honestly rather than tuned away: the clip
+                  # thresholds introduce a fixed (n-independent) bias —
+                  # at η=0.1, λ_n=0.74 truncates the bounded-factor data
+                  # itself (hard NI clip-bias ⇒ coverage → 0); and for
+                  # INT even mild product clipping biases ρ̂ by ~1e-3
+                  # while its CI width shrinks as 1/√n, so at n=10⁶ the
+                  # interval is narrower than the bias (coverage → 0).
+                  # The reference never ran n=10⁶ (max n=12,000,
+                  # ver-cor-subG.R:245); at its scales the same widths
+                  # dominate the same biases and coverage is nominal
+                  # (see acceptance_r02.json subg points at n=4000).
+                  "coverage_note": "fixed clip-bias vs 1/sqrt(n) width; "
+                                   "see detail comment in benchmarks/"
+                                   "run_all.py config5",
               })
 
 

@@ -76,15 +76,17 @@ for i in $(seq 1 200); do
 
     echo "== 5. BASELINE config 5 stress (streaming n=10^6) =="
     step config5 bash -c \
-      'timeout 3000 python -m benchmarks.run_all --config 5 \
+      'set -o pipefail; timeout 3000 python -m benchmarks.run_all --config 5 \
        2>"'$OUT'/config5.err" \
-       | tee benchmarks/results/r03_tpu_config5.jsonl | tail -3'
+       | tee benchmarks/results/r03_tpu_config5.jsonl \
+       | grep -q stress_n1e6'
 
     echo "== 6. full 5-config suite, BASELINE rep counts (longest, last) =="
     step suite bash -c \
-      'timeout 7200 python -m benchmarks.run_all --full \
+      'set -o pipefail; timeout 7200 python -m benchmarks.run_all --full \
        2>"'$OUT'/suite.err" \
-       | tee benchmarks/results/r03_tpu_suite.jsonl | tail -3'
+       | tee benchmarks/results/r03_tpu_suite.jsonl \
+       | grep -q stress_n1e6'
 
     cat "$OUT"/*.json 2>/dev/null
     echo "r03 queue finished ($(date -u +%H:%M:%SZ)): $((TOTAL - FAILED))/$TOTAL steps OK"

@@ -143,39 +143,68 @@ def _standardizers(key: jax.Array, chunk_fn: ChunkFn, n: int, n_chunk: int,
 
 
 # ------------------------------------------------------------ NI core ----
+def _ni_batch_noise(key_x: jax.Array, key_y: jax.Array, k: int,
+                    scale_x, scale_y, pad_to: int):
+    """The materialized-shape ``(k,)`` batch-noise draws, zero-padded to
+    the chunk grid — one source for the separate and fused kernels so the
+    key addresses and call shapes can never diverge."""
+    lap_x = jnp.pad(laplace(key_x, (k,), scale_x), (0, pad_to - k))
+    lap_y = jnp.pad(laplace(key_y, (k,), scale_y), (0, pad_to - k))
+    return lap_x, lap_y
+
+
+def _ni_chunk_stats(xy, c, tx: Callable, ty: Callable, m: int, kc: int,
+                    k: int, lap_x, lap_y):
+    """One chunk's NI contribution (vert-cor.R:131-153 /
+    ver-cor-subG.R:40-52): kc batch means of the transformed columns plus
+    the sliced batch noise; batches past k are masked to 0 (a chunk past
+    the last batch contributes exact zeros)."""
+    xb = tx(xy[:, 0]).reshape(kc, m).mean(axis=1)
+    yb = ty(xy[:, 1]).reshape(kc, m).mean(axis=1)
+    b0 = c * kc
+    xt = xb + jax.lax.dynamic_slice(lap_x, (b0,), (kc,))
+    yt = yb + jax.lax.dynamic_slice(lap_y, (b0,), (kc,))
+    t = jnp.where(b0 + jnp.arange(kc) < k, m * xt * yt, 0.0)
+    return jnp.sum(t), jnp.sum(t * t)
+
+
+def _ni_from_sums(st, st2, k: int):
+    """(η̂, sd(T_j)) from the accumulated Σ T_j, Σ T_j² (sample sd with
+    denominator k−1, as R's sd)."""
+    eta_hat = st / k
+    var_t = jnp.maximum((st2 - k * eta_hat * eta_hat) / max(k - 1, 1), 0.0)
+    return eta_hat, jnp.sqrt(var_t)
+
+
 def _ni_stream(key_x: jax.Array, key_y: jax.Array, chunk_fn: ChunkFn,
                tx: Callable, ty: Callable, m: int, k: int,
                scale_x, scale_y, n_chunk: int):
-    """Streamed batch pipeline (vert-cor.R:131-153 / ver-cor-subG.R:40-52):
-    per chunk, kc = n_chunk/m batch means of the transformed columns, plus
-    the sliced batch noise; accumulate Σ T_j and Σ T_j².
-
-    Returns (η̂, sd(T_j)). Noise is one materialized-shape ``(k,)`` draw per
-    side (zero-padded to the chunk grid), so results match the materialized
-    estimators exactly on identical data.
-    """
+    """Streamed batch pipeline; returns (η̂, sd(T_j)). Composed from the
+    shared pieces above so it stays bit-identical to the fused pair."""
     kc = n_chunk // m
     n_chunks = -(-k // kc)
-    pad = n_chunks * kc - k
-    lap_x = jnp.pad(laplace(key_x, (k,), scale_x), (0, pad))
-    lap_y = jnp.pad(laplace(key_y, (k,), scale_y), (0, pad))
+    lap_x, lap_y = _ni_batch_noise(key_x, key_y, k, scale_x, scale_y,
+                                   n_chunks * kc)
 
     def chunk_stats(c):
-        xy = chunk_fn(c)
-        xb = tx(xy[:, 0]).reshape(kc, m).mean(axis=1)
-        yb = ty(xy[:, 1]).reshape(kc, m).mean(axis=1)
-        b0 = c * kc
-        xt = xb + jax.lax.dynamic_slice(lap_x, (b0,), (kc,))
-        yt = yb + jax.lax.dynamic_slice(lap_y, (b0,), (kc,))
-        t = jnp.where(b0 + jnp.arange(kc) < k, m * xt * yt, 0.0)
-        return jnp.sum(t), jnp.sum(t * t)
+        return _ni_chunk_stats(chunk_fn(c), c, tx, ty, m, kc, k,
+                               lap_x, lap_y)
 
     st_c, st2_c = jax.lax.map(chunk_stats, jnp.arange(n_chunks))
-    st, st2 = jnp.sum(st_c), jnp.sum(st2_c)
-    eta_hat = st / k
-    # sample sd via sufficient statistics (denominator k−1, as R's sd)
-    var_t = jnp.maximum((st2 - k * eta_hat * eta_hat) / max(k - 1, 1), 0.0)
-    return eta_hat, jnp.sqrt(var_t)
+    return _ni_from_sums(jnp.sum(st_c), jnp.sum(st2_c), k)
+
+
+def _ni_subg_interval(eta_hat, s_t, k: int, m: int, lam1, lam2,
+                      alpha: float) -> CorrResult:
+    """NI subG normal CI tail (ver-cor-subG.R:51-59): no sine link,
+    ρ-space clamp; shared by the separate and fused kernels."""
+    rho_hat = eta_hat
+    se = s_t / jnp.sqrt(float(k))
+    crit = ndtri(1.0 - alpha / 2.0)
+    lo = jnp.maximum(rho_hat - crit * se, -1.0)
+    hi = jnp.minimum(rho_hat + crit * se, 1.0)
+    aux = {"k": k, "m": m, "lambda_x": lam1, "lambda_y": lam2}
+    return CorrResult(rho_hat, lo, hi, aux)
 
 
 def ci_ni_signbatch_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
@@ -231,13 +260,7 @@ def correlation_ni_subg_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
         stream(key, "ni_subg/lap_x"), stream(key, "ni_subg/lap_y"),
         chunk_fn, lambda v: clip_sym(v, lam1), lambda v: clip_sym(v, lam2),
         m, k, 2.0 * lam1 / (m * eps1), 2.0 * lam2 / (m * eps2), n_chunk)
-    rho_hat = eta_hat  # no sine link (ver-cor-subG.R:51-52)
-    se = s_t / jnp.sqrt(float(k))
-    crit = ndtri(1.0 - alpha / 2.0)
-    lo = jnp.maximum(rho_hat - crit * se, -1.0)  # ρ-space clamp (:58-59)
-    hi = jnp.minimum(rho_hat + crit * se, 1.0)
-    aux = {"k": k, "m": m, "lambda_x": lam1, "lambda_y": lam2}
-    return CorrResult(rho_hat, lo, hi, aux)
+    return _ni_subg_interval(eta_hat, s_t, k, m, lam1, lam2, alpha)
 
 
 # ----------------------------------------------------------- INT sign ----
@@ -281,37 +304,38 @@ def ci_int_signflip_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
                                       mode, mixquant_mode)
 
 
-# ----------------------------------------------------------- INT subG ----
-def ci_int_subg_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
-                       eps1: float, eps2: float,
-                       eta1: float = 1.0, eta2: float = 1.0,
-                       alpha: float = 0.05, mixquant_mode: str = "det",
-                       n_chunk: int = 65536) -> CorrResult:
-    """Streaming INT clipped (grid variant) ≡ ``ci_int_subg(variant="grid")``
-    (ver-cor-subG.R:67-108): Σ Uc, Σ Uc² accumulated per chunk; per-sample
-    sender noise from per-chunk folded keys; one central draw at the
-    materialized key address."""
-    sender_is_x = eps1 >= eps2  # ver-cor-subG.R:76-81
+# -------------------------------------------------- INT subG pieces ----
+def _int_subg_roles(n: int, eps1, eps2, eta1, eta2):
+    """Sender selection + λ pair (ver-cor-subG.R:76-81, lambda_INT_n) —
+    shared by the separate and fused kernels."""
+    sender_is_x = eps1 >= eps2
     eps_s, eps_r = (eps1, eps2) if sender_is_x else (eps2, eps1)
     eta_s, eta_r = (eta1, eta2) if sender_is_x else (eta2, eta1)
     lam_s, lam_r = lambda_int_n(n, eta_s=eta_s, eta_r=eta_r, eps_s=eps_s)
+    return sender_is_x, eps_s, eps_r, lam_s, lam_r
 
-    noise_base = stream(key, "int_subg/lap_sender")
-    n_chunks = -(-n // n_chunk)
 
-    def chunk_stats(c):
-        xy = chunk_fn(c)
-        xs = xy[:, 0] if sender_is_x else xy[:, 1]
-        xo = xy[:, 1] if sender_is_x else xy[:, 0]  # v1: other NOT clipped
-        noise = laplace(jax.random.fold_in(noise_base, c), (n_chunk,),
-                        2.0 * lam_s / eps_s)
-        uc = clip_sym((clip_sym(xs, lam_s) + noise) * xo, lam_r)
-        w = (c * n_chunk + jnp.arange(n_chunk)) < n
-        uc = jnp.where(w, uc, 0.0)
-        return jnp.sum(uc), jnp.sum(uc * uc)
+def _int_subg_chunk_stats(xy, c, noise_base, sender_is_x: bool, lam_s,
+                          lam_r, eps_s, n: int, n_chunk: int):
+    """One chunk's INT contribution (ver-cor-subG.R:87-97): per-sample
+    sender noise from the per-chunk folded key, clipped products, rows
+    past n masked to 0."""
+    xs = xy[:, 0] if sender_is_x else xy[:, 1]
+    xo = xy[:, 1] if sender_is_x else xy[:, 0]  # v1: other NOT clipped
+    noise = laplace(jax.random.fold_in(noise_base, c), (n_chunk,),
+                    2.0 * lam_s / eps_s)
+    uc = clip_sym((clip_sym(xs, lam_s) + noise) * xo, lam_r)
+    w = (c * n_chunk + jnp.arange(n_chunk)) < n
+    uc = jnp.where(w, uc, 0.0)
+    return jnp.sum(uc), jnp.sum(uc * uc)
 
-    s1c, s2c = jax.lax.map(chunk_stats, jnp.arange(n_chunks))
-    s1, s2 = jnp.sum(s1c), jnp.sum(s2c)
+
+def _int_subg_interval(key: jax.Array, s1, s2, n: int, eps_s, eps_r,
+                       lam_s, lam_r, alpha: float,
+                       mixquant_mode: str) -> CorrResult:
+    """INT subG estimate + grid-variant CI tail from the accumulated
+    Σ Uc, Σ Uc² (ver-cor-subG.R:95-104); the central draw and the CI keep
+    their materialized key addresses."""
     mean_uc = s1 / n
     central_scale = 2.0 * lam_r / (n * eps_r)
     rho_hat = mean_uc + laplace(stream(key, "int_subg/lap_recv"), (),
@@ -322,3 +346,93 @@ def ci_int_subg_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
     return int_subg.grid_interval(key, rho_hat, jnp.sqrt(var_uc), n, eps_r,
                                   central_scale, alpha,
                                   mixquant_mode)._replace(aux=aux)
+
+
+# ------------------------------------------------- fused subG pair ----
+def subg_pair_stream(key_ni: jax.Array, key_int: jax.Array,
+                     chunk_fn: ChunkFn, n: int,
+                     eps1: float, eps2: float,
+                     eta1: float = 1.0, eta2: float = 1.0,
+                     alpha: float = 0.05, mixquant_mode: str = "det",
+                     n_chunk: int = 65536):
+    """Both subG estimators in ONE pass over the chunks.
+
+    The separate streaming kernels each re-generate the full n-row sample
+    from ``chunk_fn`` — at the stress shape (n=10⁶, BASELINE.md config 5)
+    that doubles the dominant PRNG/DGP work per replication. This fused
+    pass generates each chunk once and accumulates the NI batch sums
+    (Σ T_j, Σ T_j²) and the INT product sums (Σ Uc, Σ Uc²) side by side.
+
+    Bit-identity contract: every noise draw keeps the *same key address
+    and call shape* as in :func:`correlation_ni_subg_stream` /
+    :func:`ci_int_subg_stream` (which themselves match the materialized
+    estimators), and per-chunk accumulation order is unchanged, so the
+    returned pair is bit-identical to calling the two separate streaming
+    kernels — pinned by ``tests/test_streaming.py``.
+
+    Returns ``(CorrResult_ni, CorrResult_int)``.
+    """
+    m, k = batch_geometry(n, eps1, eps2)
+    if n_chunk % m:
+        raise ValueError(
+            f"n_chunk={n_chunk} must be a multiple of the batch size m={m} "
+            f"(use choose_n_chunk(n, m, target))")
+    # NI setup (as correlation_ni_subg_stream). The INT side needs
+    # ceil(n/n_chunk) chunks; the NI side only ceil(k/kc) ≤ that (k·m ≤ n
+    # and kc = n_chunk/m) — so the fused loop runs the larger count and
+    # NI's mask zeroes the extra chunks' contributions exactly. The noise
+    # arrays are padded to the larger grid so the slices stay in bounds.
+    lam1 = lambda_n(n, eta1)
+    lam2 = lambda_n(n, eta2)
+    kc = n_chunk // m
+    n_chunks = -(-n // n_chunk)
+    lap_x, lap_y = _ni_batch_noise(
+        stream(key_ni, "ni_subg/lap_x"), stream(key_ni, "ni_subg/lap_y"),
+        k, 2.0 * lam1 / (m * eps1), 2.0 * lam2 / (m * eps2), n_chunks * kc)
+    tx = lambda v: clip_sym(v, lam1)
+    ty = lambda v: clip_sym(v, lam2)
+    # INT setup (as ci_int_subg_stream)
+    sender_is_x, eps_s, eps_r, lam_s, lam_r = _int_subg_roles(
+        n, eps1, eps2, eta1, eta2)
+    noise_base = stream(key_int, "int_subg/lap_sender")
+
+    def chunk_stats(c):
+        xy = chunk_fn(c)  # generated ONCE for both estimators
+        ni_t = _ni_chunk_stats(xy, c, tx, ty, m, kc, k, lap_x, lap_y)
+        int_u = _int_subg_chunk_stats(xy, c, noise_base, sender_is_x,
+                                      lam_s, lam_r, eps_s, n, n_chunk)
+        return ni_t + int_u
+
+    st_c, st2_c, s1c, s2c = jax.lax.map(chunk_stats, jnp.arange(n_chunks))
+
+    eta_hat, s_t = _ni_from_sums(jnp.sum(st_c), jnp.sum(st2_c), k)
+    ni = _ni_subg_interval(eta_hat, s_t, k, m, lam1, lam2, alpha)
+    it = _int_subg_interval(key_int, jnp.sum(s1c), jnp.sum(s2c), n, eps_s,
+                            eps_r, lam_s, lam_r, alpha, mixquant_mode)
+    return ni, it
+
+
+# ----------------------------------------------------------- INT subG ----
+def ci_int_subg_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
+                       eps1: float, eps2: float,
+                       eta1: float = 1.0, eta2: float = 1.0,
+                       alpha: float = 0.05, mixquant_mode: str = "det",
+                       n_chunk: int = 65536) -> CorrResult:
+    """Streaming INT clipped (grid variant) ≡ ``ci_int_subg(variant="grid")``
+    (ver-cor-subG.R:67-108): Σ Uc, Σ Uc² accumulated per chunk; per-sample
+    sender noise from per-chunk folded keys; one central draw at the
+    materialized key address. Composed from the same pieces as the fused
+    pair so the two stay bit-identical."""
+    sender_is_x, eps_s, eps_r, lam_s, lam_r = _int_subg_roles(
+        n, eps1, eps2, eta1, eta2)
+    noise_base = stream(key, "int_subg/lap_sender")
+    n_chunks = -(-n // n_chunk)
+
+    def chunk_stats(c):
+        return _int_subg_chunk_stats(chunk_fn(c), c, noise_base,
+                                     sender_is_x, lam_s, lam_r, eps_s,
+                                     n, n_chunk)
+
+    s1c, s2c = jax.lax.map(chunk_stats, jnp.arange(n_chunks))
+    return _int_subg_interval(key, jnp.sum(s1c), jnp.sum(s2c), n, eps_s,
+                              eps_r, lam_s, lam_r, alpha, mixquant_mode)

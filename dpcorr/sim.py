@@ -172,13 +172,14 @@ def _one_rep_streaming(key: jax.Array, rho: jax.Array, cfg: SimConfig):
     chunk_fn = st.dgp_chunk_fn(cfg.dgp_fn(), rng.stream(key, "dgp"),
                                n_chunk, rho)
     if cfg.use_subg:
-        ni = st.correlation_ni_subg_stream(
-            rng.stream(key, "ni"), chunk_fn, cfg.n, cfg.eps1, cfg.eps2,
-            eta1=cfg.eta1, eta2=cfg.eta2, alpha=cfg.alpha, n_chunk=n_chunk)
-        it = st.ci_int_subg_stream(
-            rng.stream(key, "int"), chunk_fn, cfg.n, cfg.eps1, cfg.eps2,
-            eta1=cfg.eta1, eta2=cfg.eta2, alpha=cfg.alpha,
-            mixquant_mode=cfg.mixquant_mode, n_chunk=n_chunk)
+        # one fused pass: the chunk is generated once for both estimators
+        # (bit-identical to the separate kernels — same key addresses);
+        # halves the dominant DGP/PRNG work at stress scale (config 5)
+        ni, it = st.subg_pair_stream(
+            rng.stream(key, "ni"), rng.stream(key, "int"), chunk_fn,
+            cfg.n, cfg.eps1, cfg.eps2, eta1=cfg.eta1, eta2=cfg.eta2,
+            alpha=cfg.alpha, mixquant_mode=cfg.mixquant_mode,
+            n_chunk=n_chunk)
     else:
         # pass A depends only on the data — compute once, share across both
         # estimators (each still draws its own standardization noise)

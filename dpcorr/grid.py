@@ -438,8 +438,7 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
         detail_all = _assemble_details(design, by_i, gcfg.b)
         summ_all = summarize_grid(detail_all)
         if out_dir:
-            detail_all.to_parquet(out_dir / "detail_all.parquet")
-            summ_all.to_parquet(out_dir / "summ_all.parquet")
+            _persist_tables(out_dir, detail_all, summ_all)
         return GridResult(detail_all, summ_all, pd.DataFrame(timings))
 
     details, timings, failures = [], [], []
@@ -483,9 +482,22 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
     detail_all = pd.concat(details, ignore_index=True)
     summ_all = summarize_grid(detail_all)
     if out_dir:
-        detail_all.to_parquet(out_dir / "detail_all.parquet")
-        summ_all.to_parquet(out_dir / "summ_all.parquet")
+        _persist_tables(out_dir, detail_all, summ_all)
     return GridResult(detail_all, summ_all, pd.DataFrame(timings))
+
+
+def _persist_tables(out_dir: Path, detail_all: pd.DataFrame,
+                    summ_all: pd.DataFrame) -> None:
+    """Persist the merged tables: parquet for the Python world, plus the
+    reference's own artifact shape — ``detail_all.rds``, a data.frame R's
+    ``readRDS`` consumes directly (``saveRDS(detail_all,
+    "sim_detail_all.rds")``, vert-cor.R:569) — so R-side consumers need
+    neither reticulate nor parquet bindings."""
+    from dpcorr.io.rds_write import write_rds_frame
+
+    detail_all.to_parquet(out_dir / "detail_all.parquet")
+    summ_all.to_parquet(out_dir / "summ_all.parquet")
+    write_rds_frame(str(out_dir / "detail_all.rds"), detail_all)
 
 
 def summarize_grid(detail_all: pd.DataFrame) -> pd.DataFrame:

@@ -1,0 +1,222 @@
+"""Pure-Python writer for R serialization format (RDS), XDR flavor.
+
+The reference persists its replicate tables with ``saveRDS(detail_all,
+"sim_detail_all.rds")`` (vert-cor.R:569, ver-cor-subG.R:314) and its
+downstream lives in R. The grid driver here writes parquet for the Python
+world; this module closes the R-facing half of the checkpoint contract
+(SURVEY.md §5 checkpoint/resume): ``write_rds_table`` emits a
+``data.frame`` .rds that R's ``readRDS`` consumes directly — no reticulate
+needed to hand results back to the reference's own data.table/ggplot code.
+
+Scope: version-3 XDR streams of one data.frame with double / integer /
+logical / string columns (exactly what the replicate tables contain —
+the write-side mirror of the subset ``rds_py`` reads). Round-trip
+validation runs against this repo's two independent readers (pure-Python
+and the native C++ one), both of which were validated against real
+R-produced files (the HRS panel).
+
+Layout notes (mirrors ``rds_py``'s grammar, R serialize.c):
+- item flags word: bits 0-7 SEXP type, 0x100 object bit (class set),
+  0x200 has-attributes, 0x400 has-tag; CHARSXP encoding rides the
+  levels field (``ASCII << 12`` / ``UTF8 << 12``).
+- attributes are a tagged pairlist terminated by NILVALUE (254);
+  symbols are emitted inline (legal — the reference table is an
+  optimization, not a requirement).
+- row.names uses R's compact internal form ``c(NA_integer_, -n)``.
+"""
+
+from __future__ import annotations
+
+import gzip
+import struct
+from typing import Any, Mapping
+
+import numpy as np
+
+from dpcorr.io.rds_py import (
+    CHARSXP,
+    INTSXP,
+    LGLSXP,
+    LISTSXP,
+    NILVALUE_SXP,
+    R_NA_INT,
+    REALSXP,
+    STRSXP,
+    SYMSXP,
+    VECSXP,
+)
+
+_HAS_ATTR = 0x200
+_HAS_TAG = 0x400
+_IS_OBJECT = 0x100
+_ASCII_MASK = 64  # CHARSXP gp levels bit
+_UTF8_MASK = 8
+
+
+def _is_na(v) -> bool:
+    """None, float NaN, or a pandas NA scalar (whose truthiness raises)."""
+    if v is None:
+        return True
+    try:
+        return bool(v != v)
+    except Exception:  # pd.NA: `v != v` is NA and bool(NA) raises
+        return True
+
+
+class _Writer:
+    def __init__(self) -> None:
+        self.parts: list[bytes] = []
+
+    def raw(self, b: bytes) -> None:
+        self.parts.append(b)
+
+    def i32(self, v: int) -> None:
+        self.raw(struct.pack(">i", v))
+
+    def flags(self, ptype: int, *, levels: int = 0, is_object: bool = False,
+              has_attr: bool = False, has_tag: bool = False) -> None:
+        self.i32(ptype | (levels << 12)
+                 | (_IS_OBJECT if is_object else 0)
+                 | (_HAS_ATTR if has_attr else 0)
+                 | (_HAS_TAG if has_tag else 0))
+
+    # ---- header ----
+    def header(self) -> None:
+        self.raw(b"X\n")
+        self.i32(3)        # serialization version 3
+        self.i32(0x040301)  # writer "R 4.3.1"
+        self.i32(0x030500)  # minimal reader R 3.5.0
+        enc = b"UTF-8"
+        self.i32(len(enc))
+        self.raw(enc)
+
+    # ---- leaf items ----
+    def charsxp(self, s: str | None) -> None:
+        if s is None:  # NA_character_
+            self.flags(CHARSXP, levels=_ASCII_MASK)
+            self.i32(-1)
+            return
+        b = s.encode("utf-8")
+        self.flags(CHARSXP,
+                   levels=_ASCII_MASK if s.isascii() else _UTF8_MASK)
+        self.i32(len(b))
+        self.raw(b)
+
+    def strsxp(self, values: list) -> None:
+        self.flags(STRSXP)
+        self.i32(len(values))
+        for v in values:
+            self.charsxp(None if v is None else str(v))
+
+    def symbol(self, name: str) -> None:
+        self.flags(SYMSXP)
+        self.charsxp(name)
+
+    def realsxp(self, arr: np.ndarray) -> None:
+        self.flags(REALSXP)
+        self.i32(arr.size)
+        self.raw(np.ascontiguousarray(arr, dtype=">f8").tobytes())
+
+    def intsxp(self, arr: np.ndarray, ptype: int = INTSXP) -> None:
+        self.flags(ptype)
+        self.i32(arr.size)
+        self.raw(np.ascontiguousarray(arr, dtype=">i4").tobytes())
+
+    # ---- the data.frame ----
+    def data_frame(self, columns: Mapping[str, Any], n_rows: int) -> None:
+        self.flags(VECSXP, is_object=True, has_attr=True)
+        self.i32(len(columns))
+        for values in columns.values():
+            self._column(values)
+        # attributes pairlist: names, row.names (compact), class
+        self.flags(LISTSXP, has_tag=True)
+        self.symbol("names")
+        self.strsxp(list(columns.keys()))
+        self.flags(LISTSXP, has_tag=True)
+        self.symbol("row.names")
+        self.intsxp(np.asarray([R_NA_INT, -n_rows], dtype=np.int64))
+        self.flags(LISTSXP, has_tag=True)
+        self.symbol("class")
+        self.strsxp(["data.frame"])
+        self.i32(NILVALUE_SXP)  # end of pairlist
+
+    def _column(self, values: Any) -> None:
+        arr = values if isinstance(values, np.ndarray) else np.asarray(values)
+        if arr.dtype.kind in "OU":
+            vals = list(arr)
+            na = [_is_na(v) for v in vals]
+            live = [v for v, m in zip(vals, na) if not m]
+            if all(isinstance(v, str) for v in live):
+                self.strsxp([None if m else str(v)
+                             for v, m in zip(vals, na)])
+            elif all(isinstance(v, (bool, np.bool_)) for v in live):
+                # e.g. a pandas nullable-boolean column via to_numpy()
+                self.intsxp(np.asarray(
+                    [R_NA_INT if m else int(bool(v))
+                     for v, m in zip(vals, na)], dtype=np.int64),
+                    ptype=LGLSXP)
+            else:
+                # object-dtype numerics (pandas nullable Int64, plain
+                # number lists): coerce numerically — NEVER silently
+                # stringify; a non-numeric mix raises instead
+                try:
+                    arr_f = np.asarray([np.nan if m else float(v)
+                                        for v, m in zip(vals, na)],
+                                       dtype=np.float64)
+                except (TypeError, ValueError) as e:
+                    raise TypeError(
+                        "column mixes non-numeric, non-string values "
+                        f"({e})") from e
+                self.realsxp(arr_f)
+            return
+        if arr.dtype.kind == "b":
+            self.intsxp(arr.astype(np.int64), ptype=LGLSXP)
+        elif arr.dtype.kind in "iu":
+            if arr.size and (arr.max(initial=0) > 2**31 - 1
+                             or arr.min(initial=0) <= -(2**31)):
+                self.realsxp(arr.astype(np.float64))  # R ints are 32-bit
+            else:
+                self.intsxp(arr.astype(np.int64))
+        elif arr.dtype.kind == "f":
+            self.realsxp(arr.astype(np.float64))
+        else:
+            raise TypeError(f"unsupported column dtype {arr.dtype}")
+
+
+def write_rds_table(path: str, columns: Mapping[str, Any],
+                    compress: bool = True) -> None:
+    """Write ``{name: values}`` as a data.frame .rds (``saveRDS``-shaped:
+    version-3 XDR, gzip by default, matching R's default compress="gzip").
+
+    Columns: float arrays → REALSXP (NaN kept — R reads it as NaN),
+    int arrays → INTSXP (64-bit values that overflow R's 32-bit ints are
+    promoted to doubles, as R itself would store them), bool → LGLSXP,
+    all-string object sequences → STRSXP with None/NaN/pd.NA as
+    NA_character_. Object-dtype numerics (plain number lists, pandas
+    nullable Int64/boolean via ``to_numpy()``) coerce to REALSXP/LGLSXP
+    with missing → NA — never silently to strings; a non-numeric,
+    non-string mix raises. All columns must share one length.
+    """
+    sizes = {len(v) if isinstance(v, (list, tuple)) else np.asarray(v).size
+             for v in columns.values()}
+    if len(sizes) > 1:
+        raise ValueError(f"ragged columns: lengths {sorted(sizes)}")
+    n_rows = sizes.pop() if sizes else 0
+    w = _Writer()
+    w.header()
+    w.data_frame(columns, n_rows)
+    blob = b"".join(w.parts)
+    if compress:
+        # mtime=0 → deterministic bytes for identical tables
+        blob = gzip.compress(blob, mtime=0)
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+def write_rds_frame(path: str, df, compress: bool = True) -> None:
+    """``write_rds_table`` for a pandas DataFrame (the grid's
+    ``detail_all`` shape — the reference's ``saveRDS(detail_all, ...)``
+    call, vert-cor.R:569)."""
+    write_rds_table(path,
+                    {str(c): df[c].to_numpy() for c in df.columns},
+                    compress=compress)

@@ -301,3 +301,108 @@ def test_hrs_wave2_complete_cases(hrs_cols):
     # sanity: plausible human ranges on complete cases
     assert 20 < np.nanmean(age[ok]) < 110
     assert 10 < np.nanmean(bmi[ok]) < 60
+
+
+# ---------------------------------------------------------------- writer ----
+class TestRdsWriter:
+    """write_rds_table round-trips through BOTH independent readers (the
+    pure-Python parser and, when buildable, the native C++ one) — the
+    write-side mirror of the saveRDS contract (vert-cor.R:569)."""
+
+    def _table(self):
+        return {
+            "repl": np.arange(1, 6, dtype=np.int64),
+            "ni_hat": np.asarray([0.1, -0.2, np.nan, 0.4, 0.5]),
+            "ni_cover": np.asarray([True, False, True, True, False]),
+            "method": ["NI", "NI", None, "INT", "INT"],
+            "big": np.asarray([2**40, 0, 1, -2**40, 7], dtype=np.int64),
+        }
+
+    def _check(self, cols):
+        np.testing.assert_array_equal(cols["repl"].values,
+                                      [1.0, 2.0, 3.0, 4.0, 5.0])
+        got = cols["ni_hat"].values
+        np.testing.assert_allclose(got[[0, 1, 3, 4]], [0.1, -0.2, 0.4, 0.5])
+        assert np.isnan(got[2])
+        np.testing.assert_array_equal(cols["ni_cover"].values,
+                                      [1.0, 0.0, 1.0, 1.0, 0.0])
+        assert cols["method"].values == ["NI", "NI", None, "INT", "INT"]
+        # 64-bit ints overflow R's 32-bit INTSXP -> promoted to doubles
+        assert cols["big"].kind == "double"
+        np.testing.assert_array_equal(cols["big"].values,
+                                      [2.0**40, 0.0, 1.0, -(2.0**40), 7.0])
+
+    @pytest.mark.parametrize("compress", [True, False])
+    def test_roundtrip_python_reader(self, tmp_path, compress):
+        from dpcorr.io.rds_write import write_rds_table
+
+        p = str(tmp_path / "t.rds")
+        write_rds_table(p, self._table(), compress=compress)
+        self._check(rds_py.read_rds_table(p))
+
+    def test_roundtrip_native_reader(self, tmp_path):
+        from dpcorr.io import rds as rds_front
+        from dpcorr.io.rds_write import write_rds_table
+
+        if rds_front._ensure_native() is None:
+            pytest.skip("native reader not buildable here")
+        p = str(tmp_path / "t.rds")
+        write_rds_table(p, self._table())
+        self._check(rds_front.read_rds_table(p))
+
+    def test_deterministic_bytes(self, tmp_path):
+        from dpcorr.io.rds_write import write_rds_table
+
+        a, b = str(tmp_path / "a.rds"), str(tmp_path / "b.rds")
+        write_rds_table(a, self._table())
+        write_rds_table(b, self._table())
+        assert open(a, "rb").read() == open(b, "rb").read()
+
+    def test_object_numerics_never_stringify(self, tmp_path):
+        """Plain number lists and pandas nullable columns must round-trip
+        numerically (review finding): strings only for actual strings."""
+        import pandas as pd
+
+        from dpcorr.io.rds_write import write_rds_table
+
+        p = str(tmp_path / "o.rds")
+        write_rds_table(p, {
+            "ints": [1, 2, 3],
+            "nullable_i": pd.array([1, None, 3], dtype="Int64").to_numpy(),
+            "nullable_b": pd.array([True, None, False],
+                                   dtype="boolean").to_numpy(),
+        })
+        cols = rds_py.read_rds_table(p)
+        assert cols["ints"].kind in ("integer", "double")
+        np.testing.assert_array_equal(cols["ints"].values, [1.0, 2.0, 3.0])
+        assert cols["nullable_i"].kind == "double"
+        v = cols["nullable_i"].values
+        assert v[0] == 1.0 and np.isnan(v[1]) and v[2] == 3.0
+        assert cols["nullable_b"].kind == "logical"
+        b = cols["nullable_b"].values
+        assert b[0] == 1.0 and np.isnan(b[1]) and b[2] == 0.0
+        with pytest.raises(TypeError):
+            write_rds_table(str(tmp_path / "bad.rds"),
+                            {"mix": ["a", object()]})
+
+    def test_ragged_raises(self, tmp_path):
+        from dpcorr.io.rds_write import write_rds_table
+
+        with pytest.raises(ValueError, match="ragged"):
+            write_rds_table(str(tmp_path / "r.rds"),
+                            {"a": np.arange(3), "b": np.arange(4)})
+
+    def test_grid_out_dir_writes_rds(self, tmp_path):
+        """run_grid(out_dir=...) persists detail_all.rds alongside parquet
+        and it reads back equal to the in-memory frame."""
+        from dpcorr.grid import GridConfig, run_grid
+
+        res = run_grid(GridConfig(n_grid=(200,), rho_grid=(0.0, 0.5),
+                                  eps_pairs=((1.0, 1.0),), b=4,
+                                  backend="bucketed",
+                                  out_dir=str(tmp_path / "g")))
+        cols = rds_py.read_rds_table(str(tmp_path / "g" / "detail_all.rds"))
+        assert list(cols) == list(res.detail_all.columns)
+        np.testing.assert_allclose(cols["ni_hat"].values,
+                                   res.detail_all["ni_hat"].to_numpy(),
+                                   rtol=0, atol=0)

@@ -71,7 +71,11 @@ def _run_grid(args, gcfg, fig1_n, fig1_eps, family="v1"):
         from dpcorr.parallel import run_grid_multihost
 
         res = run_grid_multihost(gcfg, n_hosts=args.n_hosts,
-                                 platform=args.platform)
+                                 platform=args.platform,
+                                 distributed=getattr(args, "distributed",
+                                                     False),
+                                 local_device_count=getattr(
+                                     args, "local_devices", None))
     else:
         res = run_grid(gcfg)
     dt = time.perf_counter() - t0
@@ -201,6 +205,16 @@ def main(argv=None):
                            help="fan the grid out over this many worker "
                                 "processes (needs --out; see "
                                 "dpcorr.parallel.multihost)")
+            p.add_argument("--distributed", action="store_true",
+                           help="with --n-hosts: run the workers as a real "
+                                "jax.distributed cluster (SPMD slicing "
+                                "from process_index/count, global barrier, "
+                                "rank-0 merge)")
+            p.add_argument("--local-devices", dest="local_devices",
+                           type=int, default=None,
+                           help="with --distributed: virtual CPU devices "
+                                "each worker contributes (local cluster "
+                                "testing)")
             p.add_argument("--fused", default="off",
                            choices=["off", "auto", "all"],
                            help="run eligible (n, eps) buckets through the "

@@ -65,3 +65,16 @@ class Throughput:
     @property
     def reps_per_sec_chip(self) -> float:
         return self.reps_per_sec / max(self.n_devices, 1)
+
+    def utilization(self, flops_per_rep: float, bytes_per_rep: float,
+                    platform: str | None = None) -> dict:
+        """%-of-peak view of the measured throughput: combine a per-rep
+        work model (from ``roofline.analytic_rep_model`` or
+        ``roofline.xla_cost``) with reps/sec/chip against the platform's
+        chip ceilings. See docs/PERFORMANCE.md "MFU / roofline"."""
+        from dpcorr.utils.roofline import peaks_for, summarize
+
+        if platform is None:
+            platform = jax.devices()[0].platform
+        return summarize(self.reps_per_sec_chip, flops_per_rep,
+                         bytes_per_rep, peaks_for(platform))

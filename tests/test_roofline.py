@@ -1,0 +1,60 @@
+"""Roofline/MFU accounting units (docs/PERFORMANCE.md "MFU / roofline")."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dpcorr.utils.profiling import Throughput
+from dpcorr.utils.roofline import (CPU_CORE, TPU_V5E, analytic_rep_model,
+                                   peaks_for, summarize, xla_cost)
+
+
+def test_analytic_model_scales_with_n():
+    a = analytic_rep_model(10_000, 1.0, 1.0)
+    b = analytic_rep_model(20_000, 1.0, 1.0)
+    assert b["flops_per_rep"] == 2 * a["flops_per_rep"]
+    assert b["bytes_per_rep_floor"] == 2 * a["bytes_per_rep_floor"]
+    # batch geometry matches the estimators' (m = ceil(8/(e1 e2)) cap n)
+    assert a["batch_geometry"] == {"m": 8, "k": 1250}
+
+
+def test_summarize_math_and_bound():
+    s = summarize(1e6, 2e6, 1.6e5, TPU_V5E)
+    np.testing.assert_allclose(s["achieved_flops_per_sec"], 2e12)
+    np.testing.assert_allclose(s["achieved_bytes_per_sec"], 1.6e11)
+    assert s["bound"] == "vpu"  # 2e12/3.9e12 > 1.6e11/8.19e11
+    assert 0 < s["pct_of_vpu_peak"] < 100
+    hbm_bound = summarize(1e6, 1e3, 1e6, TPU_V5E)
+    assert hbm_bound["bound"] == "hbm"
+
+
+def test_peaks_for_platforms():
+    assert peaks_for("tpu") is TPU_V5E
+    assert peaks_for("axon") is TPU_V5E
+    assert peaks_for("cpu") is CPU_CORE
+
+
+def test_xla_cost_counts_a_known_matmul():
+    """cost_analysis of an (m,k)@(k,n) matmul must report ~2mkn flops."""
+    m = k = n = 256
+
+    def f(a, b):
+        return a @ b
+
+    a = jnp.ones((m, k), jnp.float32)
+    b = jnp.ones((k, n), jnp.float32)
+    cost = xla_cost(jax.jit(f), a, b)
+    assert cost["flops"] >= 2 * m * k * n * 0.9
+    assert cost["bytes"] >= (m * k + k * n + m * n) * 4 * 0.9
+
+
+def test_throughput_utilization_wiring():
+    tp = Throughput(n_devices=2)
+    tp.add(2000)
+    tp.seconds = 1.0
+    u = tp.utilization(1e6, 1e5, platform="cpu")
+    np.testing.assert_allclose(u["reps_per_sec"], 1000.0)  # per chip
+    np.testing.assert_allclose(u["achieved_flops_per_sec"], 1e9)
+    assert u["peaks"]["name"] == "cpu-core"

@@ -39,4 +39,5 @@ from dpcorr.models.estimators.streaming import (  # noqa: F401
     ci_ni_signbatch_stream,
     correlation_ni_subg_stream,
     dgp_chunk_fn,
+    subg_pair_stream,
 )

@@ -1,0 +1,62 @@
+#!/bin/bash
+# Harvest the r03 TPU queue outputs (/tmp/tpu_r03) into checked-in
+# artifacts. Run after `tpu_r03_queue.sh` reports steps OK. Idempotent;
+# prints what it found and what it wrote. Commit separately after review.
+
+set -u
+cd "$(dirname "$0")/.."
+IN=/tmp/tpu_r03
+OUT=benchmarks/results
+
+copy_json() {  # copy_json <src> <dst> <must-contain>
+  local src=$1 dst=$2 needle=$3
+  if [ -s "$src" ] && grep -q "$needle" "$src"; then
+    cp "$src" "$dst"
+    echo "wrote $dst"
+  else
+    echo "SKIP $dst ($src missing or lacks '$needle')"
+  fi
+}
+
+echo "== headline =="
+# bench_default.json is the full driver-shaped line; keep it verbatim as
+# the round's recorded hardware evidence
+copy_json "$IN/bench_default.json" "$OUT/r03_tpu_headline.json" reps_per_sec
+
+echo "== gauss A/B =="
+for f in pallas_boxmuller pallas_ndtri; do
+  copy_json "$IN/$f.json" "$OUT/r03_$f.json" reps_per_sec
+done
+if [ -s "$OUT/r03_pallas_boxmuller.json" ] && [ -s "$OUT/r03_pallas_ndtri.json" ]; then
+  python - <<'EOF'
+import json
+bm = json.load(open("benchmarks/results/r03_pallas_boxmuller.json"))
+nd = json.load(open("benchmarks/results/r03_pallas_ndtri.json"))
+b, n = bm["value"], nd["value"]
+print(f"gauss A/B: boxmuller {b:.0f} vs ndtri {n:.0f} reps/sec "
+      f"-> {'NDTRI WINS: flip the kernel default' if n > 1.02*b else 'keep boxmuller'}")
+EOF
+fi
+
+echo "== config5 / suite =="
+# the queue already tees these into benchmarks/results/ — just verify
+for f in r03_tpu_config5.jsonl r03_tpu_suite.jsonl; do
+  if [ -s "$OUT/$f" ]; then echo "present: $OUT/$f ($(wc -l < "$OUT/$f") lines)"
+  else echo "MISSING: $OUT/$f"; fi
+done
+
+echo "== roofline =="
+if [ -s "$OUT/r03_roofline.json" ]; then
+  python -c "import json; d=json.load(open('$OUT/r03_roofline.json')); print('roofline:', d['summary'])"
+else
+  echo "MISSING: $OUT/r03_roofline.json"
+fi
+if [ -d "$OUT/trace_r03" ]; then
+  du -sh "$OUT/trace_r03"
+  echo "note: review trace size before committing (trim to the .trace/.json summary if huge)"
+fi
+
+echo "== reminders =="
+echo "- update docs/STATUS_r03.md + docs/PERFORMANCE.md with the numbers"
+echo "- decide subG fused: win -> keep, else retire fused='all' citing r03 A/B"
+echo "- stop the watcher before session end: pgrep -fa r03_queue"

@@ -133,14 +133,21 @@ def cmd_hrs(args):
 def cmd_stress(args):
     """Stress-scale run (BASELINE.md config 5 shape): streaming n-blocked
     estimators, optionally sharded over the device mesh; prints reps/sec."""
+    import jax
+
     from dpcorr.sim import SimConfig, run_sim_one
 
+    b = args.b or 256
+    # replication vmap width: narrow on CPU (cache-measured), wide on TPU
+    # (same policy as benchmarks/run_all.py config 5)
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    chunk = args.chunk_size or (min(b, 32) if on_tpu else max(2, b // 8))
     cfg = SimConfig(
-        n=args.n, rho=0.5, eps1=1.0, eps2=1.0, b=args.b or 256,
+        n=args.n, rho=0.5, eps1=1.0, eps2=1.0, b=b,
         dgp="bounded_factor" if args.family == "subg" else "gaussian",
         use_subg=args.family == "subg",
         stream_n_chunk=args.n_chunk,
-        chunk_size=max(2, (args.b or 256) // 8))
+        chunk_size=chunk)
     t0 = time.perf_counter()
     if args.backend == "sharded":
         from dpcorr.parallel import run_summary_sharded
@@ -198,6 +205,10 @@ def main(argv=None):
                            default=65_536)
             p.add_argument("--family", choices=["sign", "subg"],
                            default="subg")
+            p.add_argument("--chunk-size", dest="chunk_size", type=int,
+                           default=None,
+                           help="replication vmap width (default: "
+                                "platform-tuned)")
         if name == "acceptance":
             p.add_argument("--out-json", dest="out_json", default=None)
         if name in ("grid", "grid-subg"):

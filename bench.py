@@ -435,6 +435,15 @@ def main() -> None:
         out.setdefault("detail", {})["attempts"] = attempts
     out.setdefault("detail", {})["tunnel_health_probe"] = (
         "ok" if healthy else "failed")
+    try:  # provenance: which revision this measurement describes
+        rev = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10).stdout.strip()
+        if rev:
+            out["detail"]["git_rev"] = rev
+    except Exception:
+        pass
     print(json.dumps(out), flush=True)
     sys.exit(0)
 

@@ -158,6 +158,14 @@ def measure_steady_state(run_block, args_for, block_reps: int,
 def worker_main(mode: str, budget_s: float) -> None:
     import jax
 
+    cache_dir = os.environ.get("DPCORR_COMPILE_CACHE")
+    if cache_dir:
+        # persistent compile cache: doesn't change the measurement (the
+        # warm-up block already excludes compile) but cuts minutes of
+        # tunnel exposure on repeat runs — less time for a wedge to hit
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
     if mode == "cpu":
         # Must happen before any backend is initialized; keeps the worker
         # clear of the (possibly hung) TPU tunnel entirely.

@@ -241,7 +241,27 @@ def main(argv=None):
 
         # must run before any backend initialization; no-op if one is live
         jax.config.update("jax_platforms", args.platform)
+    _maybe_compile_cache()
     args.fn(args)
+
+
+def _maybe_compile_cache() -> None:
+    """Opt-in persistent XLA compilation cache (DPCORR_COMPILE_CACHE=dir).
+
+    The grid workloads compile one kernel per (n, ε) shape bucket — the
+    dominant cost of short on-chip runs (e.g. the 144-point fused grid is
+    compile-bound at B=250, docs/PERFORMANCE.md) — and the cache makes
+    re-runs skip all of it. Opt-in because cache entries are
+    revision/flag-sensitive and a stale cache dir is confusing in
+    benchmarks; point it at a per-revision path for honest timings."""
+    import os
+
+    cache_dir = os.environ.get("DPCORR_COMPILE_CACHE")
+    if cache_dir:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 if __name__ == "__main__":

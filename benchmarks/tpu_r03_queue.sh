@@ -23,6 +23,9 @@ OUT=/tmp/tpu_r03
 mkdir -p "$OUT"
 FAILED=0
 TOTAL=0
+# persistent compile cache, keyed by revision (honest timings: the first
+# run of this revision still pays compile; later steps/retries skip it)
+export DPCORR_COMPILE_CACHE="$OUT/xla_cache_$(git rev-parse --short HEAD)"
 
 step() {  # step <name> <cmd...>: run, record status, keep going
   local name=$1; shift

@@ -349,6 +349,15 @@ def _run_worker(mode: str, timeout_s: float, budget_s: float):
     return None, f"{mode} worker: exited 0 but printed no measurement JSON"
 
 
+#: the probe payload: one matmul on the default backend, which must be a
+#: real TPU — a silent CPU fallback is NOT healthy and must exit nonzero
+PROBE_CODE = (
+    "import jax, jax.numpy as jnp; "
+    "assert jax.devices()[0].platform in ('tpu', 'axon'), "
+    "jax.devices()[0].platform; "
+    "print(float((jnp.ones((128,128))@jnp.ones((128,128))).sum()))")
+
+
 def _health_probe(timeout_s: float = 150.0) -> bool:
     """Bounded TPU-liveness probe in a throwaway process group (the same
     one-matmul check ``benchmarks/tpu_revalidate.sh`` polls with). Its
@@ -357,12 +366,8 @@ def _health_probe(timeout_s: float = 150.0) -> bool:
     patience rather than a kill (the r02 round lost its headline to two
     worker timeouts on a tunnel that was merely slow); a probe that fails
     keeps the short timeout so a wedged tunnel degrades to CPU quickly."""
-    code = ("import jax, jax.numpy as jnp; "
-            "assert jax.devices()[0].platform in ('tpu', 'axon'), "
-            "jax.devices()[0].platform; "  # a CPU fallback is NOT healthy
-            "print(float((jnp.ones((128,128))@jnp.ones((128,128))).sum()))")
     try:
-        p = subprocess.Popen([sys.executable, "-c", code],
+        p = subprocess.Popen([sys.executable, "-c", PROBE_CODE],
                              stdout=subprocess.DEVNULL,
                              stderr=subprocess.DEVNULL,
                              start_new_session=True)

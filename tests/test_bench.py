@@ -152,6 +152,20 @@ def test_total_failure_still_valid_json(monkeypatch, capsys):
 
 
 @pytest.mark.slow
+def test_health_probe_payload_rejects_cpu_platform():
+    """The probe payload must exit nonzero on a CPU backend (a silent CPU
+    fallback must never earn the long TPU leash): run the exact PROBE_CODE
+    with the platform forced to cpu and check the platform assert fires."""
+    p = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu'); "
+         + bench.PROBE_CODE],
+        capture_output=True, text=True, timeout=120)
+    assert p.returncode != 0
+    assert "AssertionError" in p.stderr
+
+
+@pytest.mark.slow
 def test_cpu_worker_smoke():
     """End-to-end CPU worker subprocess: valid JSON, sane statistics."""
     p = subprocess.run(

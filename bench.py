@@ -366,6 +366,7 @@ def _health_probe(timeout_s: float = 150.0) -> bool:
     patience rather than a kill (the r02 round lost its headline to two
     worker timeouts on a tunnel that was merely slow); a probe that fails
     keeps the short timeout so a wedged tunnel degrades to CPU quickly."""
+    p = None
     try:
         p = subprocess.Popen([sys.executable, "-c", PROBE_CODE],
                              stdout=subprocess.DEVNULL,
@@ -373,17 +374,20 @@ def _health_probe(timeout_s: float = 150.0) -> bool:
                              start_new_session=True)
         p.communicate(timeout=timeout_s)
         return p.returncode == 0
-    except subprocess.TimeoutExpired:
-        import signal
-
-        try:
-            os.killpg(p.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-        p.wait()
-        return False
     except Exception:
         return False
+    finally:
+        # every failure path (not just TimeoutExpired) must reap the probe
+        # process group, or a leaked child keeps the TPU tunnel handle the
+        # probe exists to quarantine
+        if p is not None and p.poll() is None:
+            import signal
+
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            p.wait()
 
 
 def main() -> None:

@@ -40,6 +40,7 @@ from dpcorr.io.rds_py import (
     LISTSXP,
     NILVALUE_SXP,
     R_NA_INT,
+    R_NA_REAL_BITS,
     REALSXP,
     STRSXP,
     SYMSXP,
@@ -53,14 +54,22 @@ _ASCII_MASK = 64  # CHARSXP gp levels bit
 _UTF8_MASK = 8
 
 
-def _is_na(v) -> bool:
-    """None, float NaN, or a pandas NA scalar (whose truthiness raises)."""
+def _na_kind(v) -> str | None:
+    """Classify one object-column value: ``"absent"`` for None / pd.NA
+    (R's NA), ``"nan"`` for a true float NaN (a computed value — R's NaN),
+    ``None`` for a live value."""
     if v is None:
-        return True
+        return "absent"
     try:
-        return bool(v != v)
+        return "nan" if bool(v != v) else None
     except Exception:  # pd.NA: `v != v` is NA and bool(NA) raises
-        return True
+        return "absent"
+
+
+# R's NA_real_ is a specific quiet-NaN payload (R arithmetic.c, the same
+# bits ``rds_py.real_is_na`` recognizes on the read side). numpy reads it
+# back as NaN; R's is.na() is TRUE and is.nan() FALSE, as for saveRDS'd NA.
+_R_NA_REAL = struct.pack(">Q", R_NA_REAL_BITS)
 
 
 class _Writer:
@@ -112,10 +121,16 @@ class _Writer:
         self.flags(SYMSXP)
         self.charsxp(name)
 
-    def realsxp(self, arr: np.ndarray) -> None:
+    def realsxp(self, arr: np.ndarray, na_mask=None) -> None:
         self.flags(REALSXP)
         self.i32(arr.size)
-        self.raw(np.ascontiguousarray(arr, dtype=">f8").tobytes())
+        buf = np.ascontiguousarray(arr, dtype=">f8").tobytes()
+        if na_mask is not None and np.any(na_mask):
+            buf = bytearray(buf)
+            for i in np.flatnonzero(na_mask):
+                buf[8 * i:8 * i + 8] = _R_NA_REAL
+            buf = bytes(buf)
+        self.raw(buf)
 
     def intsxp(self, arr: np.ndarray, ptype: int = INTSXP) -> None:
         self.flags(ptype)
@@ -144,7 +159,8 @@ class _Writer:
         arr = values if isinstance(values, np.ndarray) else np.asarray(values)
         if arr.dtype.kind in "OU":
             vals = list(arr)
-            na = [_is_na(v) for v in vals]
+            kinds = [_na_kind(v) for v in vals]
+            na = [k is not None for k in kinds]
             live = [v for v, m in zip(vals, na) if not m]
             if all(isinstance(v, str) for v in live):
                 self.strsxp([None if m else str(v)
@@ -167,7 +183,10 @@ class _Writer:
                     raise TypeError(
                         "column mixes non-numeric, non-string values "
                         f"({e})") from e
-                self.realsxp(arr_f)
+                # absent values (None/pd.NA) get R's NA_real_ payload;
+                # a float NaN that was *in* the column stays plain NaN
+                self.realsxp(arr_f, na_mask=np.asarray(
+                    [k == "absent" for k in kinds], dtype=bool))
             return
         if arr.dtype.kind == "b":
             self.intsxp(arr.astype(np.int64), ptype=LGLSXP)
@@ -188,14 +207,18 @@ def write_rds_table(path: str, columns: Mapping[str, Any],
     """Write ``{name: values}`` as a data.frame .rds (``saveRDS``-shaped:
     version-3 XDR, gzip by default, matching R's default compress="gzip").
 
-    Columns: float arrays → REALSXP (NaN kept — R reads it as NaN),
-    int arrays → INTSXP (64-bit values that overflow R's 32-bit ints are
-    promoted to doubles, as R itself would store them), bool → LGLSXP,
-    all-string object sequences → STRSXP with None/NaN/pd.NA as
-    NA_character_. Object-dtype numerics (plain number lists, pandas
-    nullable Int64/boolean via ``to_numpy()``) coerce to REALSXP/LGLSXP
-    with missing → NA — never silently to strings; a non-numeric,
-    non-string mix raises. All columns must share one length.
+    Columns: float arrays → REALSXP (NaN kept as IEEE NaN — R's is.na()
+    is TRUE for it but is.nan() distinguishes it from NA_real_; a float64
+    array carries no missing/NaN distinction to recover), int arrays →
+    INTSXP (64-bit values that overflow R's 32-bit ints are promoted to
+    doubles, as R itself would store them), bool → LGLSXP, all-string
+    object sequences → STRSXP with None/NaN/pd.NA as NA_character_.
+    Object-dtype numerics (plain number lists, pandas nullable
+    Int64/boolean via ``to_numpy()``) coerce to REALSXP/LGLSXP where the
+    truly *absent* entries (None/pd.NA) are written as R's ``NA_real_``
+    payload — bit-faithful to saveRDS — while an actual NaN value stays
+    NaN; never silently to strings, and a non-numeric, non-string mix
+    raises. All columns must share one length.
     """
     sizes = {len(v) if isinstance(v, (list, tuple)) else np.asarray(v).size
              for v in columns.values()}

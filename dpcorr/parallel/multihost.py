@@ -191,61 +191,91 @@ def run_grid_multihost(gcfg: GridConfig, n_hosts: int = 2,
     env = dict(os.environ)
     if platform:
         env["DPCORR_HOST_PLATFORM"] = platform
-    dist = None
-    if distributed:
+
+    def _free_port() -> int:
         import socket
 
         with socket.socket() as s:  # free port for the coordinator service
             s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        dist = {"coordinator": f"127.0.0.1:{port}",
-                "num_processes": n_hosts,
-                "local_device_count": local_device_count}
-    procs = []
-    for h in range(n_hosts):
-        spec = {"host_id": h, "n_hosts": n_hosts,
-                "gcfg": {f.name: getattr(gcfg, f.name)
-                         for f in dataclasses.fields(gcfg)}}
-        if dist:
-            spec["dist"] = {**dist, "process_id": h}
-        procs.append(subprocess.Popen(
-            [python or sys.executable, "-m", "dpcorr.parallel.multihost"],
-            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True, env=env))
-        # deliver the spec at spawn time so hosts run concurrently; null
-        # the handle so the later communicate() won't flush a closed file
-        procs[-1].stdin.write(json.dumps(spec))
-        procs[-1].stdin.close()
-        procs[-1].stdin = None
-    errs, reports = [], []
-    for h, p in enumerate(procs):
-        # communicate() drains stdout+stderr together — a worker that fills
-        # one pipe can never deadlock the join
-        out, err = p.communicate()
-        if p.returncode != 0:
-            tail = err.strip().splitlines()[-3:]
-            errs.append(f"host {h}: rc={p.returncode}: " + " | ".join(tail))
-        else:
-            # tolerant scan (as bench._run_worker): a stray non-JSON line
-            # on a worker's stdout must not cost the finished grid
-            for line in reversed(out.strip().splitlines()):
-                try:
-                    rep = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if isinstance(rep, dict) and "host_id" in rep:
-                    reports.append(rep)
-                    break
+            return s.getsockname()[1]
+
+    def _attempt() -> tuple[list[str], list[dict]]:
+        dist = None
+        if distributed:
+            dist = {"coordinator": f"127.0.0.1:{_free_port()}",
+                    "num_processes": n_hosts,
+                    "local_device_count": local_device_count}
+        procs = []
+        for h in range(n_hosts):
+            spec = {"host_id": h, "n_hosts": n_hosts,
+                    "gcfg": {f.name: getattr(gcfg, f.name)
+                             for f in dataclasses.fields(gcfg)}}
+            if dist:
+                spec["dist"] = {**dist, "process_id": h}
+            procs.append(subprocess.Popen(
+                [python or sys.executable,
+                 "-m", "dpcorr.parallel.multihost"],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True, env=env))
+            # deliver the spec at spawn time so hosts run concurrently;
+            # null the handle so communicate() won't flush a closed file
+            procs[-1].stdin.write(json.dumps(spec))
+            procs[-1].stdin.close()
+            procs[-1].stdin = None
+        errs, reports = [], []
+        for h, p in enumerate(procs):
+            # communicate() drains stdout+stderr together — a worker that
+            # fills one pipe can never deadlock the join
+            out, err = p.communicate()
+            if p.returncode != 0:
+                tail = err.strip().splitlines()[-3:]
+                errs.append(f"host {h}: rc={p.returncode}: "
+                            + " | ".join(tail))
+            else:
+                # tolerant scan (as bench._run_worker): a stray non-JSON
+                # line on a worker's stdout must not cost a finished grid
+                for line in reversed(out.strip().splitlines()):
+                    try:
+                        rep = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(rep, dict) and "host_id" in rep:
+                        reports.append(rep)
+                        break
+        return errs, reports
+
+    errs, reports = _attempt()
+    if errs and distributed and any("bind" in e.lower()
+                                    or "address" in e.lower()
+                                    for e in errs):
+        # the free-port pick above is inherently check-then-use: another
+        # process can claim the port before jax.distributed's coordinator
+        # binds it. One retry with a fresh port turns that flake into a
+        # recovered run; a second failure is a real error.
+        errs, reports = _attempt()
     if errs:
         raise RuntimeError(f"{len(errs)}/{n_hosts} hosts failed: "
                            + "; ".join(errs)[:800])
-    if dist:
-        # the cluster facts must agree with what we launched: every worker
-        # saw the full process set, and exactly rank 0 merged
+    if distributed:
+        # the cluster facts must agree with what we launched — but only
+        # for reports that actually surfaced: a worker whose JSON line got
+        # lost in stdout noise must not discard a grid that completed.
+        # Safe because rc==0 (checked above) already implies the worker
+        # finished its slice; and even if a point were somehow absent from
+        # the cache, the resume assembly below recomputes it in-parent
+        # (correct result, just slower and on the parent's platform)
         bad = [r for r in reports if r["process_count"] != n_hosts]
-        if bad or sum(r["merged"] for r in reports) != 1:
+        merged = sum(r["merged"] for r in reports)
+        if bad or merged > 1 or (merged == 0 and len(reports) == n_hosts):
             raise RuntimeError(
                 f"distributed cluster inconsistent: {reports!r}")
+        if len(reports) < n_hosts:
+            import warnings
+
+            warnings.warn(
+                f"only {len(reports)}/{n_hosts} worker reports parsed "
+                "from stdout; trusting the merged artifacts instead",
+                RuntimeWarning, stacklevel=2)
     # assemble from the (now complete) shared cache — pure cache hits even
     # when the caller disabled resume for the compute itself
     res = run_grid(dataclasses.replace(gcfg, resume=True))

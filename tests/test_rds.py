@@ -385,6 +385,36 @@ class TestRdsWriter:
             write_rds_table(str(tmp_path / "bad.rds"),
                             {"mix": ["a", object()]})
 
+    def test_absent_numerics_are_na_real(self, tmp_path):
+        """None/pd.NA in object-numeric columns must land as R's NA_real_
+        payload (0x7FF00000000007A2, R arithmetic.c) so is.na() is TRUE
+        and is.nan() FALSE — while a true float NaN stays a plain quiet
+        NaN (advisor finding r3: the two were conflated)."""
+        import struct
+
+        import pandas as pd
+
+        from dpcorr.io.rds_write import write_rds_table
+
+        p = str(tmp_path / "na.rds")
+        write_rds_table(p, {
+            "x": [1.5, None, float("nan"), pd.NA],
+        }, compress=False)
+        blob = open(p, "rb").read()
+        na_real = struct.pack(">Q", 0x7FF00000000007A2)
+        # both absent entries carry the payload; the literal NaN does not
+        assert blob.count(na_real) == 2
+        # readers see all three missing entries as NaN doubles
+        v = rds_py.read_rds_table(p)["x"].values
+        assert v[0] == 1.5 and all(np.isnan(v[1:]))
+        # raw stream order: value, NA_real_, plain NaN (not the payload),
+        # NA_real_ — find the 4 doubles behind the REALSXP header
+        idx = blob.index(struct.pack(">d", 1.5))
+        doubles = [blob[idx + 8 * i: idx + 8 * (i + 1)] for i in range(4)]
+        assert doubles[1] == na_real and doubles[3] == na_real
+        assert doubles[2] != na_real and np.isnan(
+            struct.unpack(">d", doubles[2])[0])
+
     def test_ragged_raises(self, tmp_path):
         from dpcorr.io.rds_write import write_rds_table
 

@@ -1,0 +1,89 @@
+"""Second acceptance point for the real-data subG variant (VERDICT r3 #5).
+
+The r03 campaign pinned det-vs-MC INT coverage agreement at ONE config
+point — (n=4000, ε=(1,1)) — and spent 93% of the 1e-3 budget doing it
+(diff 9.28e-4 at B=2²⁰, `acceptance_r03_subg_real.json`). One point can't
+say whether that margin is MC noise or a real det-mode bias of the
+real-data construction (real-data-sims.R:115-252). This script runs the
+same B=2²⁰ det/mc twin — identical replicate keys, so NI coverage must
+agree exactly and the INT diff isolates the mixquant construction — at a
+caller-chosen (n, ε), defaulting to the HRS-like shape (wave-2 complete
+cases n=19,433, ε_corr=2.0; dpcorr/hrs.py).
+
+Reuses the campaign machinery (`dpcorr.acceptance`): one AccPoint with
+``both_mixquant=True`` in the real-data flavor, which makes the MC twin
+draw at the real-data script's nsim=2000 (real-data-sims.R:161-164).
+
+Run: python benchmarks/acceptance_point2.py [--n 19433] [--eps 2.0]
+         [--log2b 20] [--platform cpu] [--out ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=19_433,
+                    help="sample size (default: HRS wave-2 complete cases)")
+    ap.add_argument("--eps", type=float, default=2.0,
+                    help="ε1=ε2 (default: the HRS pipeline's ε_corr)")
+    ap.add_argument("--rho", type=float, default=0.5)
+    ap.add_argument("--log2b", type=int, default=20,
+                    help="log2 of replications per mode (20 ⇒ MC SE ≈ "
+                         "2.1e-4 on a 0.95 coverage)")
+    ap.add_argument("--block", type=int, default=32_768)
+    ap.add_argument("--chunk", type=int, default=1024,
+                    help="vmap chunk (smaller than the campaign's 4096: "
+                         "n here is ~5× the campaign's largest)")
+    ap.add_argument("--platform", type=str, default=None,
+                    help="force a JAX platform (the site hook ignores "
+                         "JAX_PLATFORMS env; this applies config.update "
+                         "before backend init)")
+    ap.add_argument("--out", type=str,
+                    default=os.path.join(REPO, "benchmarks", "results",
+                                         "acceptance_r04.json"))
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from dpcorr.acceptance import AccPoint, run_campaign
+
+    pt = AccPoint(
+        "subg_real_p2",
+        "real-data (v2) estimator pair at the HRS-like shape — second "
+        "det/mc calibration point (VERDICT r3 #5); same construction as "
+        "subg_real (real-data-sims.R:115-252)",
+        {"n": args.n, "rho": args.rho, "eps1": args.eps, "eps2": args.eps,
+         "dgp": "bounded_factor", "use_subg": True,
+         "subg_variant": "real"},
+        both_mixquant=True,
+    )
+    table = run_campaign(b=1 << args.log2b, block=args.block,
+                         points=(pt,), chunk_size=args.chunk,
+                         out=args.out)
+    row = table["points"][0]
+    print(json.dumps({
+        "point": row["point"],
+        "n": args.n, "eps": args.eps, "b": row["det"]["b"],
+        "det_INT": row["det"]["INT"]["coverage"],
+        "mc_INT": row["mc"]["INT"]["coverage"],
+        "det_mc_diff_INT": row["int_det_mc_diff"],
+        "det_mc_diff_NI": row["ni_det_mc_diff"],
+        "within_1e3": bool(row["int_det_mc_diff"] <= 1e-3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,10 @@ def _summ_stats(res):
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--b", type=int, default=250)
+    ap.add_argument("--out", type=str, default=None,
+                    help="output JSON path (default: the family's r02 "
+                         "artifact — pass an r0N name to keep old "
+                         "evidence intact)")
     ap.add_argument("--family", choices=["sign", "subg"], default="sign",
                     help="sign: v1 Gaussian grid (vert-cor.R:488-511); "
                          "subg: v2 bounded-factor grid "
@@ -98,7 +102,7 @@ def main() -> None:
     out["coverage_diff_INT"] = round(
         abs(o["mean_coverage_INT"] - a["mean_coverage_INT"]), 4)
 
-    path = RESULTS[args.family]
+    path = args.out or RESULTS[args.family]
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
         f.write("\n")

@@ -1,0 +1,74 @@
+#!/bin/bash
+# Harvest the r04 TPU queue outputs (/tmp/tpu_r04) into checked-in
+# artifacts. Run after `tpu_r04_queue.sh` reports steps OK. Idempotent;
+# prints what it found and what it wrote. Commit separately after review.
+
+set -u
+cd "$(dirname "$0")/.."
+IN=/tmp/tpu_r04
+OUT=benchmarks/results
+
+copy_json() {  # copy_json <src> <dst> <must-contain>
+  local src=$1 dst=$2 needle=$3
+  if [ -s "$src" ] && grep -q "$needle" "$src"; then
+    cp "$src" "$dst"
+    echo "wrote $dst"
+  else
+    echo "SKIP $dst ($src missing or lacks '$needle')"
+  fi
+}
+
+echo "== headline =="
+# bench_default.json is the full driver-shaped line; keep it verbatim as
+# the round's recorded hardware evidence
+copy_json "$IN/bench_default.json" "$OUT/r04_tpu_headline.json" reps_per_sec
+
+echo "== gauss A/B =="
+for f in pallas_boxmuller pallas_ndtri; do
+  copy_json "$IN/$f.json" "$OUT/r04_$f.json" reps_per_sec
+done
+if [ -s "$OUT/r04_pallas_boxmuller.json" ] && [ -s "$OUT/r04_pallas_ndtri.json" ]; then
+  python - <<'EOF'
+import json
+bm = json.load(open("benchmarks/results/r04_pallas_boxmuller.json"))
+nd = json.load(open("benchmarks/results/r04_pallas_ndtri.json"))
+b, n = bm["value"], nd["value"]
+print(f"gauss A/B: boxmuller {b:.0f} vs ndtri {n:.0f} reps/sec "
+      f"-> {'NDTRI WINS: flip the kernel default' if n > 1.02*b else 'keep boxmuller'}")
+EOF
+fi
+
+echo "== subG fused decisive A/B =="
+if [ -s "$OUT/r04_grid_fused_subg_tpu.json" ]; then
+  python - <<'EOF'
+import json
+d = json.load(open("benchmarks/results/r04_grid_fused_subg_tpu.json"))
+s = d.get("fused_speedup_rps", 0)
+print(f"subG fused vs XLA: {s}x "
+      f"-> {'KEEP fused=all' if s > 1.05 else 'RETIRE fused=all (cite this file)'}")
+EOF
+else
+  echo "MISSING: $OUT/r04_grid_fused_subg_tpu.json (if the tunnel never"
+  echo "healed, retire fused='all' citing r02_grid_fused_subg_tpu.json)"
+fi
+
+echo "== config5 / suite / acceptance =="
+for f in r04_tpu_config5.jsonl r04_tpu_suite.jsonl acceptance_r04_tpu.json; do
+  if [ -s "$OUT/$f" ]; then echo "present: $OUT/$f ($(wc -c < "$OUT/$f") bytes)"
+  else echo "MISSING: $OUT/$f"; fi
+done
+
+echo "== roofline =="
+if [ -s "$OUT/r04_roofline.json" ]; then
+  python -c "import json; d=json.load(open('$OUT/r04_roofline.json')); print('roofline:', d['summary'])"
+else
+  echo "MISSING: $OUT/r04_roofline.json"
+fi
+if [ -d "$OUT/trace_r04" ]; then
+  du -sh "$OUT/trace_r04"
+  echo "note: review trace size before committing (trim to the .trace/.json summary if huge)"
+fi
+
+echo "== reminders =="
+echo "- update docs/STATUS_r04.md + docs/PERFORMANCE.md with the numbers"
+echo "- stop the watcher before session end: pgrep -fa r04_queue"

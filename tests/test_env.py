@@ -2,9 +2,30 @@
 sharding paths are actually exercised (SURVEY.md §4)."""
 
 import jax
+import pytest
 
 
 def test_virtual_mesh_is_live(devices):
     assert len(devices) == 8
     assert all(d.platform == "cpu" for d in devices)
     assert jax.device_count() == 8
+
+
+def test_graft_entry_compiles(devices):
+    """The driver compile-checks entry() single-chip; pin it here too."""
+    import __graft_entry__ as g
+
+    step, args = g.entry()
+    out = jax.jit(step)(*args)
+    assert out.shape[0] == 12 and out.shape[1] == 64
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_certifies_all_families(devices):
+    """dryrun_multichip(8) must assert bit-identity of the sharded detail
+    vs the local run for all four families (sign, subG, streaming, fused
+    streaming pair) — VERDICT r3 #4. Running it here keeps the driver's
+    MULTICHIP artifact honest between rounds."""
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)

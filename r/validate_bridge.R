@@ -1,0 +1,68 @@
+# One-command proof of the reticulate seam (docs/R_BRIDGE.md).
+#
+# The reference's only process boundary is the mclapply fan-out over
+# design rows (vert-cor.R:534-554). r/backend.R swaps that seam for the
+# dpcorr TPU backend via reticulate; this script proves the marshalling
+# round trip in any environment that has R + reticulate + this repo:
+#
+#   Rscript r/validate_bridge.R          # CPU JAX is fine
+#
+# It runs the fixed 4-point grid TWICE —
+#   (a) through reticulate:  run_grid_backend(..., backend = "tpu")
+#   (b) through a subprocess: python r/validate_bridge_helper.py, whose
+#       output comes back as detail_all.rds via this repo's own RDS writer
+# — and diffs the two frames cell by cell. Both sides are the identical
+# computation (same seeds, same kernels), so ANY difference is a
+# marshalling defect: type coercion, row reordering, precision loss, NA
+# mangling. It finishes by pushing the bridge frame through the
+# reference's grouped-summary recipe (vert-cor.R:575-597).
+
+# run from the repo root: Rscript r/validate_bridge.R
+source(file.path("r", "backend.R"))
+
+design_df <- expand.grid(n = c(400L, 800L), rho = c(0.2, 0.6))
+design_df <- design_df[order(design_df$n, design_df$rho), ]
+design_df$eps1 <- 1.0
+design_df$eps2 <- 1.0
+B <- 16L
+SEED <- 2025L
+
+message("== (a) 4-point grid through reticulate (backend='tpu') ==")
+bridge_df <- run_grid_backend(design_df, B = B, seed = SEED,
+                              backend = "tpu", py_backend = "bucketed")
+stopifnot(nrow(bridge_df) == nrow(design_df) * B)
+
+message("== (b) same grid via subprocess -> detail_all.rds ==")
+rds_path <- tempfile(fileext = ".rds")
+helper <- file.path("r", "validate_bridge_helper.py")
+rc <- system2(Sys.getenv("RETICULATE_PYTHON", "python"),
+              c(helper, "--out", shQuote(rds_path)))
+stopifnot(rc == 0L)
+subproc_df <- readRDS(rds_path)
+
+message("== diff ==")
+stopifnot(identical(dim(bridge_df), dim(subproc_df)))
+stopifnot(identical(sort(names(bridge_df)), sort(names(subproc_df))))
+subproc_df <- subproc_df[names(bridge_df)]
+max_abs_diff <- 0
+for (col in names(bridge_df)) {
+  a <- bridge_df[[col]]
+  b <- subproc_df[[col]]
+  if (is.numeric(a)) {
+    d <- max(abs(as.numeric(a) - as.numeric(b)), na.rm = TRUE)
+    max_abs_diff <- max(max_abs_diff, d)
+    if (d != 0) message(sprintf("  col %-12s max |diff| = %.3g", col, d))
+  } else {
+    stopifnot(identical(as.character(a), as.character(b)))
+  }
+}
+stopifnot(max_abs_diff == 0)  # bit-identity: same computation both ways
+
+message("== reference summary recipe on the bridge frame ==")
+# vert-cor.R:575-597 shape: grouped coverage / mse by design cell
+agg <- aggregate(cbind(ni_cover, int_cover) ~ n + rho_true + eps1 + eps2,
+                 data = bridge_df, FUN = mean)
+print(agg)
+stopifnot(all(agg$ni_cover >= 0 & agg$ni_cover <= 1))
+
+message("BRIDGE VALIDATION PASSED: reticulate round trip is bit-exact")

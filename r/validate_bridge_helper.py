@@ -1,0 +1,64 @@
+"""Python half of the R-bridge validation (r/validate_bridge.R).
+
+Runs the fixed 4-point validation grid through ``dpcorr.rbridge`` — the
+same function the reticulate path calls — and writes the detail frame as
+``detail_all.rds``. The R script readRDS()es this file and diffs it
+against the frame it received through reticulate: any marshalling defect
+(type coercion, row reordering, NA mangling) shows up as a non-empty
+diff, because both sides are the identical computation
+(vert-cor.R:534-554 seam; SURVEY.md §7 step 6).
+
+tests/test_rbridge.py runs this helper directly, so the Python half is
+executed evidence even in images without an R runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+#: The validation grid (2n x 2rho x one eps-pair) and rep count. Small
+#: enough for seconds on CPU JAX; shared verbatim with validate_bridge.R.
+ROWS = [{"n": 400, "rho": 0.2, "eps1": 1.0, "eps2": 1.0},
+        {"n": 400, "rho": 0.6, "eps1": 1.0, "eps2": 1.0},
+        {"n": 800, "rho": 0.2, "eps1": 1.0, "eps2": 1.0},
+        {"n": 800, "rho": 0.6, "eps1": 1.0, "eps2": 1.0}]
+B = 16
+SEED = 2025
+
+
+def run_validation_grid(backend: str = "bucketed"):
+    from dpcorr import rbridge
+
+    return rbridge.run_design_rows(ROWS, b=B, seed=SEED, backend=backend)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True, help="detail_all.rds path")
+    ap.add_argument("--backend", default="bucketed")
+    ap.add_argument("--platform", default="cpu",
+                    help="JAX platform (the site hook ignores "
+                         "JAX_PLATFORMS env; '' keeps the default)")
+    args = ap.parse_args()
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from dpcorr.io.rds_write import write_rds_frame
+
+    detail = run_validation_grid(args.backend)
+    write_rds_frame(args.out, detail)
+    print(f"wrote {args.out}: {len(detail)} rows x "
+          f"{len(detail.columns)} cols")
+
+
+if __name__ == "__main__":
+    main()

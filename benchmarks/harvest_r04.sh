@@ -10,11 +10,15 @@ OUT=benchmarks/results
 
 copy_json() {  # copy_json <src> <dst> <must-contain>
   local src=$1 dst=$2 needle=$3
-  if [ -s "$src" ] && grep -q "$needle" "$src"; then
+  # a degraded CPU-fallback line still contains reps_per_sec — it must
+  # never be banked as TPU evidence (bench.py cites these files back as
+  # "recorded_tpu_evidence", which would become circular)
+  if [ -s "$src" ] && grep -q "$needle" "$src" \
+     && ! grep -q '"degraded"' "$src"; then
     cp "$src" "$dst"
     echo "wrote $dst"
   else
-    echo "SKIP $dst ($src missing or lacks '$needle')"
+    echo "SKIP $dst ($src missing, lacks '$needle', or is degraded)"
   fi
 }
 

@@ -49,7 +49,14 @@ for (col in names(bridge_df)) {
   a <- bridge_df[[col]]
   b <- subproc_df[[col]]
   if (is.numeric(a)) {
-    d <- max(abs(as.numeric(a) - as.numeric(b)), na.rm = TRUE)
+    # NA placement must agree BEFORE the numeric diff — an NA-vs-value
+    # mismatch is exactly the marshalling defect class this script exists
+    # to catch, and na.rm would silently drop it
+    stopifnot(identical(is.na(a), is.na(b)))
+    live <- !is.na(a)
+    d <- if (any(live)) {
+      max(abs(as.numeric(a[live]) - as.numeric(b[live])))
+    } else 0
     max_abs_diff <- max(max_abs_diff, d)
     if (d != 0) message(sprintf("  col %-12s max |diff| = %.3g", col, d))
   } else {

@@ -31,53 +31,64 @@ def test_main_rejects_unknown_config(capsys):
         run_all.main(["--config", "9"])
 
 
+def _parser_flags(mod):
+    """All option strings of a benchmarks script's argparse parser,
+    collected by intercepting parse_args (the parser is built inside
+    main(), before any heavy import)."""
+    import argparse
+
+    flags: set[str] = set()
+    old_parse = argparse.ArgumentParser.parse_args
+
+    def grab(self, args=None, namespace=None):
+        for a in self._actions:
+            flags.update(a.option_strings)
+        raise SystemExit(0)
+
+    argparse.ArgumentParser.parse_args = grab
+    try:
+        try:
+            mod.main()
+        except SystemExit:
+            pass
+    finally:
+        argparse.ArgumentParser.parse_args = old_parse
+    return flags
+
+
 def test_r04_scripts_importable_and_documented():
     """The unattended r04 queue (tpu_r04_queue.sh) invokes these scripts
     with specific flags; an import error or a renamed flag would silently
     burn the round's first healthy-tunnel window. Pin the contract."""
-    import argparse
-
     from benchmarks import acceptance_point2, multihost_scaling
 
     for mod, flags in ((acceptance_point2,
                         {"--n", "--eps", "--log2b", "--out", "--platform"}),
                        (multihost_scaling,
                         {"--b", "--n-hosts", "--out", "--platform"})):
-        # re-build the parser exactly as main() would, without running it
-        src_flags = set()
-        old_parse = argparse.ArgumentParser.parse_args
-
-        def grab(self, args=None, namespace=None):
-            for a in self._actions:
-                src_flags.update(a.option_strings)
-            raise SystemExit(0)
-
-        argparse.ArgumentParser.parse_args = grab
-        try:
-            try:
-                mod.main()
-            except SystemExit:
-                pass
-        finally:
-            argparse.ArgumentParser.parse_args = old_parse
-        assert flags <= src_flags, (mod.__name__, flags - src_flags)
+        assert flags <= _parser_flags(mod), mod.__name__
 
 
 def test_queue_script_invokes_real_flags():
     """Every --flag the r04 queue passes to a benchmarks/ python script
-    must exist in that script's parser (same class of guard as
+    must exist in that script's ACTUAL parser (derived live, not a
+    hand-maintained list — same class of guard as
     test_backend_r_call_contract for the R seam)."""
     import re
     from pathlib import Path
 
+    from benchmarks import acceptance_point2, grid_fused_tpu
+
     repo = Path(__file__).parent.parent
     sh = (repo / "benchmarks" / "tpu_r04_queue.sh").read_text()
-    known = {
-        "acceptance_point2.py": {"--n", "--eps", "--log2b", "--out"},
-        "grid_fused_tpu.py": {"--family", "--out", "--b"},
-    }
-    for script, valid in known.items():
-        for m in re.finditer(re.escape(script) + r"(.*?)(?:2>|\||$)",
+    for script, mod in (("acceptance_point2.py", acceptance_point2),
+                        ("grid_fused_tpu.py", grid_fused_tpu)):
+        valid = _parser_flags(mod)
+        assert valid, script
+        found = 0
+        for m in re.finditer(re.escape(script) + r"(.*?)(?:2>|\|)",
                              sh, re.S):
+            found += 1
             used = set(re.findall(r"(--[a-z0-9-]+)", m.group(1)))
             assert used <= valid, (script, used - valid)
+        assert found, f"{script} not invoked by the queue?"

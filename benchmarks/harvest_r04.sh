@@ -5,8 +5,9 @@
 
 set -u
 cd "$(dirname "$0")/.."
-IN=/tmp/tpu_r04
-OUT=benchmarks/results
+# overridable for tests (tests/test_benchmarks.py harvests a fixture dir)
+IN=${TPU_R04_IN:-/tmp/tpu_r04}
+OUT=${TPU_R04_OUT:-benchmarks/results}
 
 copy_json() {  # copy_json <src> <dst> <must-contain>
   local src=$1 dst=$2 needle=$3

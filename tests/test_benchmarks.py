@@ -92,3 +92,39 @@ def test_queue_script_invokes_real_flags():
             used = set(re.findall(r"(--[a-z0-9-]+)", m.group(1)))
             assert used <= valid, (script, used - valid)
         assert found, f"{script} not invoked by the queue?"
+
+
+def test_harvest_rejects_degraded_headline(tmp_path):
+    """harvest_r04.sh must never bank a degraded CPU-fallback bench line
+    as r04_tpu_headline.json (bench.py cites that file back as
+    'recorded_tpu_evidence' — banking a degraded line would be circular).
+    Run the real script against fixture dirs both ways."""
+    import json
+    import subprocess
+    from pathlib import Path
+
+    repo = Path(__file__).parent.parent
+    fix_in = tmp_path / "in"
+    fix_out = tmp_path / "out"
+    fix_in.mkdir()
+    fix_out.mkdir()
+    env = {"TPU_R04_IN": str(fix_in), "TPU_R04_OUT": str(fix_out),
+           "PATH": "/usr/bin:/bin"}
+
+    degraded = {"metric": "m", "value": 2018.0, "unit": "reps/sec/chip",
+                "detail": {"degraded": "tpu-init-failed",
+                           "paths": {"xla": {"reps_per_sec": 2018.0}}}}
+    (fix_in / "bench_default.json").write_text(json.dumps(degraded))
+    subprocess.run(["bash", str(repo / "benchmarks" / "harvest_r04.sh")],
+                   capture_output=True, text=True, env=env, cwd=repo)
+    assert not (fix_out / "r04_tpu_headline.json").exists()
+
+    clean = {"metric": "m", "value": 981783.0, "unit": "reps/sec/chip",
+             "detail": {"device": "TPU_0",
+                        "paths": {"xla": {"reps_per_sec": 981783.0}}}}
+    (fix_in / "bench_default.json").write_text(json.dumps(clean))
+    subprocess.run(["bash", str(repo / "benchmarks" / "harvest_r04.sh")],
+                   capture_output=True, text=True, env=env, cwd=repo)
+    banked = fix_out / "r04_tpu_headline.json"
+    assert banked.exists()
+    assert json.loads(banked.read_text())["value"] == 981783.0

@@ -128,3 +128,43 @@ def test_harvest_rejects_degraded_headline(tmp_path):
     banked = fix_out / "r04_tpu_headline.json"
     assert banked.exists()
     assert json.loads(banked.read_text())["value"] == 981783.0
+
+
+def test_queue_resume_semantics(tmp_path):
+    """The r04 queue's wedge-resume contract (bash functions sourced with
+    a stubbed probe): ok-marked steps skip, a failure with the tunnel
+    alive marks .fail and continues, a failure with the tunnel dead sets
+    WEDGED (no marker — retried on next recovery) and suppresses every
+    later step; finished() requires a terminal marker per step."""
+    import subprocess
+    from pathlib import Path
+
+    repo = Path(__file__).parent.parent
+    script = f"""
+set -u
+export TPU_R04_IN={tmp_path}
+export TPU_R04_PROBE=true
+source {repo}/benchmarks/tpu_r04_queue.sh
+
+run_step s1 true
+run_step s2 false              # fails, probe says alive -> .fail
+run_step s1 false              # .ok marker -> must skip (cmd not run)
+export TPU_R04_PROBE=false
+run_step s3 false              # fails, probe dead -> wedge, no marker
+run_step s4 true               # suppressed by WEDGED (no marker)
+echo "WEDGED=$WEDGED"
+STEP_NAMES="s1 s2"; finished && echo "fin12=yes" || echo "fin12=no"
+STEP_NAMES="s1 s3"; finished && echo "fin13=yes" || echo "fin13=no"
+"""
+    r = subprocess.run(["bash", "-c", script], capture_output=True,
+                       text=True, cwd=repo)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert (tmp_path / "s1.ok").exists()
+    assert (tmp_path / "s2.fail").exists()
+    assert not (tmp_path / "s3.ok").exists()
+    assert not (tmp_path / "s3.fail").exists()   # wedge leaves no marker
+    assert not (tmp_path / "s4.ok").exists()     # suppressed
+    assert "WEDGED=1" in r.stdout
+    assert "fin12=yes" in r.stdout               # ok + fail = terminal
+    assert "fin13=no" in r.stdout                # wedged step unfinished
+    assert "s1: already done" in r.stdout

@@ -194,7 +194,13 @@ def main(argv=None):
                     help="full BASELINE.md rep counts (slow)")
     ap.add_argument("--b", type=int, default=None,
                     help="override rep counts (smoke testing)")
+    ap.add_argument("--platform", type=str, default=None,
+                    help="force a JAX platform (the site hook ignores "
+                         "JAX_PLATFORMS env; this applies config.update "
+                         "before the backend initializes)")
     args = ap.parse_args(argv)
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
     which = args.config or sorted(CONFIGS)
     print(json.dumps({"device": str(jax.devices()[0]),
                       "n_devices": jax.device_count(),

@@ -143,6 +143,10 @@ for i in $(seq 1 300); do
     echo "tunnel healthy at attempt $i ($(date -u +%H:%M:%SZ))"
     WEDGED=0
     all_steps
+    # harvest whatever is banked so far (idempotent; rejects degraded
+    # lines) — evidence must reach benchmarks/results/ the moment it
+    # exists, not only after a full queue pass survives the tunnel
+    bash benchmarks/harvest_r04.sh || true
     if finished; then
       ok=0; fail=0
       for s in $STEP_NAMES; do

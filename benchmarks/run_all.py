@@ -139,14 +139,13 @@ def config4(full: bool, b_override=None):
 def config5(full: bool, b_override=None):
     from dpcorr.sim import SimConfig
 
+    from dpcorr.sim import stress_chunk_size
+
     n = 1_000_000
     b = b_override or (256 if full else 32)
     target = 1_000_000  # BASELINE.md: 1M reps
-    # Replication vmap width: CPU caches want it small (b//8 measured best
-    # on this image); a TPU wants wide blocks — (chunk, 65536, 2) f32 at
-    # chunk=32 is ~17 MB resident per lax.map step, nowhere near HBM.
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    chunk_size = min(b, 32) if on_tpu else max(2, b // 8)
+    chunk_size = stress_chunk_size(b, on_tpu)
     # λ_n(n, η) = min(2η√(log n), 2√3) caps at 2√3 for every η ≳ 0.47 at
     # n=1e6 (ver-cor-subG.R:1), so sweep the region where the clip binds.
     for eta in (0.1, 0.25, 0.5):

@@ -138,10 +138,12 @@ def cmd_stress(args):
     from dpcorr.sim import SimConfig, run_sim_one
 
     b = args.b or 256
-    # replication vmap width: narrow on CPU (cache-measured), wide on TPU
-    # (same policy as benchmarks/run_all.py config 5)
+    # replication vmap width: sequential on CPU, wide on TPU — the single
+    # measured policy (dpcorr.sim.stress_chunk_size)
+    from dpcorr.sim import stress_chunk_size
+
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    chunk = args.chunk_size or (min(b, 32) if on_tpu else max(2, b // 8))
+    chunk = args.chunk_size or stress_chunk_size(b, on_tpu)
     cfg = SimConfig(
         n=args.n, rho=0.5, eps1=1.0, eps2=1.0, b=b,
         dgp="bounded_factor" if args.family == "subg" else "gaussian",

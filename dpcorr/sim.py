@@ -197,6 +197,21 @@ def _one_rep_streaming(key: jax.Array, rho: jax.Array, cfg: SimConfig):
     return ni, it
 
 
+def stress_chunk_size(b: int, on_tpu: bool) -> int:
+    """Replication vmap width for the streaming stress path (BASELINE
+    config 5, ``stream_n_chunk`` set). A TPU wants wide blocks — (chunk,
+    65536, 2) f32 at chunk=32 is ~17 MB resident per ``lax.map`` step,
+    nowhere near HBM. On CPU the opposite: vmapping even a few
+    replications interleaves their n-chunk scan states and evicts each
+    other's cache lines, so sequential reps win — measured 2026-07-31 at
+    n=10⁶ with the fused subG pair: chunk 1 → 31.9 reps/sec, 2 → 30.1,
+    4 → 22.0, 8 → 20.8, 32 (the previous b//8 policy at b=256) → ~16.
+    The pre-r04 ``b//8`` rule was tuned against the separate streaming
+    kernels; the fused pair's single-pass state is exactly what a core's
+    cache can hold once."""
+    return min(b, 32) if on_tpu else 1
+
+
 def chunked_vmap(fn: Callable, args, chunk_size: int):
     """``vmap(fn)`` over axis 0, blocked into ``lax.map`` chunks.
 

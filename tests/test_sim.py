@@ -141,3 +141,15 @@ class TestGoldenCoverage:
         mc = run_sim_one(SimConfig(**base, mixquant_mode="mc"))
         diff = abs(det.summary["INT"]["coverage"] - mc.summary["INT"]["coverage"])
         assert diff < 0.05, diff
+
+
+def test_stress_chunk_size_policy():
+    """The streaming stress path's replication width: wide on TPU,
+    sequential on CPU (measured 2026-07-31: chunk 1 is 1.7x the old b//8
+    rule at n=1e6 with the fused subG pair — interleaved scan states
+    evict each other's cache lines)."""
+    from dpcorr.sim import stress_chunk_size
+
+    assert stress_chunk_size(256, on_tpu=False) == 1
+    assert stress_chunk_size(256, on_tpu=True) == 32
+    assert stress_chunk_size(8, on_tpu=True) == 8

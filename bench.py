@@ -59,10 +59,14 @@ MAX_BLOCKS = 32
 # baseline) with stable coverage; 2^20 exceeded the worker timeout through
 # the tunnel. Overridable for tuning runs without editing:
 # DPCORR_BENCH_BLOCK_REPS / DPCORR_BENCH_CHUNK.
-# The CPU fallback shape is measured-optimal too (2026-07-30 sweep on this
-# image: 2048/256 → 2282 reps/s; 4096/512 → 1955; 8192/1024 → 1527 —
-# bigger chunks thrash CPU caches, the opposite of the TPU trend).
-WORKER_SHAPE = {"tpu": (512 * 1024, 16384), "cpu": (2048, 256)}
+# The CPU fallback shape is measured-optimal too. 2026-07-31 sweep (the
+# r04 streaming-width finding applied here: at n=10⁴ a 256-wide vmap
+# chunk holds ~20 MB of live sample tables — far past L2): chunk 256 →
+# 2283 reps/s, 64 → 2445, 32 → 2532; at chunk 32-64, block 8192 → 2577
+# (2026-07-30's 2048/256 → 2282 baseline; bigger CHUNKS thrash CPU
+# caches — the opposite of the TPU trend — while bigger BLOCKS amortize
+# dispatch once the chunk fits).
+WORKER_SHAPE = {"tpu": (512 * 1024, 16384), "cpu": (8192, 64)}
 
 
 def _worker_shape(mode: str) -> tuple[int, int]:

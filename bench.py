@@ -26,8 +26,13 @@ Inside a worker, two implementations are raced on TPU:
   hardware PRNG — TPU only; measured in its *own* bounded subprocess
   (a Mosaic compile hang has been observed to wedge the remote backend —
   isolation keeps the XLA number safe); any failure falls back to xla with
-  the failure recorded in the JSON detail. ``DPCORR_BENCH_SKIP_PALLAS=1``
-  skips the attempt entirely.
+  the failure recorded in the JSON detail. **Opt-in** since r04
+  (``DPCORR_BENCH_PALLAS=1``): three rounds of measurement put pallas at
+  ≤0.98× xla on this workload (r02_grid_fused_tpu.json), and the r04
+  session observed the tunnel wedge immediately after a killed 465 s
+  Mosaic compile — an unattended driver run must not pay that risk for a
+  path that has never held the headline. ``--worker tpu-pallas`` (the
+  queue's explicit A/B) is unaffected.
 
 Each path compiles one fixed-size block, calibrates its wall-clock, then
 dispatches its share of the time budget asynchronously with a single fetch
@@ -282,8 +287,11 @@ def _sane(means, ref_means) -> bool:
 def _merge_pallas(out: dict, budget_s: float) -> None:
     """Run the pallas worker (its own process + TPU client) and fold its
     result into the tpu worker's measurement, keeping the faster path."""
-    if os.environ.get("DPCORR_BENCH_SKIP_PALLAS"):
-        out["detail"]["pallas_skipped"] = "skipped (DPCORR_BENCH_SKIP_PALLAS)"
+    if os.environ.get("DPCORR_BENCH_PALLAS", "").lower() in ("", "0", "false"):
+        out["detail"]["pallas_skipped"] = (
+            "not attempted (opt in: DPCORR_BENCH_PALLAS=1); measured <=0.98x "
+            "xla r02-r03 and a killed Mosaic compile is the leading "
+            "tunnel-wedge suspect (STATUS_r04.md)")
         return
     p_out, p_err = _run_worker("tpu-pallas",
                                timeout_s=420 + 1.5 * budget_s,

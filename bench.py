@@ -317,6 +317,28 @@ def _merge_pallas(out: dict, budget_s: float) -> None:
 # Orchestrator: bounded-time worker attempts, guaranteed rc=0 + JSON.
 # --------------------------------------------------------------------------
 
+def _reap(p) -> None:
+    """Kill a worker's whole process group and wait for it.
+
+    Must never block the orchestrator forever: if the group kill is
+    refused (PermissionError), fall back to killing the direct child, and
+    bound the wait — a reap that cannot finish should not turn a degrade
+    path into a hang.
+    """
+    import signal
+
+    try:
+        os.killpg(p.pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    except PermissionError:
+        p.kill()
+    try:
+        p.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        pass
+
+
 def _run_worker(mode: str, timeout_s: float, budget_s: float):
     """Spawn a worker; return (parsed JSON, None) or (None, error string).
 
@@ -326,6 +348,13 @@ def _run_worker(mode: str, timeout_s: float, budget_s: float):
     wedge the next attempt. The tpu-pallas probe runs as a *sibling*
     worker via this same path after the tpu worker exits (see
     ``_merge_pallas``), never nested inside it.
+
+    The worker must die with the orchestrator, too: an r04 session caught
+    an externally SIGTERM'd orchestrator (a queue step `timeout`) leaving
+    its detached worker alive for 13+ minutes, holding the exclusive TPU
+    client — i.e. exactly the mid-queue wedge the markers blame on the
+    tunnel. ``main`` converts SIGTERM into SystemExit so the ``finally``
+    here reaps the group on every exit path short of SIGKILL.
     """
     cmd = [sys.executable, os.path.abspath(__file__),
            "--worker", mode, "--budget", str(budget_s)]
@@ -336,16 +365,16 @@ def _run_worker(mode: str, timeout_s: float, budget_s: float):
     except Exception as e:  # spawn failure itself
         return None, f"{mode} worker: {type(e).__name__}: {e}"[:300]
     try:
-        stdout, stderr = p.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        import signal
-
         try:
-            os.killpg(p.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-        p.wait()
-        return None, f"{mode} worker: timeout after {timeout_s:.0f}s"
+            stdout, stderr = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            _reap(p)
+            return None, f"{mode} worker: timeout after {timeout_s:.0f}s"
+    finally:
+        # reaps on SIGTERM-as-SystemExit, KeyboardInterrupt, or any bug in
+        # the orchestrator itself — not just the worker's own timeout
+        if p.poll() is None:
+            _reap(p)
     if p.returncode != 0:
         tail = (stderr or "").strip().splitlines()[-3:]
         return None, (f"{mode} worker: rc={p.returncode}: "
@@ -393,16 +422,13 @@ def _health_probe(timeout_s: float = 150.0) -> bool:
         # process group, or a leaked child keeps the TPU tunnel handle the
         # probe exists to quarantine
         if p is not None and p.poll() is None:
-            import signal
-
-            try:
-                os.killpg(p.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
-            p.wait()
+            _reap(p)
 
 
 def main() -> None:
+    # An external SIGTERM (queue step `timeout`, driver cleanup) must not
+    # strand a detached worker holding the exclusive TPU client: convert
+    # it to SystemExit so _run_worker's finally reaps the group.
     ap = argparse.ArgumentParser()
     ap.add_argument("--worker", choices=["tpu", "tpu-pallas", "cpu"],
                     default=None)
@@ -413,6 +439,19 @@ def main() -> None:
     if args.worker:
         worker_main(args.worker, args.budget)
         return
+
+    # Orchestrator only — a worker must keep SIG_DFL so a direct SIGTERM
+    # still kills it even when it's wedged inside a native Mosaic compile
+    # (a Python-level handler can't run while C code holds the GIL).
+    import signal
+
+    def _sigterm_to_exit(signum, frame):
+        # latch: ignore further SIGTERMs so a second one cannot abort the
+        # finally-block reap in _run_worker and strand the worker anyway
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        raise SystemExit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _sigterm_to_exit)
 
     attempts = []
     # Attempt 1: TPU, full budget, XLA path only. Init alone can take

@@ -38,8 +38,16 @@ def _run_main(monkeypatch, capsys, responses, healthy=True, pallas=True):
     monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
     monkeypatch.setattr(bench, "_health_probe", lambda: healthy)
     monkeypatch.setattr(sys, "argv", ["bench.py"])
-    with pytest.raises(SystemExit) as exc:
-        bench.main()
+    import signal
+
+    prev_sigterm = signal.getsignal(signal.SIGTERM)
+    try:
+        with pytest.raises(SystemExit) as exc:
+            bench.main()
+    finally:
+        # main() installs a process-global SIGTERM handler; the pytest
+        # process must not keep it beyond the test
+        signal.signal(signal.SIGTERM, prev_sigterm)
     assert exc.value.code == 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     return json.loads(line), calls, timeouts
@@ -99,6 +107,60 @@ def test_pallas_insane_stats_rejected(monkeypatch, capsys):
     assert out["value"] == 5000.0
     assert out["detail"]["path"] == "xla"
     assert "sanity" in out["detail"]["pallas_skipped"]
+
+
+def test_run_worker_reaps_on_orchestrator_death(monkeypatch):
+    """A dying orchestrator must take its detached worker down with it.
+
+    r04 incident: an external SIGTERM (queue step `timeout`) killed the
+    orchestrator mid-communicate and the stranded worker held the
+    exclusive TPU client for 13+ minutes — a self-inflicted tunnel wedge.
+    main() converts SIGTERM to SystemExit; this pins that _run_worker's
+    finally then reaps the worker's whole process group.
+    """
+    spawned = []
+    real_popen = subprocess.Popen
+
+    class DyingPopen(real_popen):
+        def communicate(self, timeout=None):
+            raise SystemExit(143)  # what main()'s SIGTERM handler raises
+
+    def fake_popen(cmd, **kw):
+        p = DyingPopen(["sleep", "60"], start_new_session=True)
+        spawned.append(p)
+        return p
+
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    with pytest.raises(SystemExit):
+        bench._run_worker("tpu", timeout_s=5, budget_s=1)
+    (p,) = spawned
+    assert p.poll() == -9  # SIGKILLed by _reap, not still sleeping
+
+
+def test_main_installs_sigterm_handler(monkeypatch, capsys):
+    """Orchestrator path installs the handler; _run_main restores it."""
+    import signal
+
+    prev = signal.getsignal(signal.SIGTERM)
+    seen = {}
+
+    def fake_run_worker(mode, timeout_s, budget_s):
+        seen["handler"] = signal.getsignal(signal.SIGTERM)
+        return _good(), None
+
+    monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
+    monkeypatch.setattr(bench, "_health_probe", lambda: True)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("DPCORR_BENCH_PALLAS", raising=False)
+    try:
+        with pytest.raises(SystemExit):
+            bench.main()
+        # handler was live while workers ran...
+        assert callable(seen["handler"]) and seen["handler"] != signal.SIG_DFL
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    # ...and _run_main-style restoration leaves the process unpolluted
+    assert signal.getsignal(signal.SIGTERM) == prev
 
 
 def test_pallas_opt_in_default(monkeypatch, capsys):

@@ -368,7 +368,8 @@ def _run_worker(mode: str, timeout_s: float, budget_s: float):
         try:
             stdout, stderr = p.communicate(timeout=timeout_s)
         except subprocess.TimeoutExpired:
-            _reap(p)
+            # the finally below reaps before this return completes; no
+            # explicit _reap here or an unkillable worker doubles the wait
             return None, f"{mode} worker: timeout after {timeout_s:.0f}s"
     finally:
         # reaps on SIGTERM-as-SystemExit, KeyboardInterrupt, or any bug in

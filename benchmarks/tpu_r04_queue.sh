@@ -30,8 +30,29 @@ set -u -o pipefail
 OUT=${TPU_R04_IN:-/tmp/tpu_r04}
 mkdir -p "$OUT"
 
+sweep_strays() {
+  # A bench worker whose orchestrator is gone (reparented to init) holds
+  # the exclusive TPU client forever and is indistinguishable from a
+  # wedged tunnel (observed live in r04: a SIGKILLed orchestrator
+  # stranded its setsid worker). bench.py now reaps its workers on every
+  # catchable death; this sweeps the uncatchable (SIGKILL) leftovers.
+  # The ppid==1 test is the real guard: every live harness/driver shell
+  # has a live parent, and the adjacent "bench.py --worker" token pair
+  # appears in no driver command line — so no interpreter-path anchor,
+  # which would silently no-op wherever the venv lives elsewhere and
+  # miss the queue's own direct 'python bench.py --worker' steps.
+  local pid
+  for pid in $(pgrep -f "bench\.py --worker" 2>/dev/null); do
+    [ "$pid" = "$$" ] && continue
+    if [ "$(ps -o ppid= -p "$pid" 2>/dev/null | tr -d ' ')" = "1" ]; then
+      kill -9 "$pid" 2>/dev/null && echo "swept stray TPU client $pid ($(date -u +%H:%M:%SZ))"
+    fi
+  done
+}
+
 probe() {
   if [ -n "${TPU_R04_PROBE:-}" ]; then eval "$TPU_R04_PROBE"; return; fi
+  sweep_strays
   timeout 150 python -c \
     "import jax; assert jax.devices()[0].platform in ('tpu','axon'); import jax.numpy as jnp; print(float((jnp.ones((128,128))@jnp.ones((128,128))).sum()))" \
     >/dev/null 2>&1

@@ -155,12 +155,10 @@ def test_main_installs_sigterm_handler(monkeypatch, capsys):
     try:
         with pytest.raises(SystemExit):
             bench.main()
-        # handler was live while workers ran...
+        # the handler must be live while workers run
         assert callable(seen["handler"]) and seen["handler"] != signal.SIG_DFL
     finally:
         signal.signal(signal.SIGTERM, prev)
-    # ...and _run_main-style restoration leaves the process unpolluted
-    assert signal.getsignal(signal.SIGTERM) == prev
 
 
 def test_pallas_opt_in_default(monkeypatch, capsys):

@@ -400,6 +400,43 @@ PROBE_CODE = (
     "print(float((jnp.ones((128,128))@jnp.ones((128,128))).sum()))")
 
 
+def _sweep_stranded_clients() -> list:
+    """Kill bench workers orphaned by an earlier uncatchable orchestrator
+    death (reparented to init). Such a worker holds the exclusive TPU
+    client and makes a healthy tunnel probe as dead — observed live in
+    r04, where one stranded worker read as a 13-minute tunnel wedge.
+    Mirrors ``sweep_strays`` in benchmarks/tpu_r04_queue.sh; running it
+    before the health probe makes the driver's unattended round-end run
+    self-healing. Returns the swept pids (for the JSON forensics)."""
+    import signal
+
+    swept = []
+    me = os.getpid()
+    try:
+        pids = [int(d) for d in os.listdir("/proc") if d.isdigit()]
+    except OSError:  # non-procfs platform: nothing to sweep
+        return swept
+    for pid in pids:
+        if pid == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                argv = fh.read().split(b"\0")
+            with open(f"/proc/{pid}/stat") as fh:
+                ppid = int(fh.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, ValueError, IndexError):
+            continue  # raced exit or unreadable — not ours to touch
+        cmd = [a.decode(errors="replace") for a in argv if a]
+        if (ppid == 1 and len(cmd) >= 3 and "--worker" in cmd
+                and any(a.endswith("bench.py") for a in cmd)):
+            try:
+                os.kill(pid, signal.SIGKILL)
+                swept.append(pid)
+            except (ProcessLookupError, PermissionError):
+                pass
+    return swept
+
+
 def _health_probe(timeout_s: float = 150.0) -> bool:
     """Bounded TPU-liveness probe in a throwaway process group (the same
     one-matmul check ``benchmarks/tpu_revalidate.sh`` polls with). Its
@@ -461,6 +498,7 @@ def main() -> None:
     # isn't killed mid-measurement. A positive health probe extends the
     # leash (tunnel alive ⇒ timeouts would only kill slow-but-working
     # runs); a negative one keeps it short for a fast CPU degrade.
+    swept = _sweep_stranded_clients()
     healthy = _health_probe()
     first_base = 900 if healthy else 420
     out, err = _run_worker("tpu", timeout_s=first_base + 2.5 * args.budget,
@@ -505,6 +543,8 @@ def main() -> None:
         out.setdefault("detail", {})["attempts"] = attempts
     out.setdefault("detail", {})["tunnel_health_probe"] = (
         "ok" if healthy else "failed")
+    if swept:
+        out["detail"]["swept_stranded_clients"] = swept
     try:  # provenance: which revision this measurement describes
         rev = subprocess.run(
             ["git", "-C", os.path.dirname(os.path.abspath(__file__)),

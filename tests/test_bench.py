@@ -8,6 +8,7 @@ via the CPU path in ``test_cpu_worker_smoke`` (marked slow).
 """
 
 import json
+import os
 import subprocess
 import sys
 
@@ -37,6 +38,7 @@ def _run_main(monkeypatch, capsys, responses, healthy=True, pallas=True):
 
     monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
     monkeypatch.setattr(bench, "_health_probe", lambda: healthy)
+    monkeypatch.setattr(bench, "_sweep_stranded_clients", lambda: [])
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     import signal
 
@@ -159,6 +161,63 @@ def test_main_installs_sigterm_handler(monkeypatch, capsys):
         assert callable(seen["handler"]) and seen["handler"] != signal.SIG_DFL
     finally:
         signal.signal(signal.SIGTERM, prev)
+
+
+def test_sweep_stranded_clients():
+    """The sweep kills an init-reparented bench worker and nothing else.
+
+    Spawns a real double-forked `bench.py --worker cpu` (parent exits
+    immediately, so the grandchild reparents to init — the exact stranded
+    state an uncatchable orchestrator death leaves behind) and asserts
+    the sweep takes it down while sparing this live-parented process.
+    """
+    import time
+
+    bench_py = bench.__file__
+    # double-fork via an intermediate python -c that exits at once
+    inter = subprocess.Popen(
+        [sys.executable, "-c",
+         "import subprocess, sys;"
+         f"subprocess.Popen([sys.executable, {bench_py!r},"
+         " '--worker', 'cpu', '--budget', '30'],"
+         " stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,"
+         " start_new_session=True)"])
+    inter.wait()
+    deadline = time.time() + 10
+    stray = None
+    try:
+        while time.time() < deadline and stray is None:
+            for pid in (int(d) for d in os.listdir("/proc") if d.isdigit()):
+                try:
+                    with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                        cmd = fh.read().decode(errors="replace")
+                    with open(f"/proc/{pid}/stat") as fh:
+                        ppid = int(fh.read().rsplit(")", 1)[1].split()[1])
+                except (OSError, ValueError, IndexError):
+                    continue
+                if "--worker" in cmd and "bench.py" in cmd and ppid == 1:
+                    stray = pid
+                    break
+            time.sleep(0.2)
+        assert stray is not None, \
+            "double-forked worker never reparented to init"
+        swept = bench._sweep_stranded_clients()
+        assert stray in swept
+        time.sleep(0.5)
+        # dead, or at worst a not-yet-reaped zombie; init may reap between
+        # the existence check and the read, so treat a vanished /proc
+        # entry as success too
+        try:
+            with open(f"/proc/{stray}/stat") as fh:
+                assert fh.read().rsplit(")", 1)[1].split()[0] == "Z"
+        except OSError:
+            pass  # already reaped — swept successfully
+    finally:
+        if stray is not None:  # never leak the real worker on test failure
+            try:
+                os.kill(stray, 9)
+            except (ProcessLookupError, PermissionError):
+                pass
 
 
 def test_pallas_opt_in_default(monkeypatch, capsys):

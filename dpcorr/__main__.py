@@ -259,7 +259,10 @@ def _maybe_compile_cache() -> None:
     import os
 
     cache_dir = os.environ.get("DPCORR_COMPILE_CACHE")
-    if cache_dir:
+    # =0/off/none means "disabled" everywhere this env var is read
+    # (bench.py defaults the cache ON, so a user who exported a disable
+    # token must not get a literal './off' cache dir here)
+    if cache_dir and cache_dir.lower() not in ("0", "off", "none"):
         import jax
 
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -186,23 +186,40 @@ def probe_level(level: str) -> dict:
 
 def _run(cmd: list[str], timeout_s: float):
     """Run cmd in its own process group; kill the whole group on timeout.
-    Returns (rc | None-on-timeout, stdout, stderr, elapsed)."""
+    Returns (rc | None-on-timeout, stdout, stderr, elapsed).
+
+    The group must also die with *us* (bench.py's r04 stranded-client
+    lesson): a probe orphaned by an external SIGTERM/ctrl-C would hold
+    the exclusive TPU client and read as a wedged tunnel afterwards."""
     t0 = time.time()
     p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                          stderr=subprocess.PIPE, text=True,
                          start_new_session=True)
     try:
-        so, se = p.communicate(timeout=timeout_s)
-        return p.returncode, so, se, time.time() - t0
-    except subprocess.TimeoutExpired:
         try:
-            os.killpg(p.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-        # drain whatever the probe printed before the kill — the hanging
-        # probe is exactly the one whose partial output matters
-        so, se = p.communicate()
-        return None, so or "", se or "", time.time() - t0
+            so, se = p.communicate(timeout=timeout_s)
+            return p.returncode, so, se, time.time() - t0
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            # drain whatever the probe printed before the kill — the
+            # hanging probe is exactly the one whose partial output matters
+            so, se = p.communicate()
+            return None, so or "", se or "", time.time() - t0
+    finally:
+        if p.poll() is None:  # abnormal exit path (signal, bug): reap
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            except PermissionError:
+                p.kill()
+            try:  # bounded: an unkillable probe must not hang shutdown
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
 
 
 def health_check() -> tuple[bool, float]:
@@ -221,8 +238,20 @@ def main() -> None:
     args = ap.parse_args()
 
     if args.level:
+        # probe worker: keep SIG_DFL — a Python handler could never run
+        # while wedged inside a native Mosaic compile, which would make
+        # the probe unkillable by SIGTERM (bench.py's r04 lesson)
         print(json.dumps(probe_level(args.level)), flush=True)
         return
+
+    # orchestrator only — same contract as bench.py: SIGTERM must run
+    # _run's finally so a killed bisect can't strand a probe holding the
+    # TPU client (latched against double delivery)
+    def _sigterm_to_exit(signum, frame):
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        raise SystemExit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _sigterm_to_exit)
 
     report = {"config": {"n": N, "eps1": EPS1, "eps2": EPS2},
               "probes": [], "culprit": None, "wedged": False}

@@ -134,8 +134,11 @@ def test_queue_resume_semantics(tmp_path):
     """The r04 queue's wedge-resume contract (bash functions sourced with
     a stubbed probe): ok-marked steps skip, a failure with the tunnel
     alive marks .fail and continues, a failure with the tunnel dead sets
-    WEDGED (no marker — retried on next recovery) and suppresses every
-    later step; finished() requires a terminal marker per step."""
+    WEDGED and suppresses every later step; finished() requires a
+    terminal marker per step. Wedges normally leave no marker (retried
+    on next recovery) — EXCEPT for MOSAIC_STEPS members, where the third
+    wedge on the same step trips a cap and writes .fail (the step is
+    classified as the wedge's cause; see tpu_r04_queue.sh header)."""
     import subprocess
     from pathlib import Path
 
@@ -146,6 +149,8 @@ export TPU_R04_IN={tmp_path}
 export TPU_R04_PROBE=true
 source {repo}/benchmarks/tpu_r04_queue.sh
 
+MOSAIC_STEPS="s3"              # s3 plays a Mosaic-risky step; s5 pure-XLA
+
 run_step s1 true
 run_step s2 false              # fails, probe says alive -> .fail
 run_step s1 false              # .ok marker -> must skip (cmd not run)
@@ -153,8 +158,20 @@ export TPU_R04_PROBE=false
 run_step s3 false              # fails, probe dead -> wedge, no marker
 run_step s4 true               # suppressed by WEDGED (no marker)
 echo "WEDGED=$WEDGED"
+WEDGED=0                       # simulate the next recovery pass
+run_step s5 false              # XLA step wedges...
+WEDGED=0
+run_step s5 false              # ...twice...
+WEDGED=0
+run_step s5 false              # ...thrice: NOT capped, still no marker
+WEDGED=0
+run_step s3 false              # second wedge on s3: below cap, no marker
+WEDGED=0
+run_step s3 false              # third wedge on s3 -> capped, .fail
+echo "WEDGED2=$WEDGED"
 STEP_NAMES="s1 s2"; finished && echo "fin12=yes" || echo "fin12=no"
 STEP_NAMES="s1 s3"; finished && echo "fin13=yes" || echo "fin13=no"
+STEP_NAMES="s1 s5"; finished && echo "fin15=yes" || echo "fin15=no"
 """
     r = subprocess.run(["bash", "-c", script], capture_output=True,
                        text=True, cwd=repo)
@@ -162,9 +179,19 @@ STEP_NAMES="s1 s3"; finished && echo "fin13=yes" || echo "fin13=no"
     assert (tmp_path / "s1.ok").exists()
     assert (tmp_path / "s2.fail").exists()
     assert not (tmp_path / "s3.ok").exists()
-    assert not (tmp_path / "s3.fail").exists()   # wedge leaves no marker
     assert not (tmp_path / "s4.ok").exists()     # suppressed
     assert "WEDGED=1" in r.stdout
+    # early wedges leave no terminal marker (retried on recovery); the
+    # THIRD wedge on a MOSAIC_STEPS member trips the cap -> .fail, so a
+    # deterministically-wedging Mosaic compile cannot livelock the queue
+    assert (tmp_path / "s3.wedges").read_text().strip() == "3"
+    assert (tmp_path / "s3.fail").exists()
+    # ...but a pure-XLA step is NEVER capped: tunnel wedges during long
+    # XLA runs are load-induced flakiness, not the step's fault
+    assert not (tmp_path / "s5.fail").exists()
+    assert not (tmp_path / "s5.wedges").exists()
+    assert "WEDGED2=1" in r.stdout
     assert "fin12=yes" in r.stdout               # ok + fail = terminal
-    assert "fin13=no" in r.stdout                # wedged step unfinished
+    assert "fin13=yes" in r.stdout               # capped wedge is terminal
+    assert "fin15=no" in r.stdout                # uncapped wedge retried
     assert "s1: already done" in r.stdout

@@ -1,0 +1,114 @@
+"""Summarize a ``jax.profiler`` trace directory into an op-time table.
+
+Turns the Perfetto-style ``*.trace.json.gz`` that ``jax.profiler.trace``
+writes (under ``<dir>/plugins/profile/<ts>/``) into the numbers
+PERFORMANCE.md §roofline cites: total wall window, device-resident time
+of the jit'd program, and the top fusions by accumulated duration.
+
+The reference has no profiling at all (SURVEY.md §5 "Tracing/profiling:
+absent"); this is the TPU build's observability half of that subsystem —
+`benchmarks/roofline.py` captures, this file reduces.
+
+Usage::
+
+    python -m benchmarks.trace_summary benchmarks/results/trace_r04
+    python -m benchmarks.trace_summary <dir> --top 10 --json
+
+Heuristics (kept deliberately simple and assert-guarded): JAX emits the
+compiled program as a ``jit_<name>(...)`` slice with XLA ops
+(``fusion.N``, ``while.N``, ...) nested under it; Python-side frames
+carry ``$file.py:line`` names. We classify a slice as *device op* when
+its name matches an XLA opcode pattern and as *program* when it matches
+``jit_`` / ``while`` wrappers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+
+# XLA HLO-ish slice names: fusion.12, select_multiply_fusion.2, copy.3,
+# while.6, dynamic-update-slice.1 ...
+_XLA_RE = re.compile(r"^[a-z][a-z0-9_.-]*\.\d+$")
+_PROGRAM_RE = re.compile(r"^(jit_?|while\.)")
+
+
+def find_trace_file(trace_dir: str) -> str:
+    """Locate the newest ``*.trace.json.gz`` under a profiler dir."""
+    pats = [os.path.join(trace_dir, "plugins", "profile", "*", "*.trace.json.gz"),
+            os.path.join(trace_dir, "*.trace.json.gz")]
+    hits: list[str] = []
+    for p in pats:
+        hits.extend(glob.glob(p))
+    if not hits:
+        raise FileNotFoundError(f"no *.trace.json.gz under {trace_dir!r}")
+    return max(hits, key=os.path.getmtime)
+
+
+def summarize_trace(trace_dir: str, top: int = 8) -> dict:
+    """Reduce a trace dir to {window_ms, program_ms, device_busy_frac,
+    top_ops: [{name, ms, frac_of_program}]}."""
+    path = find_trace_file(trace_dir)
+    with gzip.open(path, "rt") as f:
+        events = json.load(f).get("traceEvents", [])
+
+    dur = collections.Counter()   # name -> total usec (complete events)
+    t0, t1 = float("inf"), 0.0
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        ts, d = e.get("ts", 0.0), e["dur"]
+        t0, t1 = min(t0, ts), max(t1, ts + d)
+        dur[e.get("name", "?")] += d
+
+    window_us = max(t1 - t0, 0.0) if events else 0.0
+    # jit_* wrapper and its while body both cover the same wall span;
+    # take the max single program slice family, not the sum of nestings
+    program_us = max((d for name, d in dur.items()
+                      if _PROGRAM_RE.match(name)), default=0.0)
+
+    ops = [(name, d) for name, d in dur.items()
+           if _XLA_RE.match(name) and not _PROGRAM_RE.match(name)]
+    ops.sort(key=lambda kv: kv[1], reverse=True)
+
+    return {
+        "trace_file": path,
+        "window_ms": round(window_us / 1e3, 2),
+        "program_ms": round(program_us / 1e3, 2),
+        "device_busy_frac": round(program_us / window_us, 3) if window_us else 0.0,
+        "top_ops": [
+            {"name": name, "ms": round(d / 1e3, 2),
+             "frac_of_program": round(d / program_us, 3) if program_us else 0.0}
+            for name, d in ops[:top]
+        ],
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=8)
+    ap.add_argument("--json", action="store_true",
+                    help="print the full summary as one JSON object")
+    args = ap.parse_args()
+
+    s = summarize_trace(args.trace_dir, args.top)
+    if args.json:
+        print(json.dumps(s))
+        return
+    print(f"trace   : {s['trace_file']}")
+    print(f"window  : {s['window_ms']:.1f} ms   "
+          f"program: {s['program_ms']:.1f} ms   "
+          f"device-busy: {100 * s['device_busy_frac']:.0f}%")
+    for op in s["top_ops"]:
+        print(f"  {op['ms']:10.2f} ms  {100 * op['frac_of_program']:5.1f}%  "
+              f"{op['name']}")
+
+
+if __name__ == "__main__":
+    main()

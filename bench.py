@@ -175,12 +175,14 @@ def worker_main(mode: str, budget_s: float) -> None:
     # round-end run even across git revisions. Per-user (not a fixed
     # world-shared /tmp name) so another account can neither collide with
     # nor pre-plant entries in it. DPCORR_COMPILE_CACHE=dir overrides the
-    # path; =0/off/none disables (same parsing as the dpcorr CLI).
-    cache_env = os.environ.get("DPCORR_COMPILE_CACHE", "")
-    if cache_env.lower() not in ("0", "off", "none"):
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            cache_env or os.path.expanduser("~/.cache/dpcorr/xla"))
+    # path; =0/off/none disables. Parsing lives canonically in
+    # dpcorr.utils.doctor (one rule, three consumers: bench default-ON,
+    # dpcorr CLI opt-in, doctor's report of both).
+    from dpcorr.utils.doctor import resolve_cache_dir
+
+    cache_dir = resolve_cache_dir("bench")
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     if mode == "cpu":
@@ -413,36 +415,15 @@ def _sweep_stranded_clients() -> list:
     death (reparented to init). Such a worker holds the exclusive TPU
     client and makes a healthy tunnel probe as dead — observed live in
     r04, where one stranded worker read as a 13-minute tunnel wedge.
-    Mirrors ``sweep_strays`` in benchmarks/tpu_r04_queue.sh; running it
-    before the health probe makes the driver's unattended round-end run
-    self-healing. Returns the swept pids (for the JSON forensics)."""
-    import signal
+    Running it before the health probe makes the driver's unattended
+    round-end run self-healing. Returns the swept pids (for the JSON
+    forensics). The match rule lives canonically in
+    ``dpcorr.utils.doctor`` (``benchmarks/tpu_r04_queue.sh`` mirrors it
+    in shell); keeping one Python implementation stops the three copies
+    drifting apart."""
+    from dpcorr.utils.doctor import find_stray_workers, sweep_strays
 
-    swept = []
-    me = os.getpid()
-    try:
-        pids = [int(d) for d in os.listdir("/proc") if d.isdigit()]
-    except OSError:  # non-procfs platform: nothing to sweep
-        return swept
-    for pid in pids:
-        if pid == me:
-            continue
-        try:
-            with open(f"/proc/{pid}/cmdline", "rb") as fh:
-                argv = fh.read().split(b"\0")
-            with open(f"/proc/{pid}/stat") as fh:
-                ppid = int(fh.read().rsplit(")", 1)[1].split()[1])
-        except (OSError, ValueError, IndexError):
-            continue  # raced exit or unreadable — not ours to touch
-        cmd = [a.decode(errors="replace") for a in argv if a]
-        if (ppid == 1 and len(cmd) >= 3 and "--worker" in cmd
-                and any(a.endswith("bench.py") for a in cmd)):
-            try:
-                os.kill(pid, signal.SIGKILL)
-                swept.append(pid)
-            except (ProcessLookupError, PermissionError):
-                pass
-    return swept
+    return sweep_strays(find_stray_workers())
 
 
 def _health_probe(timeout_s: float = 150.0) -> bool:

@@ -29,4 +29,14 @@ Package map (see SURVEY.md §7 for the blueprint):
 
 __version__ = "0.1.0"
 
-from dpcorr.utils.rng import MASTER_SEED  # noqa: F401
+
+def __getattr__(name):  # PEP 562: lazy re-export
+    """``dpcorr.MASTER_SEED`` without importing JAX at package-import
+    time — keeps JAX-free consumers (``dpcorr.utils.doctor``, the bench
+    orchestrator's stray sweep, ``python -m dpcorr doctor``) from paying
+    the jax import (and, on machines without the site-hook preload,
+    from pulling jax into processes that never touch a device)."""
+    if name == "MASTER_SEED":
+        from dpcorr.utils.rng import MASTER_SEED
+        return MASTER_SEED
+    raise AttributeError(f"module 'dpcorr' has no attribute {name!r}")

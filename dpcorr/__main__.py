@@ -9,6 +9,10 @@ Replaces the reference's "source the script" workflow (README.md:28-46):
 - ``grid-subg``   v2 bounded-factor sub-Gaussian grid (ver-cor-subG.R:245-436)
 - ``hrs``         HRS point estimates (real-data-sims.R:259-333)
 - ``hrs-sweep``   HRS ε-sweep + panels (real-data-sims.R:342-506)
+- ``doctor``      environment health triage (tunnel endpoint, stray TPU
+                  clients, compile cache, queue markers; no reference
+                  analogue — SURVEY.md §5 failure detection is absent
+                  there)
 
 Grids persist per-design-point ``.npz`` + parquet tables into ``--out`` and
 resume from them (the reference only saves one blob at the end).
@@ -187,9 +191,43 @@ def cmd_hrs_sweep(args):
         print("figures:", *(str(p) for p in paths))
 
 
+def cmd_doctor(args):
+    from dpcorr.utils import doctor
+
+    report = doctor.diagnose(probe=args.probe, sweep=args.sweep,
+                             queue_dir=args.queue_dir)
+    try:
+        if args.json:
+            print(json.dumps(report))
+        else:
+            print(doctor.render_text(report))
+    except BrokenPipeError:
+        pass  # `dpcorr doctor | head` must not stack-trace
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="dpcorr")
     sub = ap.add_subparsers(dest="cmd", required=True)
+
+    # doctor takes none of the common flags (no JAX import unless --probe)
+    pd_ = sub.add_parser("doctor", help="environment health report "
+                         "(tunnel endpoint, stray TPU clients, compile "
+                         "cache, queue markers)")
+    pd_.add_argument("--probe", action="store_true",
+                     help="also run the authoritative device probe "
+                          "(subprocess, 150s hard timeout)")
+    pd_.add_argument("--sweep", action="store_true",
+                     help="kill stray bench workers holding the TPU client")
+    pd_.add_argument("--json", action="store_true")
+    pd_.add_argument("--queue-dir", dest="queue_dir", default=None,
+                     help="queue marker dir (default: $TPU_R04_IN or "
+                          "/tmp/tpu_r04, same rule as the queue itself)")
+    # doctor skips _add_common, so give the shared dispatch code below
+    # the one attribute it reads unconditionally; jax_free marks any
+    # subcommand that must not touch jax config (the dispatch checks
+    # the flag, not function identity, so future jax-free subcommands
+    # just set it too)
+    pd_.set_defaults(fn=cmd_doctor, platform=None, jax_free=True)
     backends_by_cmd = {
         "grid": ("local", "sharded", "bucketed", "bucketed-sharded"),
         "grid-subg": ("local", "sharded", "bucketed", "bucketed-sharded"),
@@ -243,7 +281,11 @@ def main(argv=None):
 
         # must run before any backend initialization; no-op if one is live
         jax.config.update("jax_platforms", args.platform)
-    _maybe_compile_cache()
+    if not getattr(args, "jax_free", False):
+        # jax_free subcommands (doctor) never compile and must not
+        # import jax or mutate its config; everything else may get the
+        # opt-in persistent compile cache
+        _maybe_compile_cache()
     args.fn(args)
 
 
@@ -256,13 +298,13 @@ def _maybe_compile_cache() -> None:
     re-runs skip all of it. Opt-in because cache entries are
     revision/flag-sensitive and a stale cache dir is confusing in
     benchmarks; point it at a per-revision path for honest timings."""
-    import os
+    # env parsing (incl. the 0/off/none disable tokens) lives canonically
+    # in dpcorr.utils.doctor; the CLI consumer is opt-in — unset env
+    # resolves to None and the run stays cold
+    from dpcorr.utils.doctor import resolve_cache_dir
 
-    cache_dir = os.environ.get("DPCORR_COMPILE_CACHE")
-    # =0/off/none means "disabled" everywhere this env var is read
-    # (bench.py defaults the cache ON, so a user who exported a disable
-    # token must not get a literal './off' cache dir here)
-    if cache_dir and cache_dir.lower() not in ("0", "off", "none"):
+    cache_dir = resolve_cache_dir("cli")
+    if cache_dir:
         import jax
 
         jax.config.update("jax_compilation_cache_dir", cache_dir)

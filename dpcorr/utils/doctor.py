@@ -1,0 +1,302 @@
+"""Environment health report: ``python -m dpcorr doctor``.
+
+The reference has no operational tooling at all (SURVEY.md §5: failure
+detection "absent" — a dead mclapply task yields a silent NULL slot,
+vert-cor.R:534-554). This framework's TPU runtime, by contrast, lives
+behind a tunnel with known failure modes (docs/STATUS_r04.md wedge
+forensics), and the difference between "chip busy", "tunnel endpoint
+dead" and "a stray process holds the exclusive TPU client" decides what
+an operator should do next. ``doctor`` runs the whole diagnosis in one
+command and prints either a human table or one JSON line.
+
+Checks (each sub-second except the opt-in device probe; note the
+interpreter itself may take seconds to start where a site hook preloads
+JAX — the checks below never import it):
+
+- **relay**: TCP-connect the tunnel relay's local listen ports. All
+  refused ⇒ the client-side endpoint is gone and no amount of waiting
+  inside this session brings the chip back (only an infra redial does).
+- **strays**: ``bench.py --worker`` processes reparented to init — each
+  holds the exclusive TPU client forever and masquerades as a wedged
+  tunnel. ``--sweep`` kills them (same rule bench.py applies).
+- **compile-cache**: persistent XLA cache dir (entries / bytes) — a warm
+  cache turns a 20-40 s first compile into seconds.
+- **queue**: marker state of the unattended validation queue, if its
+  state dir exists (ok / fail / wedge counts per step).
+- **probe** (``--probe`` only): the authoritative device check — init
+  JAX in a subprocess with a hard timeout and report platform + device.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+
+#: The tunnel relay's local listen ports (an infra-owned stdio
+#: multiplexer; see docs/STATUS_r04.md). Checking a subset is enough:
+#: the relay binds all or none of them.
+RELAY_PORTS = (8082, 8083, 8087)
+
+DEFAULT_CACHE = os.path.expanduser("~/.cache/dpcorr/xla")
+
+
+def default_queue_dir() -> str:
+    """Same resolution rule as tpu_r04_queue.sh / harvest_r04.sh
+    (``OUT=${TPU_R04_IN:-/tmp/tpu_r04}``) so doctor reads the markers
+    the queue actually wrote."""
+    return os.environ.get("TPU_R04_IN") or "/tmp/tpu_r04"
+
+
+def check_relay(ports=RELAY_PORTS, timeout=2.0) -> dict:
+    """True if any relay port accepts a TCP connection."""
+    open_ports = []
+    for p in ports:
+        s = socket.socket()
+        s.settimeout(timeout)
+        try:
+            s.connect(("127.0.0.1", p))
+            open_ports.append(p)
+        except OSError:
+            pass
+        finally:
+            s.close()
+    return {"alive": bool(open_ports), "open_ports": open_ports,
+            "checked": list(ports)}
+
+
+def find_stray_workers() -> list[dict]:
+    """``bench.py --worker`` processes whose parent is init (ppid 1).
+
+    Every live orchestrator keeps a live parent, so ppid==1 means the
+    orchestrator died (SIGKILL class) and the worker now holds the
+    exclusive TPU client with nothing left to reap it. This is the
+    CANONICAL Python implementation of the stranded-client rule —
+    ``bench.py:_sweep_stranded_clients`` delegates here.
+    ``benchmarks/tpu_r04_queue.sh::sweep_strays`` approximates it in
+    shell with ``pgrep -f "bench\\.py --worker"`` — an *adjacent-token*
+    match, narrower than this rule, but exact for the only spawn form
+    that exists (``<python> bench.py --worker <kind>``).
+    """
+    strays = []
+    for pid_dir in glob.glob("/proc/[0-9]*"):
+        try:
+            pid = int(os.path.basename(pid_dir))
+            with open(os.path.join(pid_dir, "cmdline"), "rb") as f:
+                argv = [a for a in f.read().split(b"\0") if a]
+            # a real worker invocation is `<python> .../bench.py --worker
+            # <kind> ...` — at least 3 args; the endswith anchor keeps us
+            # off driver shells that merely mention bench.py in a string
+            if (len(argv) < 3 or b"--worker" not in argv
+                    or not any(a.endswith(b"bench.py") for a in argv)):
+                continue
+            with open(os.path.join(pid_dir, "stat")) as f:
+                ppid = int(f.read().rsplit(")", 1)[1].split()[1])
+            if ppid == 1 and pid != os.getpid():
+                strays.append({"pid": pid, "cmdline": b" ".join(argv)
+                               .decode(errors="replace").strip()})
+        except (OSError, ValueError, IndexError):
+            continue  # raced a process exit or unreadable /proc entry
+    return strays
+
+
+def sweep_strays(strays: list[dict]) -> list[int]:
+    swept = []
+    for s in strays:
+        try:
+            os.kill(s["pid"], 9)
+            swept.append(s["pid"])
+        except OSError:
+            pass
+    return swept
+
+
+def parse_cache_env() -> tuple[str | None, bool]:
+    """Canonical parse of DPCORR_COMPILE_CACHE: ``(dir, disabled)`` where
+    ``dir`` is the explicit directory (None if unset or disabled) and
+    ``disabled`` is True only for the explicit 0/off/none tokens. The
+    two consumers apply different defaults to the unset case — bench.py
+    defaults the cache ON at DEFAULT_CACHE, the dpcorr CLI stays cold
+    unless the var is set (README "benchmarks" note) — so resolution
+    is per-consumer: ``resolve_cache_dir``."""
+    env = os.environ.get("DPCORR_COMPILE_CACHE", "")
+    disabled = bool(env) and env.lower() in ("0", "off", "none")
+    return (env if env and not disabled else None), disabled
+
+
+def resolve_cache_dir(consumer: str = "bench") -> str | None:
+    """The cache dir a given consumer would actually use (None = cold)."""
+    env_dir, disabled = parse_cache_env()
+    if disabled:
+        return None
+    if consumer == "bench":
+        return env_dir or DEFAULT_CACHE
+    return env_dir  # dpcorr CLI: opt-in only
+
+
+def check_compile_cache(path: str | None = None) -> dict:
+    """State of bench.py's persistent XLA cache (``path``: bench
+    semantics — default ON). ``cli_path`` records what the opt-in
+    dpcorr CLI would use (None = cold), so the report can't suggest a
+    warm cache to a `python -m dpcorr grid` run that won't see one."""
+    cli_path = resolve_cache_dir("cli")
+    if path is None:
+        path = resolve_cache_dir("bench")
+    if path is None:
+        return {"path": None, "present": False, "disabled": True,
+                "cli_path": cli_path}
+    if not os.path.isdir(path):
+        return {"path": path, "present": False, "cli_path": cli_path}
+    entries = bytes_total = 0
+    for root, _dirs, files in os.walk(path):
+        for fn in files:
+            entries += 1
+            try:
+                bytes_total += os.path.getsize(os.path.join(root, fn))
+            except OSError:
+                pass
+    return {"path": path, "present": True, "entries": entries,
+            "mb": round(bytes_total / 1e6, 1),
+            "cli_path": cli_path}
+
+
+def check_queue(state_dir: str | None = None) -> dict:
+    if state_dir is None:
+        state_dir = default_queue_dir()
+    if not os.path.isdir(state_dir):
+        return {"state_dir": state_dir, "present": False}
+    out: dict = {"state_dir": state_dir, "present": True,
+                 "ok": [], "fail": [], "wedges": {}}
+    for f in sorted(os.listdir(state_dir)):
+        stem, dot, kind = f.rpartition(".")
+        if not dot:
+            continue
+        if kind == "ok":
+            out["ok"].append(stem)
+        elif kind == "fail":
+            out["fail"].append(stem)
+        elif kind == "wedges":
+            try:
+                with open(os.path.join(state_dir, f)) as fh:
+                    out["wedges"][stem] = int(fh.read().strip())
+            except (OSError, ValueError):
+                pass
+    return out
+
+
+def probe_device(timeout_s: float = 150.0) -> dict:
+    """Authoritative device check in a throwaway process GROUP (JAX init
+    can hang on a wedged tunnel — never run it in-process, and reap the
+    whole group on every exit path: a leaked descendant holding the
+    capture pipe would both block us past the timeout and keep the
+    exclusive TPU tunnel handle — the same contract as
+    ``bench.py:_health_probe``)."""
+    import signal
+
+    code = ("import jax, json; d = jax.devices()[0]; "
+            "print(json.dumps({'platform': d.platform, "
+            "'device': str(d)}))")
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, start_new_session=True)
+    try:
+        out, err = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"timeout after {timeout_s:.0f}s"}
+    finally:
+        try:  # reap the whole group whether we timed out or not
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        if p.poll() is None:
+            p.wait()
+    if p.returncode != 0:
+        return {"ok": False, "error": (err or "")[-300:]}
+    try:
+        return {"ok": True, **json.loads(out.strip().splitlines()[-1])}
+    except (ValueError, IndexError):
+        return {"ok": False, "error": f"unparseable: {out[-200:]!r}"}
+
+
+def diagnose(probe: bool = False, sweep: bool = False,
+             cache_dir: str | None = None,
+             queue_dir: str | None = None) -> dict:
+    strays = find_stray_workers()
+    report = {
+        "relay": check_relay(),
+        "stray_workers": strays,
+        "compile_cache": check_compile_cache(cache_dir),
+        "queue": check_queue(queue_dir),
+    }
+    remaining = list(strays)
+    if sweep:
+        # key always present when --sweep was requested: a stable JSON
+        # schema for scripts (`jq .swept` must not go null on the
+        # healthy path)
+        report["swept"] = sweep_strays(strays) if strays else []
+        remaining = [s for s in strays
+                     if s["pid"] not in set(report["swept"])]
+    if probe:
+        if report["relay"]["alive"]:
+            report["device_probe"] = probe_device()
+        else:
+            # against a dead endpoint the jax probe can only hang to its
+            # 150 s timeout (same short-circuit tpu_r04_queue.sh::probe
+            # applies); if the relay port list ever goes stale, the
+            # rendered report still shows exactly which ports were
+            # checked, so the skip is auditable
+            report["device_probe"] = {
+                "ok": False, "skipped": "relay endpoint down"}
+    # one-word triage verdict, the thing an operator actually wants.
+    # A stray that survived --sweep (EPERM, other owner) still holds the
+    # TPU client — that must dominate the verdict, not read as "ok".
+    if remaining:
+        report["verdict"] = ("stray-client (run --sweep, then re-probe)"
+                             if not sweep else
+                             "stray-client-unkillable (sweep could not "
+                             "remove pids %s)" % [s["pid"]
+                                                  for s in remaining])
+    elif not report["relay"]["alive"]:
+        report["verdict"] = ("tunnel-endpoint-dead (heals only on infra "
+                             "redial; CPU work only)")
+    elif probe and not report.get("device_probe", {}).get("ok"):
+        report["verdict"] = "relay-up-but-device-probe-failed (wedged chip?)"
+    else:
+        report["verdict"] = "ok" if probe else "ok (relay up; --probe to confirm device)"
+    return report
+
+
+def render_text(report: dict) -> str:
+    lines = []
+    r = report["relay"]
+    lines.append(f"relay endpoint : {'UP  (ports ' + str(r['open_ports']) + ')' if r['alive'] else 'DOWN (all of ' + str(r['checked']) + ' refused)'}")
+    s = report["stray_workers"]
+    lines.append(f"stray clients  : {len(s)}" + (
+        " -> " + ", ".join(str(x["pid"]) for x in s) if s else ""))
+    if "swept" in report:
+        lines.append(f"swept          : {report['swept']}")
+    c = report["compile_cache"]
+    cli = (f"dpcorr CLI: {c['cli_path']}" if c.get("cli_path")
+           else "dpcorr CLI: cold (opt-in)")
+    lines.append("compile cache  : " + (
+        "disabled (DPCORR_COMPILE_CACHE)" if c.get("disabled")
+        else f"bench: {c['entries']} entries, {c['mb']} MB at {c['path']}"
+        if c.get("present") else f"bench: absent ({c['path']})") +
+        f"; {cli}")
+    q = report["queue"]
+    if q.get("present"):
+        lines.append(f"queue markers  : ok={len(q['ok'])} fail={len(q['fail'])}"
+                     + (f" wedges={q['wedges']}" if q["wedges"] else ""))
+    else:
+        lines.append(f"queue markers  : none ({q['state_dir']})")
+    if "device_probe" in report:
+        p = report["device_probe"]
+        lines.append("device probe   : " + (
+            f"ok — {p['device']} ({p['platform']})" if p.get("ok")
+            else f"skipped — {p['skipped']}" if "skipped" in p
+            else f"FAILED — {p.get('error', '?')}"))
+    lines.append(f"verdict        : {report['verdict']}")
+    return "\n".join(lines)

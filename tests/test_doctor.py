@@ -1,0 +1,176 @@
+"""dpcorr doctor: the operational triage tool (SURVEY.md §5 failure
+detection — the reference has none; this framework's tunnel runtime
+needs one, docs/STATUS_r04.md wedge forensics)."""
+
+import json
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from dpcorr.utils import doctor
+
+
+def test_check_relay_detects_listener_and_refusal():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    try:
+        up = doctor.check_relay(ports=(port,), timeout=2.0)
+        assert up["alive"] and up["open_ports"] == [port]
+    finally:
+        srv.close()
+    down = doctor.check_relay(ports=(port,), timeout=2.0)
+    assert not down["alive"] and down["open_ports"] == []
+
+
+def test_stray_scan_ignores_parented_worker(tmp_path):
+    """A live-parented process whose cmdline looks exactly like a bench
+    worker must NOT be flagged (the ppid==1 test is the real guard —
+    flagging parented workers would let --sweep kill an in-flight
+    bench run)."""
+    fake = tmp_path / "bench.py"
+    fake.write_text("import time\ntime.sleep(30)\n")
+    p = subprocess.Popen([sys.executable, str(fake), "--worker", "tpu"])
+    try:
+        time.sleep(0.3)
+        assert p.pid not in [s["pid"] for s in doctor.find_stray_workers()]
+    finally:
+        p.kill()
+        p.wait()
+
+
+def test_compile_cache_report(tmp_path, monkeypatch):
+    monkeypatch.delenv("DPCORR_COMPILE_CACHE", raising=False)
+    rep = doctor.check_compile_cache(str(tmp_path / "nope"))
+    assert rep["path"] == str(tmp_path / "nope") and not rep["present"]
+    assert rep["cli_path"] is None          # CLI cache is opt-in
+    d = tmp_path / "cache"
+    d.mkdir()
+    (d / "a.bin").write_bytes(b"x" * 1000)
+    (d / "b.bin").write_bytes(b"y" * 500)
+    rep = doctor.check_compile_cache(str(d))
+    assert rep["present"] and rep["entries"] == 2
+    assert rep["mb"] == round(1500 / 1e6, 1)
+
+
+def test_cache_env_consumer_semantics(monkeypatch):
+    """One parse, two defaults: bench defaults ON at the per-user path,
+    the dpcorr CLI stays cold unless the var names a dir; explicit
+    disable tokens kill both (bench.py:179-184, __main__ opt-in)."""
+    monkeypatch.delenv("DPCORR_COMPILE_CACHE", raising=False)
+    assert doctor.resolve_cache_dir("bench") == doctor.DEFAULT_CACHE
+    assert doctor.resolve_cache_dir("cli") is None
+    monkeypatch.setenv("DPCORR_COMPILE_CACHE", "/scratch/xla")
+    assert doctor.resolve_cache_dir("bench") == "/scratch/xla"
+    assert doctor.resolve_cache_dir("cli") == "/scratch/xla"
+    for tok in ("0", "off", "NONE"):
+        monkeypatch.setenv("DPCORR_COMPILE_CACHE", tok)
+        assert doctor.resolve_cache_dir("bench") is None
+        assert doctor.resolve_cache_dir("cli") is None
+        assert doctor.check_compile_cache()["disabled"]
+
+
+def test_queue_marker_report(tmp_path):
+    (tmp_path / "s1.ok").touch()
+    (tmp_path / "s2.fail").write_text("wedged the tunnel 3x")
+    (tmp_path / "s3.wedges").write_text("2\n")
+    (tmp_path / "s1.json").write_text("{}")   # non-marker: ignored
+    q = doctor.check_queue(str(tmp_path))
+    assert q["ok"] == ["s1"] and q["fail"] == ["s2"]
+    assert q["wedges"] == {"s3": 2}
+    assert not doctor.check_queue(str(tmp_path / "gone"))["present"]
+
+
+def test_diagnose_verdicts(monkeypatch, tmp_path):
+    monkeypatch.setattr(doctor, "find_stray_workers", lambda: [])
+    monkeypatch.setattr(doctor, "check_relay",
+                        lambda ports=None, timeout=None: {
+                            "alive": False, "open_ports": [],
+                            "checked": [1]})
+    rep = doctor.diagnose(queue_dir=str(tmp_path),
+                          cache_dir=str(tmp_path))
+    assert rep["verdict"].startswith("tunnel-endpoint-dead")
+
+    monkeypatch.setattr(doctor, "find_stray_workers",
+                        lambda: [{"pid": 99999999, "cmdline": "x"}])
+    rep = doctor.diagnose(queue_dir=str(tmp_path),
+                          cache_dir=str(tmp_path))
+    assert rep["verdict"].startswith("stray-client")
+
+    monkeypatch.setattr(doctor, "find_stray_workers", lambda: [])
+    monkeypatch.setattr(doctor, "check_relay",
+                        lambda ports=None, timeout=None: {
+                            "alive": True, "open_ports": [1],
+                            "checked": [1]})
+    rep = doctor.diagnose(queue_dir=str(tmp_path),
+                          cache_dir=str(tmp_path))
+    assert rep["verdict"].startswith("ok")
+    # text renderer covers every section without raising
+    assert "verdict" in doctor.render_text(rep)
+
+
+def test_queue_dir_resolution_matches_queue_script(monkeypatch):
+    """doctor must read the same marker dir the queue writes
+    (OUT=${TPU_R04_IN:-/tmp/tpu_r04} in tpu_r04_queue.sh)."""
+    monkeypatch.delenv("TPU_R04_IN", raising=False)
+    assert doctor.default_queue_dir() == "/tmp/tpu_r04"
+    monkeypatch.setenv("TPU_R04_IN", "/data/r04")
+    assert doctor.default_queue_dir() == "/data/r04"
+
+
+def test_probe_skipped_when_relay_dead(monkeypatch, tmp_path):
+    """--probe against a dead endpoint must not burn the 150s jax
+    timeout (the same short-circuit the queue's probe applies)."""
+    monkeypatch.setattr(doctor, "find_stray_workers", lambda: [])
+    monkeypatch.setattr(doctor, "check_relay",
+                        lambda ports=None, timeout=None: {
+                            "alive": False, "open_ports": [],
+                            "checked": [1]})
+    monkeypatch.setattr(doctor, "probe_device", lambda timeout_s=150.0: (
+        pytest.fail("probe_device must not run against a dead relay")))
+    rep = doctor.diagnose(probe=True, queue_dir=str(tmp_path),
+                          cache_dir=str(tmp_path))
+    assert rep["device_probe"] == {"ok": False,
+                                   "skipped": "relay endpoint down"}
+    assert "skipped — relay endpoint down" in doctor.render_text(rep)
+
+
+def test_lazy_package_init_keeps_doctor_jax_free():
+    """dpcorr.__init__ re-exports MASTER_SEED lazily (PEP 562) so the
+    doctor import chain never imports jax; pin both properties."""
+    repo = Path(__file__).parent.parent
+    # -S skips the axon site hook that preloads jax unconditionally —
+    # the property under test is OUR import chain, not the hook's.
+    # (-S also drops site-packages, so jax is unimportable here: the
+    # doctor chain must survive that too.)
+    r = subprocess.run(
+        [sys.executable, "-S", "-c",
+         "import sys; sys.path.insert(0, '.'); "
+         "import dpcorr.utils.doctor; "
+         "assert 'jax' not in sys.modules, 'doctor import pulled jax'"],
+        capture_output=True, text=True, timeout=120, cwd=repo)
+    assert r.returncode == 0, r.stderr[-500:]
+    # the lazy re-export still works where jax IS importable
+    import dpcorr
+
+    assert dpcorr.MASTER_SEED == 2025
+
+
+def test_doctor_cli_json(tmp_path):
+    """End-to-end CLI drive: no JAX backend init without --probe (fast),
+    valid one-line JSON with --json."""
+    qdir = tmp_path / "no-such-queue"
+    r = subprocess.run(
+        [sys.executable, "-m", "dpcorr", "doctor", "--json",
+         "--queue-dir", str(qdir)],
+        capture_output=True, text=True, timeout=120,
+        cwd=Path(__file__).parent.parent)
+    assert r.returncode == 0, r.stderr[-300:]
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "relay" in rep and "verdict" in rep
+    assert rep["queue"] == {"state_dir": str(qdir), "present": False}

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 
@@ -201,8 +203,12 @@ def cmd_doctor(args):
             print(json.dumps(report))
         else:
             print(doctor.render_text(report))
+        sys.stdout.flush()
     except BrokenPipeError:
-        pass  # `dpcorr doctor | head` must not stack-trace
+        # `dpcorr doctor | head` must not stack-trace — and the
+        # interpreter's exit-time stdout flush would re-raise, so hand
+        # it a dead fd instead
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
 
 
 def main(argv=None):

@@ -129,6 +129,10 @@ def parse_cache_env() -> tuple[str | None, bool]:
 
 def resolve_cache_dir(consumer: str = "bench") -> str | None:
     """The cache dir a given consumer would actually use (None = cold)."""
+    if consumer not in ("bench", "cli"):
+        # a typo'd consumer silently running cold would cost minutes of
+        # avoidable compile per unattended run — fail loudly instead
+        raise ValueError(f"unknown cache consumer {consumer!r}")
     env_dir, disabled = parse_cache_env()
     if disabled:
         return None
@@ -240,9 +244,7 @@ def diagnose(probe: bool = False, sweep: bool = False,
         remaining = [s for s in strays
                      if s["pid"] not in set(report["swept"])]
     if probe:
-        if report["relay"]["alive"]:
-            report["device_probe"] = probe_device()
-        else:
+        if not report["relay"]["alive"]:
             # against a dead endpoint the jax probe can only hang to its
             # 150 s timeout (same short-circuit tpu_r04_queue.sh::probe
             # applies); if the relay port list ever goes stale, the
@@ -250,6 +252,15 @@ def diagnose(probe: bool = False, sweep: bool = False,
             # checked, so the skip is auditable
             report["device_probe"] = {
                 "ok": False, "skipped": "relay endpoint down"}
+        elif remaining:
+            # a surviving stray HOLDS the exclusive TPU client — the
+            # probe would hang its full timeout against it by definition
+            report["device_probe"] = {
+                "ok": False,
+                "skipped": "stray client holds the TPU client "
+                           "(sweep first)"}
+        else:
+            report["device_probe"] = probe_device()
     # one-word triage verdict, the thing an operator actually wants.
     # A stray that survived --sweep (EPERM, other owner) still holds the
     # TPU client — that must dominate the verdict, not read as "ok".

@@ -140,6 +140,30 @@ def test_probe_skipped_when_relay_dead(monkeypatch, tmp_path):
     assert "skipped — relay endpoint down" in doctor.render_text(rep)
 
 
+def test_probe_skipped_when_stray_survives(monkeypatch, tmp_path):
+    """A stray that --sweep could not kill still holds the exclusive
+    TPU client; probing against it can only hang to the timeout."""
+    stray = [{"pid": 99999999, "cmdline": "x"}]
+    monkeypatch.setattr(doctor, "find_stray_workers", lambda: stray)
+    monkeypatch.setattr(doctor, "sweep_strays", lambda s: [])
+    monkeypatch.setattr(doctor, "check_relay",
+                        lambda ports=None, timeout=None: {
+                            "alive": True, "open_ports": [1],
+                            "checked": [1]})
+    monkeypatch.setattr(doctor, "probe_device", lambda timeout_s=150.0: (
+        pytest.fail("probe must not run while a stray holds the client")))
+    rep = doctor.diagnose(probe=True, sweep=True,
+                          queue_dir=str(tmp_path), cache_dir=str(tmp_path))
+    assert rep["swept"] == []
+    assert rep["device_probe"]["skipped"].startswith("stray client")
+    assert rep["verdict"].startswith("stray-client-unkillable")
+
+
+def test_cache_consumer_typo_raises():
+    with pytest.raises(ValueError):
+        doctor.resolve_cache_dir("Bench")
+
+
 def test_lazy_package_init_keeps_doctor_jax_free():
     """dpcorr.__init__ re-exports MASTER_SEED lazily (PEP 562) so the
     doctor import chain never imports jax; pin both properties."""

@@ -532,6 +532,18 @@ def main() -> None:
         out.setdefault("detail", {})["attempts"] = attempts
     out.setdefault("detail", {})["tunnel_health_probe"] = (
         "ok" if healthy else "failed")
+    if not healthy:
+        # forensics only (never decision-changing): distinguish "the
+        # tunnel's local relay endpoint is gone" (heals only on infra
+        # redial, STATUS_r04.md post-mortem) from "endpoint up but chip
+        # unresponsive" in the judged artifact itself
+        try:
+            from dpcorr.utils.doctor import check_relay
+
+            out["detail"]["relay_endpoint"] = (
+                "up" if check_relay()["alive"] else "dead")
+        except Exception:
+            pass
     if swept:
         out["detail"]["swept_stranded_clients"] = swept
     try:  # provenance: which revision this measurement describes

@@ -270,6 +270,8 @@ def test_health_probe_sets_first_leash(monkeypatch, capsys):
                               [(_good(), None), (_pallas(), None)],
                               healthy=False)
     assert out["detail"]["tunnel_health_probe"] == "failed"
+    # failed probe adds endpoint forensics: dead relay vs wedged chip
+    assert out["detail"]["relay_endpoint"] in ("up", "dead")
     assert t_ok[0] > t_bad[0] >= 420
 
 

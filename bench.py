@@ -489,6 +489,19 @@ def main() -> None:
     # runs); a negative one keeps it short for a fast CPU degrade.
     swept = _sweep_stranded_clients()
     healthy = _health_probe()
+    relay_state = None
+    if not healthy:
+        # forensics only (never decision-changing): snapshot the relay
+        # endpoint NOW, not at artifact-write time — the tpu attempts and
+        # cpu fallback below can take 10+ minutes, and an infra redial in
+        # that window would otherwise misattribute the probe failure
+        # (dead endpoint vs endpoint-up-but-chip-wedged, STATUS_r04.md)
+        try:
+            from dpcorr.utils.doctor import check_relay
+
+            relay_state = "up" if check_relay()["alive"] else "dead"
+        except Exception:
+            pass
     first_base = 900 if healthy else 420
     out, err = _run_worker("tpu", timeout_s=first_base + 2.5 * args.budget,
                            budget_s=args.budget)
@@ -532,18 +545,8 @@ def main() -> None:
         out.setdefault("detail", {})["attempts"] = attempts
     out.setdefault("detail", {})["tunnel_health_probe"] = (
         "ok" if healthy else "failed")
-    if not healthy:
-        # forensics only (never decision-changing): distinguish "the
-        # tunnel's local relay endpoint is gone" (heals only on infra
-        # redial, STATUS_r04.md post-mortem) from "endpoint up but chip
-        # unresponsive" in the judged artifact itself
-        try:
-            from dpcorr.utils.doctor import check_relay
-
-            out["detail"]["relay_endpoint"] = (
-                "up" if check_relay()["alive"] else "dead")
-        except Exception:
-            pass
+    if relay_state is not None:
+        out["detail"]["relay_endpoint"] = relay_state
     if swept:
         out["detail"]["swept_stranded_clients"] = swept
     try:  # provenance: which revision this measurement describes

@@ -266,12 +266,19 @@ def test_health_probe_sets_first_leash(monkeypatch, capsys):
                              [(_good(), None), (_pallas(), None)],
                              healthy=True)
     assert out["detail"]["tunnel_health_probe"] == "ok"
+    # failed probe adds endpoint forensics, snapshotted at probe time
+    # (not artifact time — a mid-run redial must not misattribute);
+    # deterministic via monkeypatch, no live TCP in a unit test
+    import dpcorr.utils.doctor as doctor_mod
+    monkeypatch.setattr(doctor_mod, "check_relay",
+                        lambda ports=None, timeout=None: {
+                            "alive": False, "open_ports": [],
+                            "checked": []})
     out, _, t_bad = _run_main(monkeypatch, capsys,
                               [(_good(), None), (_pallas(), None)],
                               healthy=False)
     assert out["detail"]["tunnel_health_probe"] == "failed"
-    # failed probe adds endpoint forensics: dead relay vs wedged chip
-    assert out["detail"]["relay_endpoint"] in ("up", "dead")
+    assert out["detail"]["relay_endpoint"] == "dead"
     assert t_ok[0] > t_bad[0] >= 420
 
 

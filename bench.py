@@ -491,18 +491,28 @@ def main() -> None:
     healthy = _health_probe()
     relay_state = None
     if not healthy:
-        # forensics only (never decision-changing): snapshot the relay
-        # endpoint NOW, not at artifact-write time — the tpu attempts and
-        # cpu fallback below can take 10+ minutes, and an infra redial in
-        # that window would otherwise misattribute the probe failure
-        # (dead endpoint vs endpoint-up-but-chip-wedged, STATUS_r04.md)
+        # Snapshot the relay endpoint NOW, not at artifact-write time —
+        # the tpu attempts and cpu fallback below can take 10+ minutes,
+        # and an infra redial in that window would otherwise
+        # misattribute the probe failure (dead endpoint vs
+        # endpoint-up-but-chip-wedged, STATUS_r04.md). The snapshot is
+        # recorded as forensics in the artifact AND selects the leash
+        # ladder's shortest rung below — decision-changing, keep it
+        # exactly here.
         try:
             from dpcorr.utils.doctor import check_relay
 
             relay_state = "up" if check_relay()["alive"] else "dead"
         except Exception:
             pass
-    first_base = 900 if healthy else 420
+    # Leash ladder, by evidence strength: healthy probe ⇒ patience (900);
+    # failed probe ⇒ short (420); failed probe AND the relay's TCP ports
+    # refusing ⇒ shortest (200) — jax init hangs its full leash even on
+    # connection-refused (measured 495 s + 295 s against a dead endpoint,
+    # STATUS_r04.md rehearsal), and ports-refused is a strictly stronger
+    # death signal than a probe timeout. Both real attempts still run:
+    # a stale port list degrades to a 200 s first try, never to a skip.
+    first_base = 900 if healthy else (200 if relay_state == "dead" else 420)
     out, err = _run_worker("tpu", timeout_s=first_base + 2.5 * args.budget,
                            budget_s=args.budget)
     if out is None:

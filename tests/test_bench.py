@@ -268,18 +268,31 @@ def test_health_probe_sets_first_leash(monkeypatch, capsys):
     assert out["detail"]["tunnel_health_probe"] == "ok"
     # failed probe adds endpoint forensics, snapshotted at probe time
     # (not artifact time — a mid-run redial must not misattribute);
-    # deterministic via monkeypatch, no live TCP in a unit test
+    # deterministic via monkeypatch, no live TCP in a unit test. The
+    # leash ladder follows evidence strength: probe failed but relay up
+    # ⇒ 420-base; relay ports REFUSING (strictly stronger death signal;
+    # jax init hangs even on connection-refused) ⇒ 200-base — both real
+    # attempts still run either way.
     import dpcorr.utils.doctor as doctor_mod
-    monkeypatch.setattr(doctor_mod, "check_relay",
-                        lambda ports=None, timeout=None: {
-                            "alive": False, "open_ports": [],
-                            "checked": []})
-    out, _, t_bad = _run_main(monkeypatch, capsys,
-                              [(_good(), None), (_pallas(), None)],
-                              healthy=False)
+
+    def relay(alive):
+        monkeypatch.setattr(doctor_mod, "check_relay",
+                            lambda ports=None, timeout=None: {
+                                "alive": alive, "open_ports": [],
+                                "checked": []})
+
+    relay(True)
+    out, _, t_up = _run_main(monkeypatch, capsys,
+                             [(_good(), None), (_pallas(), None)],
+                             healthy=False)
     assert out["detail"]["tunnel_health_probe"] == "failed"
+    assert out["detail"]["relay_endpoint"] == "up"
+    relay(False)
+    out, _, t_dead = _run_main(monkeypatch, capsys,
+                               [(_good(), None), (_pallas(), None)],
+                               healthy=False)
     assert out["detail"]["relay_endpoint"] == "dead"
-    assert t_ok[0] > t_bad[0] >= 420
+    assert t_ok[0] > t_up[0] >= 420 > t_dead[0] >= 200
 
 
 def test_total_failure_still_valid_json(monkeypatch, capsys):

@@ -232,7 +232,8 @@ def worker_main(mode: str, budget_s: float) -> None:
         p_rps, p_means = _measure(_pallas_block, lambda i: jnp.int32(i))
         print(json.dumps({
             "metric": METRIC, "value": round(p_rps, 1),
-            "unit": "reps/sec/chip", "vs_baseline": 0.0,
+            "unit": "reps/sec/chip",
+            "vs_baseline": round(p_rps / BASELINE_REPS_PER_SEC_CHIP, 3),
             "detail": {"paths": {"pallas": {
                 "reps_per_sec": round(p_rps, 1),
                 "mse": round(p_means[0], 6),
@@ -418,7 +419,7 @@ def _sweep_stranded_clients() -> list:
     Running it before the health probe makes the driver's unattended
     round-end run self-healing. Returns the swept pids (for the JSON
     forensics). The match rule lives canonically in
-    ``dpcorr.utils.doctor`` (``benchmarks/tpu_r04_queue.sh`` mirrors it
+    ``dpcorr.utils.doctor`` (``benchmarks/tpu_r05_queue.sh`` mirrors it
     in shell); keeping one Python implementation stops the three copies
     drifting apart."""
     from dpcorr.utils.doctor import find_stray_workers, sweep_strays
@@ -481,6 +482,16 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _sigterm_to_exit)
 
     attempts = []
+    try:
+        # CPU contention forensics, sampled BEFORE the bench's own
+        # workers run (they saturate the 1-core box themselves and would
+        # mask external load): a competing niced job halves the CPU
+        # fallback's measured rate (the r04 degraded artifact's 1,234.7
+        # vs 2,577 clean); the 1-minute load average at bench start
+        # makes that attributable from the artifact alone.
+        loadavg_start = round(os.getloadavg()[0], 2)
+    except OSError:
+        loadavg_start = None
     # Attempt 1: TPU, full budget, XLA path only. Init alone can take
     # minutes through the tunnel; the timeout bounds init + compile + the
     # measurement and scales with the requested budget so a long --budget
@@ -528,9 +539,16 @@ def main() -> None:
         _merge_pallas(out, args.budget)
     if out is None:
         attempts.append(err)
-        cpu_budget = min(10.0, args.budget)
-        out, err = _run_worker("cpu", timeout_s=200 + 2.5 * cpu_budget,
-                               budget_s=cpu_budget)
+        # Full budget, not a 10 s stub: the degraded artifact is the
+        # round's official number when the tunnel is dead, and r04's
+        # 10 s fallback measured only ~3 blocks — too few to amortize
+        # per-block dispatch, and hypersensitive to transient load on
+        # this 1-core box (BENCH_r04: 1,234.7 vs the clean-box 2,577
+        # sweep value, with a niced 4 h job sharing the core). The
+        # extra wall cost is bounded (~2.5x budget) and only paid on
+        # the already-slow degrade path.
+        out, err = _run_worker("cpu", timeout_s=200 + 2.5 * args.budget,
+                               budget_s=args.budget)
         if out is not None:
             out["detail"]["degraded"] = "tpu-init-failed"
             here = os.path.dirname(os.path.abspath(__file__))
@@ -559,6 +577,8 @@ def main() -> None:
         out["detail"]["relay_endpoint"] = relay_state
     if swept:
         out["detail"]["swept_stranded_clients"] = swept
+    if loadavg_start is not None:
+        out["detail"]["loadavg_1m_at_start"] = loadavg_start
     try:  # provenance: which revision this measurement describes
         rev = subprocess.run(
             ["git", "-C", os.path.dirname(os.path.abspath(__file__)),

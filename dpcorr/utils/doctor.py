@@ -38,21 +38,53 @@ import sys
 
 #: The tunnel relay's local listen ports (an infra-owned stdio
 #: multiplexer; see docs/STATUS_r04.md). Checking a subset is enough:
-#: the relay binds all or none of them.
+#: the relay binds all or none of them. These are THIS deployment's
+#: observed ports, not a protocol constant — another image's relay (or a
+#: re-provisioned tunnel) may bind elsewhere, so the list is overridable
+#: via ``DPCORR_RELAY_PORTS`` (comma-separated) without editing the
+#: package.
 RELAY_PORTS = (8082, 8083, 8087)
+
+
+def relay_ports() -> tuple[int, ...]:
+    """The relay port list in effect: ``DPCORR_RELAY_PORTS`` (comma-
+    separated ints) if set and parseable, else the baked-in default.
+    An unparseable override falls back to the default rather than
+    raising — doctor is a diagnostic tool and must not crash on a typo
+    — but the rendered report always shows which ports were checked,
+    so the fallback is auditable."""
+    env = os.environ.get("DPCORR_RELAY_PORTS", "").strip()
+    if env:
+        try:
+            ports = tuple(int(tok) for tok in env.split(",") if tok.strip())
+            if ports:
+                return ports
+        except ValueError:
+            pass
+    return RELAY_PORTS
 
 DEFAULT_CACHE = os.path.expanduser("~/.cache/dpcorr/xla")
 
 
 def default_queue_dir() -> str:
-    """Same resolution rule as tpu_r04_queue.sh / harvest_r04.sh
-    (``OUT=${TPU_R04_IN:-/tmp/tpu_r04}``) so doctor reads the markers
-    the queue actually wrote."""
-    return os.environ.get("TPU_R04_IN") or "/tmp/tpu_r04"
+    """Same resolution rule as tpu_r05_queue.sh / harvest_r05.sh
+    (``OUT=${TPU_R05_IN:-/tmp/tpu_r05}``) so doctor reads the markers
+    the queue actually wrote. Falls back to the r04 dir when no r05
+    state exists yet (e.g. triaging right after a reboot that predates
+    the r05 queue's first launch)."""
+    env = os.environ.get("TPU_R05_IN")
+    if env:
+        return env
+    if os.path.isdir("/tmp/tpu_r05"):
+        return "/tmp/tpu_r05"
+    legacy = os.environ.get("TPU_R04_IN") or "/tmp/tpu_r04"
+    return legacy if os.path.isdir(legacy) else "/tmp/tpu_r05"
 
 
-def check_relay(ports=RELAY_PORTS, timeout=2.0) -> dict:
+def check_relay(ports=None, timeout=2.0) -> dict:
     """True if any relay port accepts a TCP connection."""
+    if ports is None:
+        ports = relay_ports()
     open_ports = []
     for p in ports:
         s = socket.socket()
@@ -76,7 +108,7 @@ def find_stray_workers() -> list[dict]:
     exclusive TPU client with nothing left to reap it. This is the
     CANONICAL Python implementation of the stranded-client rule —
     ``bench.py:_sweep_stranded_clients`` delegates here.
-    ``benchmarks/tpu_r04_queue.sh::sweep_strays`` approximates it in
+    ``benchmarks/tpu_r05_queue.sh::sweep_strays`` approximates it in
     shell with ``pgrep -f "bench\\.py --worker"`` — an *adjacent-token*
     match, narrower than this rule, but exact for the only spawn form
     that exists (``<python> bench.py --worker <kind>``).
@@ -246,7 +278,7 @@ def diagnose(probe: bool = False, sweep: bool = False,
     if probe:
         if not report["relay"]["alive"]:
             # against a dead endpoint the jax probe can only hang to its
-            # 150 s timeout (same short-circuit tpu_r04_queue.sh::probe
+            # 150 s timeout (same short-circuit tpu_r05_queue.sh::probe
             # applies); if the relay port list ever goes stale, the
             # rendered report still shows exactly which ports were
             # checked, so the skip is auditable
@@ -264,7 +296,16 @@ def diagnose(probe: bool = False, sweep: bool = False,
     # one-word triage verdict, the thing an operator actually wants.
     # A stray that survived --sweep (EPERM, other owner) still holds the
     # TPU client — that must dominate the verdict, not read as "ok".
-    if remaining:
+    # UNLESS the relay endpoint is also dead: then re-probing after a
+    # sweep is futile (the probe would be skipped as "relay endpoint
+    # down" anyway), so the endpoint condition dominates and the strays
+    # become a secondary note — the operator sweeps locally AND waits
+    # for the infra redial, in that order.
+    if remaining and not report["relay"]["alive"]:
+        report["verdict"] = (
+            "tunnel-endpoint-dead+stray-client (sweep strays, but the "
+            "chip needs an infra redial either way; CPU work only)")
+    elif remaining:
         report["verdict"] = ("stray-client (run --sweep, then re-probe)"
                              if not sweep else
                              "stray-client-unkillable (sweep could not "

@@ -56,8 +56,8 @@ def _parser_flags(mod):
     return flags
 
 
-def test_r04_scripts_importable_and_documented():
-    """The unattended r04 queue (tpu_r04_queue.sh) invokes these scripts
+def test_queue_scripts_importable_and_documented():
+    """The unattended r05 queue (tpu_r05_queue.sh) invokes these scripts
     with specific flags; an import error or a renamed flag would silently
     burn the round's first healthy-tunnel window. Pin the contract."""
     from benchmarks import acceptance_point2, multihost_scaling
@@ -70,19 +70,18 @@ def test_r04_scripts_importable_and_documented():
 
 
 def test_queue_script_invokes_real_flags():
-    """Every --flag the r04 queue passes to a benchmarks/ python script
+    """Every --flag the r05 queue passes to a benchmarks/ python script
     must exist in that script's ACTUAL parser (derived live, not a
     hand-maintained list — same class of guard as
     test_backend_r_call_contract for the R seam)."""
     import re
     from pathlib import Path
 
-    from benchmarks import acceptance_point2, grid_fused_tpu
+    from benchmarks import acceptance_point2
 
     repo = Path(__file__).parent.parent
-    sh = (repo / "benchmarks" / "tpu_r04_queue.sh").read_text()
-    for script, mod in (("acceptance_point2.py", acceptance_point2),
-                        ("grid_fused_tpu.py", grid_fused_tpu)):
+    sh = (repo / "benchmarks" / "tpu_r05_queue.sh").read_text()
+    for script, mod in (("acceptance_point2.py", acceptance_point2),):
         valid = _parser_flags(mod)
         assert valid, script
         found = 0
@@ -95,8 +94,8 @@ def test_queue_script_invokes_real_flags():
 
 
 def test_harvest_rejects_degraded_headline(tmp_path):
-    """harvest_r04.sh must never bank a degraded CPU-fallback bench line
-    as r04_tpu_headline.json (bench.py cites that file back as
+    """harvest_r05.sh must never bank a degraded CPU-fallback bench line
+    as r05_tpu_headline.json (bench.py cites that file back as
     'recorded_tpu_evidence' — banking a degraded line would be circular).
     Run the real script against fixture dirs both ways."""
     import json
@@ -108,53 +107,53 @@ def test_harvest_rejects_degraded_headline(tmp_path):
     fix_out = tmp_path / "out"
     fix_in.mkdir()
     fix_out.mkdir()
-    env = {"TPU_R04_IN": str(fix_in), "TPU_R04_OUT": str(fix_out),
+    env = {"TPU_R05_IN": str(fix_in), "TPU_R05_OUT": str(fix_out),
            "PATH": "/usr/bin:/bin"}
 
     degraded = {"metric": "m", "value": 2018.0, "unit": "reps/sec/chip",
                 "detail": {"degraded": "tpu-init-failed",
                            "paths": {"xla": {"reps_per_sec": 2018.0}}}}
     (fix_in / "bench_default.json").write_text(json.dumps(degraded))
-    subprocess.run(["bash", str(repo / "benchmarks" / "harvest_r04.sh")],
+    subprocess.run(["bash", str(repo / "benchmarks" / "harvest_r05.sh")],
                    capture_output=True, text=True, env=env, cwd=repo)
-    assert not (fix_out / "r04_tpu_headline.json").exists()
+    assert not (fix_out / "r05_tpu_headline.json").exists()
 
     clean = {"metric": "m", "value": 981783.0, "unit": "reps/sec/chip",
              "detail": {"device": "TPU_0",
                         "paths": {"xla": {"reps_per_sec": 981783.0}}}}
     (fix_in / "bench_default.json").write_text(json.dumps(clean))
-    subprocess.run(["bash", str(repo / "benchmarks" / "harvest_r04.sh")],
+    subprocess.run(["bash", str(repo / "benchmarks" / "harvest_r05.sh")],
                    capture_output=True, text=True, env=env, cwd=repo)
-    banked = fix_out / "r04_tpu_headline.json"
+    banked = fix_out / "r05_tpu_headline.json"
     assert banked.exists()
     assert json.loads(banked.read_text())["value"] == 981783.0
 
 
 def test_queue_resume_semantics(tmp_path):
-    """The r04 queue's wedge-resume contract (bash functions sourced with
+    """The r05 queue's wedge-resume contract (bash functions sourced with
     a stubbed probe): ok-marked steps skip, a failure with the tunnel
     alive marks .fail and continues, a failure with the tunnel dead sets
     WEDGED and suppresses every later step; finished() requires a
     terminal marker per step. Wedges normally leave no marker (retried
     on next recovery) — EXCEPT for MOSAIC_STEPS members, where the third
     wedge on the same step trips a cap and writes .fail (the step is
-    classified as the wedge's cause; see tpu_r04_queue.sh header)."""
+    classified as the wedge's cause; see tpu_r05_queue.sh header)."""
     import subprocess
     from pathlib import Path
 
     repo = Path(__file__).parent.parent
     script = f"""
 set -u
-export TPU_R04_IN={tmp_path}
-export TPU_R04_PROBE=true
-source {repo}/benchmarks/tpu_r04_queue.sh
+export TPU_R05_IN={tmp_path}
+export TPU_R05_PROBE=true
+source {repo}/benchmarks/tpu_r05_queue.sh
 
 MOSAIC_STEPS="s3"              # s3 plays a Mosaic-risky step; s5 pure-XLA
 
 run_step s1 true
 run_step s2 false              # fails, probe says alive -> .fail
 run_step s1 false              # .ok marker -> must skip (cmd not run)
-export TPU_R04_PROBE=false
+export TPU_R05_PROBE=false
 run_step s3 false              # fails, probe dead -> wedge, no marker
 run_step s4 true               # suppressed by WEDGED (no marker)
 echo "WEDGED=$WEDGED"

@@ -96,17 +96,25 @@ def test_diagnose_verdicts(monkeypatch, tmp_path):
                           cache_dir=str(tmp_path))
     assert rep["verdict"].startswith("tunnel-endpoint-dead")
 
+    # strays + dead endpoint: the endpoint condition dominates (sweeping
+    # then re-probing is futile against a dead relay — the probe would
+    # be skipped anyway), with the strays kept as a secondary note
+    # (ADVICE r04)
     monkeypatch.setattr(doctor, "find_stray_workers",
                         lambda: [{"pid": 99999999, "cmdline": "x"}])
+    rep = doctor.diagnose(queue_dir=str(tmp_path),
+                          cache_dir=str(tmp_path))
+    assert rep["verdict"].startswith("tunnel-endpoint-dead+stray-client")
+
+    monkeypatch.setattr(doctor, "check_relay",
+                        lambda ports=None, timeout=None: {
+                            "alive": True, "open_ports": [1],
+                            "checked": [1]})
     rep = doctor.diagnose(queue_dir=str(tmp_path),
                           cache_dir=str(tmp_path))
     assert rep["verdict"].startswith("stray-client")
 
     monkeypatch.setattr(doctor, "find_stray_workers", lambda: [])
-    monkeypatch.setattr(doctor, "check_relay",
-                        lambda ports=None, timeout=None: {
-                            "alive": True, "open_ports": [1],
-                            "checked": [1]})
     rep = doctor.diagnose(queue_dir=str(tmp_path),
                           cache_dir=str(tmp_path))
     assert rep["verdict"].startswith("ok")
@@ -116,10 +124,23 @@ def test_diagnose_verdicts(monkeypatch, tmp_path):
 
 def test_queue_dir_resolution_matches_queue_script(monkeypatch):
     """doctor must read the same marker dir the queue writes
-    (OUT=${TPU_R04_IN:-/tmp/tpu_r04} in tpu_r04_queue.sh)."""
+    (OUT=${TPU_R05_IN:-/tmp/tpu_r05} in tpu_r05_queue.sh), falling back
+    to the r04 dir only when it exists and no r05 state does."""
+    monkeypatch.delenv("TPU_R05_IN", raising=False)
     monkeypatch.delenv("TPU_R04_IN", raising=False)
-    assert doctor.default_queue_dir() == "/tmp/tpu_r04"
+    monkeypatch.setattr(doctor.os.path, "isdir", lambda p: False)
+    assert doctor.default_queue_dir() == "/tmp/tpu_r05"
+    monkeypatch.setenv("TPU_R05_IN", "/data/r05")
+    assert doctor.default_queue_dir() == "/data/r05"
+    monkeypatch.delenv("TPU_R05_IN", raising=False)
+    # r05 state present -> it wins even with an r04 override set
     monkeypatch.setenv("TPU_R04_IN", "/data/r04")
+    monkeypatch.setattr(doctor.os.path, "isdir",
+                        lambda p: p == "/tmp/tpu_r05")
+    assert doctor.default_queue_dir() == "/tmp/tpu_r05"
+    # no r05 state, r04 markers exist -> legacy fallback
+    monkeypatch.setattr(doctor.os.path, "isdir",
+                        lambda p: p == "/data/r04")
     assert doctor.default_queue_dir() == "/data/r04"
 
 

@@ -25,11 +25,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
+#: The "subg" family was removed with the r05 fused="all" retirement
+#: (GridConfig.fused): its recorded r02 measurement
+#: (r02_grid_fused_subg_tpu.json, 0.98x XLA) is the retirement's cited
+#: evidence and stays checked in.
 RESULTS = {
     "sign": os.path.join(REPO, "benchmarks", "results",
                          "r02_grid_fused_tpu.json"),
-    "subg": os.path.join(REPO, "benchmarks", "results",
-                         "r02_grid_fused_subg_tpu.json"),
 }
 
 
@@ -52,10 +54,11 @@ def main() -> None:
                     help="output JSON path (default: the family's r02 "
                          "artifact — pass an r0N name to keep old "
                          "evidence intact)")
-    ap.add_argument("--family", choices=["sign", "subg"], default="sign",
-                    help="sign: v1 Gaussian grid (vert-cor.R:488-511); "
-                         "subg: v2 bounded-factor grid "
-                         "(ver-cor-subG.R:245-269)")
+    ap.add_argument("--family", choices=["sign"], default="sign",
+                    help="sign: v1 Gaussian grid (vert-cor.R:488-511). "
+                         "(The 'subg' family went with the r05 "
+                         "fused='all' retirement; its r02 measurement "
+                         "r02_grid_fused_subg_tpu.json stays checked in)")
     args = ap.parse_args()
 
     import jax
@@ -65,13 +68,9 @@ def main() -> None:
     dev = jax.devices()[0]
     out = {"device": str(dev), "b": args.b, "family": args.family,
            "runs": {}}
-    family_kw = ({} if args.family == "sign" else
-                 dict(n_grid=(2500, 4000, 6000, 9000, 12000),
-                      dgp="bounded_factor", use_subg=True))
+    family_kw = {}
 
-    # subG fusing is gated behind "all" (perf-neutral — GridConfig.fused);
-    # this script's job is to measure it, so force the fused arm per family
-    fused_mode = "auto" if args.family == "sign" else "all"
+    fused_mode = "auto"
     for fused in ("off", fused_mode):
         gcfg = GridConfig(b=args.b, backend="bucketed", fused=fused,
                           **family_kw)

@@ -273,13 +273,13 @@ def main(argv=None):
                                 "each worker contributes (local cluster "
                                 "testing)")
             p.add_argument("--fused", default="off",
-                           choices=["off", "auto", "all"],
+                           choices=["off", "auto"],
                            help="run eligible (n, eps) buckets through the "
                                 "fused Pallas kernels (TPU + --backend "
                                 "bucketed only). auto: only where fused "
                                 "measures faster (the Gaussian sign pair, "
-                                "4.5x); all: also the subG pair (perf-"
-                                "neutral vs XLA, see GridConfig.fused)")
+                                "4.5x; the former 'all' subG mode was "
+                                "retired in r05, see GridConfig.fused)")
         p.set_defaults(fn=fn)
     args = ap.parse_args(argv)
     if args.platform:

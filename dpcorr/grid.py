@@ -59,19 +59,23 @@ class GridConfig:
     #: "bucketed-sharded" (bucket kernels with the flat point×rep axis
     #: split across the mesh — both parallel axes composed)
     backend: str = "local"
-    #: "off" | "auto" | "all": fused-Pallas bucket selection for the
-    #: bucketed backend (on-chip PRNG, whole replication in VMEM).
-    #: "auto" runs buckets through a fused kernel only where it measures
-    #: FASTER than the XLA kernel: the Gaussian sign pair
-    #: (ops/pallas_ni.py — 4.5× on the reference grid,
-    #: benchmarks/results/r02_grid_fused_tpu.json). "all" additionally
-    #: fuses every eligible bucket even where it is perf-neutral: the
-    #: subG grid pair (ops/pallas_subg.py — steady-state 0.98× of XLA
-    #: and slower to Mosaic-compile, r02_grid_fused_subg_tpu.json).
-    #: TPU-only; eligibility also needs det mixquant and m ≤ 128
-    #: (see _fused_bucket_ok). Fused results come from a different PRNG
+    #: "off" | "auto": fused-Pallas bucket selection for the bucketed
+    #: backend (on-chip PRNG, whole replication in VMEM). "auto" runs
+    #: buckets through a fused kernel only where it measures FASTER
+    #: than the XLA kernel: the Gaussian sign pair (ops/pallas_ni.py —
+    #: 4.5× on the reference grid,
+    #: benchmarks/results/r02_grid_fused_tpu.json). TPU-only;
+    #: eligibility also needs det mixquant and m ≤ 128 (see
+    #: _fused_bucket_ok). Fused results come from a different PRNG
     #: stream family, so their resume caches are stamped separately and
     #: never mix with XLA-path caches.
+    #: A third mode "all" (the perf-neutral fused subG grid pair,
+    #: ops/pallas_subg.py) was RETIRED in r05 by STATUS_r04.md's
+    #: written deadline decision: measured 0.98× XLA steady-state and
+    #: 0.867× wall with a slower Mosaic compile
+    #: (r02_grid_fused_subg_tpu.json), and its class of fresh Mosaic
+    #: compile is the leading tunnel-wedge suspect. The kernel lives in
+    #: git history (r04 tree) should hardware ever favor it.
     fused: str = "off"
     out_dir: str | None = None
     resume: bool = True
@@ -150,9 +154,14 @@ def validate_fused(fused: str, backend: str) -> None:
     """Shared fail-fast for the fused knob (run_grid and the R bridge):
     a typo'd value or a silently-never-fusing backend must raise before
     any work is dispatched."""
-    if fused not in ("off", "auto", "all"):
+    if fused == "all":
         raise ValueError(
-            f"fused must be 'off', 'auto' or 'all', got {fused!r}")
+            "fused='all' (the perf-neutral fused subG pair) was retired "
+            "in r05 — measured 0.98x XLA, r02_grid_fused_subg_tpu.json; "
+            "use 'auto' (the measured-faster sign kernel) or 'off'")
+    if fused not in ("off", "auto"):
+        raise ValueError(
+            f"fused must be 'off' or 'auto', got {fused!r}")
     if fused != "off" and backend != "bucketed":
         raise ValueError(
             f"fused={fused!r} requires backend='bucketed', got {backend!r}")
@@ -160,32 +169,24 @@ def validate_fused(fused: str, backend: str) -> None:
 
 def _fused_bucket_ok(gcfg: GridConfig, cfg: SimConfig) -> str | None:
     """Which fused Pallas kernel (if any) covers this (n, ε) bucket:
-    ``"sign"`` (Gaussian sign-estimator pair, ops/pallas_ni.py), ``"subg"``
-    (bounded-factor subG grid-variant pair, ops/pallas_subg.py), or None.
-    Gated on: opt-in (``fused`` in "auto"/"all" — "auto" selects only the
-    measured-faster sign kernel, "all" adds the perf-neutral subG kernel;
-    GridConfig.fused has the numbers), single-device bucketed backend,
-    real TPU, det mixquant (the closed-form quantile — the kernel emits
-    scalars, the per-CI MC variant draws from the key-tree the kernel
-    doesn't carry), and the kernel's (m ≤ 128, k ≥ 2) batch geometry."""
+    ``"sign"`` (Gaussian sign-estimator pair, ops/pallas_ni.py) or None.
+    Gated on: opt-in (``fused="auto"`` — selects only the
+    measured-faster sign kernel; GridConfig.fused has the numbers and
+    the r05 retirement note for the former subG kernel), single-device
+    bucketed backend, real TPU, det mixquant (the closed-form quantile —
+    the kernel emits scalars, the per-CI MC variant draws from the
+    key-tree the kernel doesn't carry), and the kernel's (m ≤ 128,
+    k ≥ 2) batch geometry."""
     validate_fused(gcfg.fused, "bucketed")  # pure value check here
     if gcfg.fused == "off" or gcfg.backend != "bucketed":
         return None
     if cfg.stream_n_chunk or cfg.mixquant_mode != "det":
         return None
-    if cfg.use_subg:
-        # the real-data variant's randomized batch permutation has no
-        # in-kernel equivalent (pallas_subg.py docstring); fused subG is
-        # "all"-only — it measures perf-neutral vs XLA (GridConfig.fused)
-        if gcfg.fused != "all":
-            return None
-        if cfg.dgp != "bounded_factor" or cfg.subg_variant != "grid":
-            return None
-        kind = "subg"
-    elif cfg.dgp == "gaussian":
-        kind = "sign"
-    else:
+    if cfg.use_subg or cfg.dgp != "gaussian":
+        # subG buckets always run the XLA kernel since the r05
+        # retirement (GridConfig.fused)
         return None
+    kind = "sign"
     import jax
 
     # "Pallas-capable TPU" in practice means two platform strings: "tpu"
@@ -289,23 +290,15 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                     rhos = jnp.repeat(
                         jnp.asarray([r.rho for r in to_run], jnp.float32),
                         gcfg.b)
-                    if fused == "subg":
-                        from dpcorr.ops import pallas_subg
+                    from dpcorr.ops import pallas_ni
 
-                        raw = pallas_subg.sim_detail_subg_pallas(
-                            seeds, rhos, cfg.n, cfg.eps1, cfg.eps2,
-                            eta1=cfg.eta1, eta2=cfg.eta2,
-                            alpha=cfg.alpha, interpret=False)
-                    else:
-                        from dpcorr.ops import pallas_ni
-
-                        args = dict(cfg.dgp_args)
-                        raw = pallas_ni.sim_detail_pallas(
-                            seeds, rhos, cfg.n, cfg.eps1, cfg.eps2,
-                            mu=args.get("mu", (0.0, 0.0)),
-                            sigma=args.get("sigma", (1.0, 1.0)),
-                            alpha=cfg.alpha, ci_mode=cfg.ci_mode,
-                            normalise=cfg.normalise, interpret=False)
+                    args = dict(cfg.dgp_args)
+                    raw = pallas_ni.sim_detail_pallas(
+                        seeds, rhos, cfg.n, cfg.eps1, cfg.eps2,
+                        mu=args.get("mu", (0.0, 0.0)),
+                        sigma=args.get("sigma", (1.0, 1.0)),
+                        alpha=cfg.alpha, ci_mode=cfg.ci_mode,
+                        normalise=cfg.normalise, interpret=False)
                 except Exception as e:
                     # fused is best-effort: a lowering/compile failure on
                     # this bucket's shape degrades to the XLA kernel (the

@@ -109,10 +109,12 @@ def _rand_uniform(shape):
     return _uniform(pltpu.prng_random_bits(shape))
 
 
-# ---- scaffolding shared by every replication kernel (this module and
-# pallas_subg.py): seed words, uniform source, layout masks, aggregation
-# matrix, and the pallas_call shell. One copy — the lane-group mask and
-# BlockSpec rules are the easiest places for two kernels to drift apart.
+# ---- scaffolding shared by every replication kernel in this module:
+# seed words, uniform source, layout masks, aggregation matrix, and the
+# pallas_call shell. One copy — the lane-group mask and BlockSpec rules
+# are the easiest places for kernels to drift apart. (The fused subG
+# kernel, pallas_subg.py, consumed this scaffolding until its r05
+# retirement — GridConfig.fused has the decision record.)
 
 
 def _seed_words(seeds) -> jax.Array:

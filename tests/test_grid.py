@@ -169,19 +169,16 @@ def test_fused_bucket_eligibility(monkeypatch):
     # m = ceil(8/(0.05·0.05)) = 3200 > 128 lanes
     assert not g._fused_bucket_ok(
         gc, dataclasses.replace(cfg, eps1=0.05, eps2=0.05))
-    # subG: fused only under "all" (perf-neutral vs XLA — GridConfig.fused)
-    # and only for the grid-variant bounded-factor pair
-    gc_all = dataclasses.replace(gc, fused="all")
+    # subG buckets never fuse since the r05 fused="all" retirement
+    # (GridConfig.fused: measured 0.98x XLA, r02_grid_fused_subg_tpu.json)
     subg = dataclasses.replace(cfg, use_subg=True, dgp="bounded_factor")
-    assert not g._fused_bucket_ok(gc, subg)  # "auto" never fuses subG
-    assert g._fused_bucket_ok(gc_all, subg) == "subg"
-    assert g._fused_bucket_ok(gc_all, cfg) == "sign"  # "all" ⊇ "auto"
+    assert not g._fused_bucket_ok(gc, subg)
     assert not g._fused_bucket_ok(
-        gc_all, dataclasses.replace(subg, subg_variant="real"))
-    assert not g._fused_bucket_ok(
-        gc_all, dataclasses.replace(subg, dgp="mix_gaussian"))
-    assert not g._fused_bucket_ok(
-        gc_all, dataclasses.replace(cfg, use_subg=True))  # gaussian + subG
+        gc, dataclasses.replace(cfg, use_subg=True))  # gaussian + subG
+    # the retired mode fails fast with the retirement citation, a typo'd
+    # value with the plain message
+    with pytest.raises(ValueError, match="retired"):
+        g._fused_bucket_ok(dataclasses.replace(gc, fused="all"), cfg)
     with pytest.raises(ValueError, match="fused"):
         g._fused_bucket_ok(dataclasses.replace(gc, fused="bogus"), cfg)
 

@@ -50,8 +50,17 @@ def cmd_demo(args):
                                               "sigma": (2.0, 0.1)})
     t0 = time.perf_counter()
     res = run_sim_one(cfg)
+    # the config echo is the FULL design point (incl. dgp_args/normalise/
+    # seed): tests/test_golden_demo.py pins it against vert-cor.R:449-458,
+    # so silent drift in any field would invalidate the R-bridge
+    # comparison recipe (docs/R_BRIDGE.md)
     print(json.dumps({"config": {"n": cfg.n, "rho": cfg.rho,
-                                 "eps": [cfg.eps1, cfg.eps2], "B": cfg.b},
+                                 "eps": [cfg.eps1, cfg.eps2], "B": cfg.b,
+                                 "dgp": cfg.dgp,
+                                 "dgp_args": {k: list(v) for k, v in
+                                              dict(cfg.dgp_args).items()},
+                                 "normalise": cfg.normalise,
+                                 "seed": cfg.seed},
                       "summary": res.summary,
                       "seconds": round(time.perf_counter() - t0, 2)},
                      indent=2))

@@ -152,3 +152,41 @@ def test_r04_second_point_resolves_margin_question():
     nominal = table["nominal"]
     assert (abs(row["det"]["INT"]["coverage"] - nominal)
             <= abs(row["mc"]["INT"]["coverage"] - nominal))
+
+
+def test_det_mc_gap_scales_inversely_with_reference_nsim():
+    """The decisive attribution check (r05; VERDICT r4 'what's weak' #3):
+    if the det-vs-MC INT coverage gap is the MC mode's finite-nsim
+    order-statistic quantile bias, it must scale ~1/nsim — the
+    reference's grid scripts draw nsim=1000 (vert-cor.R:44-56), its
+    real-data script nsim=2000 (real-data-sims.R:161-164), and the
+    framework's mc mode reproduces each faithfully
+    (``ci_int_subg``'s variant-aware default).
+
+    Measured across every checked-in campaign table: the nsim=1000
+    points (sign_normal, subg_factor — r02, B≥1e6) sit at ~1.88e-3 and
+    the nsim=2000 points (subg_real flavor — r03/r04 campaigns, four
+    configs from n=1000 to n=19,433) at ~0.85-1.03e-3: a ratio of ~2.0
+    matching the nsim ratio exactly. A det-mode *error* would have no
+    reason to halve when the reference's own draw count doubles."""
+    by_nsim = {1000: [], 2000: []}
+    for path in sorted(RESULTS_DIR.glob("acceptance_*.json")):
+        table = json.loads(path.read_text())
+        for row in table["points"]:
+            if "int_det_mc_diff" not in row:
+                continue
+            variant = row["config"].get("subg_variant", "grid")
+            use_subg = row["config"].get("use_subg", False)
+            nsim = 2000 if (use_subg and variant == "real") else 1000
+            by_nsim[nsim].append(float(row["int_det_mc_diff"]))
+    if not (by_nsim[1000] and by_nsim[2000]):
+        pytest.skip("need campaign tables at both nsim flavors")
+    mean1k = sum(by_nsim[1000]) / len(by_nsim[1000])
+    mean2k = sum(by_nsim[2000]) / len(by_nsim[2000])
+    # the claim is about the GROUP MEANS; per-point caps are loose
+    # (mean + ~3 MC SE at the noisiest table, SE up to 4.3e-4 at the
+    # reduced-B insurance point) so a fresh on-chip draw of the same
+    # true gap cannot fail spuriously — the direction is the strict part
+    assert 1.4 <= mean1k / mean2k <= 2.8, (mean1k, mean2k)
+    assert all(d <= 3.2e-3 for d in by_nsim[1000])
+    assert all(d <= 2.2e-3 for d in by_nsim[2000])

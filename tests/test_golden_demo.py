@@ -105,3 +105,7 @@ def test_demo_cli_runs_the_reference_config(capsys):
                              "dgp_args": {"mu": [2.0, 2.0],
                                           "sigma": [2.0, 0.1]},
                              "normalise": True, "seed": 2025}
+    # summary sanity at the smoke count (absorbed from test_cli's former
+    # test_demo so the suite pays for one demo invocation, not two)
+    for meth in ("NI", "INT"):
+        assert 0.0 <= out["summary"][meth]["coverage"] <= 1.0

@@ -552,7 +552,8 @@ def main() -> None:
         if out is not None:
             out["detail"]["degraded"] = "tpu-init-failed"
             here = os.path.dirname(os.path.abspath(__file__))
-            for evidence_rel in ("benchmarks/results/r04_tpu_headline.json",
+            for evidence_rel in ("benchmarks/results/r05_tpu_headline.json",
+                                 "benchmarks/results/r04_tpu_headline.json",
                                  "benchmarks/results/r03_tpu_headline.json",
                                  "benchmarks/results/r02_tpu_headline.json"):
                 if os.path.exists(os.path.join(here,

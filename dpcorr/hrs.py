@@ -11,10 +11,12 @@ BMI-vs-Age DP correlation on wave 2 of the HRS long panel:
    and INT with AGE as sender (:290-323);
 6. ε-sweep: for each ε in a grid, R Monte-Carlo replications of both
    estimators (:342-448). The reference runs these 9,200 estimator calls
-   serially in R; here each ε is one ``jit(vmap)`` kernel over the
-   replication axis (batch geometry (m, k) is ε-dependent, so kernels
-   compile per ε — the shape-bucket strategy of SURVEY.md §7), and the
-   23-kernel sweep streams on one chip or shards over a mesh.
+   serially in R; here the ENTIRE grid is served by two compiled
+   ``jit(vmap)`` kernels (one NI, one INT — r05): ε is a traced scalar,
+   the ε-dependent batch geometry (m, k) becomes in-kernel masked data
+   (``correlation_ni_subg(dynamic_geometry=True)``), and the protocol
+   direction is named explicitly (``sender="x"``), so no per-ε
+   recompile exists to hide (PERFORMANCE.md §ε-sweep: 9.2× on CPU).
 
 Everything below the ingest boundary is pure JAX on device; only the
 column extraction and the final pandas summaries run on host.
@@ -200,23 +202,41 @@ def point_estimates(cfg: HrsConfig = HrsConfig(), cols=None) -> HrsPointResult:
 
 
 # --------------------------------------------------------------- ε-sweep ----
-@partial(jax.jit, static_argnums=(3, 8, 9))
-def _sweep_eps_kernel(keys_ni, keys_int, arrays, eps: float, lam_age,
-                      lam_bmi, lam_recv, delta, alpha: float,
-                      mixquant_mode: str):
-    """All replications of both methods at one ε as a single fused kernel."""
+# ONE compiled kernel per method serves the ENTIRE ε grid (r05): ε, the
+# λs and δ are traced scalars — the NI batch geometry becomes in-kernel
+# data via the masked dynamic-geometry estimator, and the INT direction
+# is named explicitly (sender="x" = AGE, the reference's AGE→BMI) so no
+# Python branch needs a concrete ε. The r04 design compiled one fused
+# kernel per ε (23 compiles ≈ 75 s of a 23-ε CPU sweep at small reps);
+# this compiles twice, total, for any grid size.
+@partial(jax.jit, static_argnums=(5,))
+def _sweep_ni_kernel(keys_ni, arrays, eps, lam_age, lam_bmi, alpha: float):
     age_z, bmi_z = arrays
 
     def ni(k):
-        r = _ni_once(k, age_z, bmi_z, eps, lam_age, lam_bmi, alpha)
+        r = correlation_ni_subg(k, age_z, bmi_z, eps, eps, alpha=alpha,
+                                lambda_x=lam_age, lambda_y=lam_bmi,
+                                randomize_batches=True, enforce_min_k=True,
+                                dynamic_geometry=True)
         return r.rho_hat, r.ci_low, r.ci_high
+
+    return jax.vmap(ni)(keys_ni)
+
+
+@partial(jax.jit, static_argnums=(7, 8))
+def _sweep_int_kernel(keys_int, arrays, eps, lam_age, lam_bmi, lam_recv,
+                      delta, mixquant_mode: str, alpha: float):
+    age_z, bmi_z = arrays
 
     def it(k):
-        r = _int_once(k, age_z, bmi_z, eps, lam_age, lam_bmi, lam_recv,
-                      delta, alpha, mixquant_mode)
+        r = ci_int_subg(k, age_z, bmi_z, eps, eps, alpha=alpha,
+                        variant="real", lambda_sender=lam_age,
+                        lambda_other=lam_bmi, lambda_receiver=lam_recv,
+                        delta_clip=delta, mixquant_mode=mixquant_mode,
+                        sender="x")
         return r.rho_hat, r.ci_low, r.ci_high
 
-    return jax.vmap(ni)(keys_ni), jax.vmap(it)(keys_int)
+    return jax.vmap(it)(keys_int)
 
 
 def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
@@ -242,14 +262,14 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
     master = rng.master_key(cfg.seed)
     arrays = (std.age_z, std.bmi_z)
 
-    # Dispatch-ahead over the ε axis (the grid backend's pattern): each ε
-    # has its own batch geometry, so each compiles its own kernel — by
-    # dispatching every ε before the first fetch, ε_{j+1}'s host-side
-    # compile overlaps ε_j's device execution instead of serializing
-    # 23 compile+run cycles (real-data-sims.R:345-448 is fully serial).
-    # receiver λs fetched BEFORE the first kernel dispatch: float() of a
-    # device value after a dispatch would queue behind the in-flight sweep
-    # kernel and re-serialize the pipeline
+    # Two compiles serve the whole grid (see the kernel comment above):
+    # ε enters as a traced scalar, so dispatching the grid is 2·|grid|
+    # launches of the same two compiled programs — no per-ε compile, no
+    # compile/execute pipelining needed (the r04 dispatch-ahead design
+    # existed to hide 23 per-ε compiles; real-data-sims.R:345-448 is
+    # fully serial). receiver λs fetched BEFORE the first dispatch:
+    # float() of a device value after a dispatch would queue behind the
+    # in-flight sweep kernel and serialize the pipeline.
     lam_recvs = [float(lambda_receiver_from_noise(std.lam_age, std.lam_bmi,
                                                   float(e), delta))
                  for e in eps_grid]
@@ -264,9 +284,14 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
         if progress:
             print(f"eps={eps:.2f}: dispatched "
                   f"({eps_idx + 1}/{len(eps_grid)})", flush=True)
-        pending.append((eps, _sweep_eps_kernel(
-            keys_ni, keys_int, arrays, eps, std.lam_age, std.lam_bmi,
-            lam_recvs[eps_idx], delta, cfg.alpha, cfg.mixquant_mode)))
+        eps_t = jnp.float32(eps)
+        pending.append((eps, (
+            _sweep_ni_kernel(keys_ni, arrays, eps_t, std.lam_age,
+                             std.lam_bmi, cfg.alpha),
+            _sweep_int_kernel(keys_int, arrays, eps_t, std.lam_age,
+                              std.lam_bmi, jnp.float32(lam_recvs[eps_idx]),
+                              jnp.float32(delta), cfg.mixquant_mode,
+                              cfg.alpha))))
 
     runs = []
     for eps, out in pending:

@@ -48,6 +48,50 @@ def batch_geometry(n: int, eps1: float, eps2: float,
     return m, k
 
 
+def batch_geometry_dyn(n: int, eps1, eps2,
+                       enforce_min_k: bool = False):
+    """Traced (m, k) int32 scalars for :func:`batch_geometry`'s rule —
+    the ε values may be JAX tracers, so ONE compiled kernel can serve an
+    entire ε-sweep (m and k become *data*, not program structure; the
+    HRS sweep's 23 per-ε compiles collapse to one, `dpcorr/hrs.py`).
+    ``n`` stays static: it is the physical array length and every shape
+    in the masked kernel derives from it."""
+    if n < 1:
+        raise ValueError(f"Need at least one observation, got n={n}")
+    q = 8.0 / (jnp.asarray(eps1, jnp.float32)
+               * jnp.asarray(eps2, jnp.float32))
+    # two float32 guards the static (float64) path never needs:
+    # - the (1 - 1e-6) factor absorbs f32 round-UP at integer
+    #   boundaries (e.g. ε=√2 squares to just under 2 in f32, making
+    #   q = 4.0000001 and ceil jump to 5 where the static rule gives 4);
+    #   a genuine fractional q is never 1e-6-close to an integer at
+    #   these magnitudes, so only rounding artifacts snap down
+    # - clipping BEFORE the int cast bounds q while still a float: at
+    #   tiny ε₁ε₂ the unclipped f32 value can exceed int32 range, where
+    #   astype would be implementation-defined instead of m=n
+    m = jnp.clip(jnp.ceil(q * (1.0 - 1e-6)), 1.0, n).astype(jnp.int32)
+    k = n // m
+    if enforce_min_k:
+        fallback = k < 2
+        k = jnp.where(fallback, 2, k)
+        m = jnp.where(fallback, n // 2, m)
+    return m, k
+
+
+def batch_means_dyn(v: jax.Array, m, k) -> jax.Array:
+    """Masked equivalent of :func:`batch_means` for traced (m, k): means
+    of the k consecutive batches of size m over the first k·m entries,
+    returned padded to length n (entry j is meaningful only for j < k —
+    mask downstream with ``arange(n) < k``). Element i contributes to
+    batch i//m when i < k·m and to a discard bucket otherwise, so the
+    per-batch sums keep the static path's consecutive-element order."""
+    n = v.shape[0]
+    idx = jnp.arange(n)
+    seg = jnp.where(idx < k * m, idx // m, n)
+    sums = jax.ops.segment_sum(v, seg, num_segments=n + 1)
+    return sums[:n] / m
+
+
 def sample_sd(x: jax.Array) -> jax.Array:
     """R's ``sd``: denominator n−1."""
     return jnp.std(x, ddof=1)

@@ -63,22 +63,34 @@ def ci_int_subg(key: jax.Array, x: jax.Array, y: jax.Array,
                 lambda_sender=None, lambda_other=None, lambda_receiver=None,
                 delta_clip: float | None = None,
                 mixquant_mode: str = "det",
-                mixquant_nsim: int | None = None) -> CorrResult:
+                mixquant_nsim: int | None = None,
+                sender: str | None = None) -> CorrResult:
     """One-round interactive clipped DP correlation estimate + mixture CI.
 
     ``mixquant_nsim`` sets the MC draw count when ``mixquant_mode="mc"``;
     the default follows the reference per variant — 1000 for the grid
     script's mixquant (ver-cor-subG.R:10) and **2000** for the real-data
     script's (real-data-sims.R:161-164).
+
+    ``sender`` fixes the protocol direction explicitly: ``"x"`` or
+    ``"y"``; ``None`` keeps the larger-ε rule (ver-cor-subG.R:76-81).
+    The real-data script names its direction outright (AGE→BMI,
+    real-data-sims.R:305) rather than relying on the ε tie-break, and an
+    explicit direction is also what lets the ε values be JAX tracers
+    (the larger-ε rule is a Python-level branch on concrete floats) —
+    which is how the HRS sweep serves every ε from one compiled kernel.
     """
     if variant not in ("grid", "real"):
         raise ValueError(f"variant must be 'grid' or 'real', got {variant!r}")
+    if sender not in (None, "x", "y"):
+        raise ValueError(f"sender must be None, 'x' or 'y', got {sender!r}")
     if mixquant_nsim is None:
         mixquant_nsim = 2000 if variant == "real" else 1000
     n = x.shape[0]
 
-    # Roles: larger ε sends (ver-cor-subG.R:76-81) — static.
-    sender_is_x = eps1 >= eps2
+    # Roles: larger ε sends (ver-cor-subG.R:76-81) — static — unless the
+    # caller names the direction (see docstring).
+    sender_is_x = (sender == "x") if sender else bool(eps1 >= eps2)
     eps_s, eps_r = (eps1, eps2) if sender_is_x else (eps2, eps1)
     eta_s, eta_r = (eta1, eta2) if sender_is_x else (eta2, eta1)
     xs, xo = (x, y) if sender_is_x else (y, x)  # sender var, other var

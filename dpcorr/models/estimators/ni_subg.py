@@ -27,7 +27,9 @@ from jax.scipy.special import ndtri
 from dpcorr.models.estimators.common import (
     CorrResult,
     batch_geometry,
+    batch_geometry_dyn,
     batch_means,
+    batch_means_dyn,
     sample_sd,
 )
 from dpcorr.ops.lambdas import lambda_n
@@ -41,14 +43,31 @@ def correlation_ni_subg(key: jax.Array, x: jax.Array, y: jax.Array,
                         alpha: float = 0.05,
                         lambda_x=None, lambda_y=None,
                         randomize_batches: bool = False,
-                        enforce_min_k: bool = False) -> CorrResult:
-    """Clipped-batch DP correlation estimate + normal CI."""
+                        enforce_min_k: bool = False,
+                        dynamic_geometry: bool = False) -> CorrResult:
+    """Clipped-batch DP correlation estimate + normal CI.
+
+    ``dynamic_geometry=True`` accepts *traced* ε values: (m, k) become
+    in-kernel data (masked segment sums padded to n) so one compiled
+    kernel serves every ε of a sweep — the TPU-first answer to the
+    reference's 23 serial per-ε runs (real-data-sims.R:345-448). The
+    batch assignment is identical to the static path (same permutation
+    stream, same consecutive-element grouping); the per-batch Laplace
+    draws come from a padded (n,)-shaped call, so the two paths are the
+    same estimator on *different PRNG stream layouts* — statistically
+    interchangeable, not bit-equal (pinned by
+    tests/test_estimators.py::test_ni_subg_dynamic_geometry_*).
+    """
     n = x.shape[0]
     lam1 = lambda_n(n, eta1) if lambda_x is None else lambda_x
     lam2 = lambda_n(n, eta2) if lambda_y is None else lambda_y
 
     xc = clip_sym(x, lam1)  # ver-cor-subG.R:33-34
     yc = clip_sym(y, lam2)
+
+    if dynamic_geometry:
+        return _ni_subg_dyn(key, xc, yc, n, eps1, eps2, lam1, lam2,
+                            alpha, randomize_batches, enforce_min_k)
 
     m, k = batch_geometry(n, eps1, eps2, enforce_min_k=enforce_min_k)
     if randomize_batches:
@@ -70,5 +89,42 @@ def correlation_ni_subg(key: jax.Array, x: jax.Array, y: jax.Array,
     lo = jnp.maximum(rho_hat - crit * se, -1.0)  # ρ-space clamp (:58-59)
     hi = jnp.minimum(rho_hat + crit * se, 1.0)
     # the real-data variant's richer return (real-data-sims.R:141-147)
+    aux = {"k": k, "m": m, "lambda_x": lam1, "lambda_y": lam2}
+    return CorrResult(rho_hat, lo, hi, aux)
+
+
+def _ni_subg_dyn(key, xc, yc, n: int, eps1, eps2, lam1, lam2,
+                 alpha: float, randomize_batches: bool,
+                 enforce_min_k: bool) -> CorrResult:
+    """Masked-geometry body: same math as the static path with (m, k) as
+    traced scalars and every per-batch vector padded to length n."""
+    m, k = batch_geometry_dyn(n, eps1, eps2, enforce_min_k=enforce_min_k)
+    if randomize_batches:
+        # full permutation; positions ≥ k·m fall into the discard bucket
+        # inside batch_means_dyn, so the first k·m elements — the ones
+        # the static path gathers — form the same randomized batches
+        perm = jax.random.permutation(stream(key, "ni_subg/perm"), n)
+        xc, yc = xc[perm], yc[perm]
+
+    mf = m.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    xbar = batch_means_dyn(xc, m, k)
+    ybar = batch_means_dyn(yc, m, k)
+    xt = xbar + laplace(stream(key, "ni_subg/lap_x"), (n,),
+                        2.0 * lam1 / (mf * eps1))
+    yt = ybar + laplace(stream(key, "ni_subg/lap_y"), (n,),
+                        2.0 * lam2 / (mf * eps2))
+
+    valid = jnp.arange(n) < k
+    prod = jnp.where(valid, xt * yt, 0.0)
+    rho_hat = (mf / kf) * jnp.sum(prod)
+
+    tj = mf * xt * yt
+    mean_tj = jnp.sum(jnp.where(valid, tj, 0.0)) / kf
+    var_tj = jnp.sum(jnp.where(valid, (tj - mean_tj) ** 2, 0.0)) / (kf - 1.0)
+    se = jnp.sqrt(var_tj) / jnp.sqrt(kf)
+    crit = ndtri(1.0 - alpha / 2.0)
+    lo = jnp.maximum(rho_hat - crit * se, -1.0)
+    hi = jnp.minimum(rho_hat + crit * se, 1.0)
     aux = {"k": k, "m": m, "lambda_x": lam1, "lambda_y": lam2}
     return CorrResult(rho_hat, lo, hi, aux)

@@ -329,3 +329,141 @@ class TestIntSignCiModes:
         np.testing.assert_allclose(float(res.ci_low), lo, rtol=1e-5)
         np.testing.assert_allclose(float(res.ci_high), hi, rtol=1e-5)
 
+
+
+class TestNiSubgDynamicGeometry:
+    """dynamic_geometry=True: the masked single-compile variant (r05) —
+    same estimator math with (m, k) as traced data, so one compiled
+    kernel serves an ε-sweep (dpcorr/hrs.py's 2-compile sweep)."""
+
+    def test_matches_static_with_noise_silenced(self, monkeypatch):
+        """With the Laplace draws zeroed, both paths are deterministic
+        functions of the same clipped/permuted data and the same (m, k)
+        rule — they must agree to float tolerance at several ε spanning
+        very different geometries (m from 128 down to 2)."""
+        from dpcorr.models.estimators import ni_subg as mod
+
+        monkeypatch.setattr(mod, "laplace",
+                            lambda key, shape, scale: jnp.zeros(shape))
+        x, y = _data(n=3000)
+        for eps in (0.25, 0.7, 1.0, 2.5):
+            for randomize in (False, True):
+                a = correlation_ni_subg(KEY, x, y, eps, eps,
+                                        randomize_batches=randomize)
+                b = correlation_ni_subg(KEY, x, y,
+                                        jnp.float32(eps), jnp.float32(eps),
+                                        randomize_batches=randomize,
+                                        dynamic_geometry=True)
+                for fa, fb in zip(a[:3], b[:3]):
+                    np.testing.assert_allclose(float(fa), float(fb),
+                                               rtol=2e-5, atol=2e-6)
+                assert int(b.aux["m"]) == a.aux["m"]
+                assert int(b.aux["k"]) == a.aux["k"]
+
+    def test_distributionally_equivalent_with_noise(self):
+        """With real noise the two paths draw from different stream
+        layouts (padded (n,) vs exact (k,)) — same distribution, not the
+        same values. Pin mean agreement over seeds at a tight-noise ε."""
+        x, y = _data(n=2000, rho=0.5)
+        stat, dyn = [], []
+        for s in range(30):
+            k = rng.master_key(500 + s)
+            stat.append(float(correlation_ni_subg(
+                k, x, y, 10.0, 10.0).rho_hat))
+            dyn.append(float(correlation_ni_subg(
+                k, x, y, jnp.float32(10.0), jnp.float32(10.0),
+                dynamic_geometry=True).rho_hat))
+        assert float(np.mean(stat)) == pytest.approx(float(np.mean(dyn)),
+                                                     abs=0.03)
+        assert float(np.std(stat)) == pytest.approx(float(np.std(dyn)),
+                                                    rel=0.7)
+
+    def test_one_compile_serves_all_eps(self):
+        """The point of the variant: jitting it and calling with many ε
+        values must compile exactly once."""
+        x, y = _data(n=1000)
+
+        @jax.jit
+        def kern(key, eps):
+            r = correlation_ni_subg(key, x, y, eps, eps,
+                                    dynamic_geometry=True)
+            return r.rho_hat
+
+        for eps in (0.3, 0.5, 1.0, 1.7, 2.5):
+            assert np.isfinite(float(kern(KEY, jnp.float32(eps))))
+        assert kern._cache_size() == 1
+
+    def test_f32_boundary_eps_matches_static_rule(self):
+        """ε=√2 squares to just under 2 in float32, pushing 8/ε² to
+        4.0000001 — without the guard the dyn rule would ceil to m=5
+        where the static (float64) rule gives m=4. Also pin the tiny-ε
+        overflow guard: the float clip must land at m=n, never an
+        implementation-defined int32 cast."""
+        import math
+
+        x, y = _data(n=1000)
+        e = math.sqrt(2.0)
+        m_static, k_static = batch_geometry(1000, e, e)
+        r = correlation_ni_subg(KEY, x, y, jnp.float32(e), jnp.float32(e),
+                                dynamic_geometry=True)
+        assert (int(r.aux["m"]), int(r.aux["k"])) == (m_static, k_static)
+        tiny = correlation_ni_subg(KEY, x, y, jnp.float32(1e-5),
+                                   jnp.float32(1e-5),
+                                   dynamic_geometry=True)
+        assert int(tiny.aux["m"]) == 1000  # clipped to n, k=1
+
+    def test_min_k_fallback_dynamic(self):
+        x, y = _data(n=50)
+        r = correlation_ni_subg(KEY, x, y, jnp.float32(0.5),
+                                jnp.float32(0.5), enforce_min_k=True,
+                                dynamic_geometry=True)
+        assert np.isfinite(float(r.rho_hat))
+        assert int(r.aux["k"]) == 2
+
+
+class TestIntSubgSenderParam:
+    """Explicit protocol direction (r05): the reference's real-data
+    script names AGE→BMI outright (real-data-sims.R:305); sender="x"/"y"
+    encodes that, and is what lets ε be traced in the sweep kernels."""
+
+    def test_explicit_sender_matches_auto_rule(self):
+        x, y = _data()
+        auto = ci_int_subg(KEY, x, y, 2.0, 1.0)          # larger-ε: x sends
+        named = ci_int_subg(KEY, x, y, 2.0, 1.0, sender="x")
+        np.testing.assert_allclose(float(auto.rho_hat),
+                                   float(named.rho_hat), rtol=1e-6)
+
+    def test_sender_overrides_eps_rule(self):
+        """sender can name a direction the larger-ε rule can never
+        produce (the smaller-ε side sending); the choice is slot-
+        independent — naming the same physical sender from either slot
+        computes the same protocol."""
+        x, y = _data()
+        a = ci_int_subg(KEY, x, y, 2.0, 1.0, sender="y")  # y sends at ε=1
+        b = ci_int_subg(KEY, y, x, 1.0, 2.0, sender="x")  # same roles
+        np.testing.assert_allclose(float(a.rho_hat), float(b.rho_hat),
+                                   rtol=1e-5)
+        # and it genuinely differs from what the auto rule would pick
+        auto = ci_int_subg(KEY, x, y, 2.0, 1.0)           # x sends at ε=2
+        assert float(a.rho_hat) != float(auto.rho_hat)
+
+    def test_traced_eps_requires_named_sender(self):
+        """With traced ε the larger-ε rule is untraceable by design —
+        naming the direction is the API for sweep kernels."""
+        x, y = _data(n=500)
+
+        @jax.jit
+        def kern(eps):
+            return ci_int_subg(KEY, x, y, eps, eps, variant="real",
+                               lambda_sender=1.0, lambda_other=1.0,
+                               lambda_receiver=2.0, delta_clip=1e-3,
+                               sender="x").rho_hat
+
+        assert np.isfinite(float(kern(jnp.float32(1.0))))
+        assert np.isfinite(float(kern(jnp.float32(2.0))))
+        assert kern._cache_size() == 1
+
+    def test_bad_sender_raises(self):
+        x, y = _data()
+        with pytest.raises(ValueError, match="sender"):
+            ci_int_subg(KEY, x, y, 1.0, 1.0, sender="z")

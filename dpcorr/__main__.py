@@ -115,7 +115,8 @@ def cmd_grid(args):
     from dpcorr.grid import GridConfig
 
     gcfg = GridConfig(b=args.b or 250, seed=args.seed, backend=args.backend,
-                      fused=args.fused, out_dir=args.out)
+                      fused=args.fused, bucket_merge=args.bucket_merge,
+                      out_dir=args.out)
     _run_grid(args, gcfg, fig1_n=1500, fig1_eps=(1.5, 0.5))
 
 
@@ -126,7 +127,7 @@ def cmd_grid_subg(args):
         n_grid=(2500, 4000, 6000, 9000, 12000),  # ver-cor-subG.R:245
         b=args.b or 250, dgp="bounded_factor", use_subg=True,
         seed=args.seed, backend=args.backend, fused=args.fused,
-        out_dir=args.out)
+        bucket_merge=args.bucket_merge, out_dir=args.out)
     # the reference's subG fig1 slices n=6000 (ver-cor-subG.R:342)
     _run_grid(args, gcfg, fig1_n=6000, fig1_eps=(1.5, 0.5), family="subg")
 
@@ -289,6 +290,13 @@ def main(argv=None):
                                 "measures faster (the Gaussian sign pair, "
                                 "4.5x; the former 'all' subG mode was "
                                 "retired in r05, see GridConfig.fused)")
+            p.add_argument("--bucket-merge", dest="bucket_merge",
+                           default="off", choices=["off", "eps"],
+                           help="eps: merge subG compile buckets across "
+                                "eps-pairs (one kernel per n; traced eps "
+                                "+ in-kernel batch geometry — "
+                                "GridConfig.bucket_merge; subG + "
+                                "--backend bucketed only)")
         p.set_defaults(fn=fn)
     args = ap.parse_args(argv)
     if args.platform:

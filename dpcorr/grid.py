@@ -77,6 +77,19 @@ class GridConfig:
     #: compile is the leading tunnel-wedge suspect. The kernel lives in
     #: git history (r04 tree) should hardware ever favor it.
     fused: str = "off"
+    #: "off" | "eps": ε-merged compile buckets for the bucketed backend
+    #: (r05). "eps" groups subG buckets by n ONLY — ε becomes a traced
+    #: per-point operand and the batch geometry in-kernel masked data
+    #: (sim._run_detail_flat_eps), so the reference's subG grid compiles
+    #: one kernel per n (5) instead of one per (n, ε) (15). subG
+    #: families only (the sign estimators keep static geometry),
+    #: non-streaming, and every ε-pair must satisfy ε₁ ≥ ε₂ (the merged
+    #: kernel names the sender explicitly). Results are statistically
+    #: identical to "off" but NOT bit-identical (the dynamic-geometry
+    #: estimator draws per-batch noise from a padded stream layout), so
+    #: resume caches are stamped "|geom=dyn" and never mix with "off"
+    #: caches — the same contract as the fused stamps.
+    bucket_merge: str = "off"
     out_dir: str | None = None
     resume: bool = True
 
@@ -167,6 +180,30 @@ def validate_fused(fused: str, backend: str) -> None:
             f"fused={fused!r} requires backend='bucketed', got {backend!r}")
 
 
+def validate_bucket_merge(gcfg: GridConfig) -> None:
+    """Fail-fast for the ε-merge knob (GridConfig.bucket_merge): the
+    merged kernel exists only for the subG families on the single-device
+    bucketed backend, and its named-sender contract needs ε₁ ≥ ε₂ on
+    every pair."""
+    if gcfg.bucket_merge not in ("off", "eps"):
+        raise ValueError(f"bucket_merge must be 'off' or 'eps', "
+                         f"got {gcfg.bucket_merge!r}")
+    if gcfg.bucket_merge == "off":
+        return
+    if gcfg.backend != "bucketed":
+        raise ValueError("bucket_merge='eps' requires backend='bucketed', "
+                         f"got {gcfg.backend!r}")
+    if not gcfg.use_subg:
+        raise ValueError("bucket_merge='eps' is subG-only: the sign "
+                         "estimators have no dynamic-geometry variant")
+    bad = [(e1, e2) for e1, e2 in gcfg.eps_pairs if e1 < e2]
+    if bad:
+        raise ValueError(
+            "bucket_merge='eps' names the sender as the ε₁ side, so every "
+            f"pair needs ε₁ ≥ ε₂; violating pairs: {bad} (swap the "
+            "columns, or use bucket_merge='off')")
+
+
 def _fused_bucket_ok(gcfg: GridConfig, cfg: SimConfig) -> str | None:
     """Which fused Pallas kernel (if any) covers this (n, ε) bucket:
     ``"sign"`` (Gaussian sign-estimator pair, ops/pallas_ni.py) or None.
@@ -227,15 +264,39 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
 
     details, timings, failures = {}, [], []
 
+    merged = gcfg.bucket_merge == "eps"
+
+    def merged_k_pad(n: int) -> int:
+        """ONE derivation for both the kernel's static pad and the cache
+        stamp — computed from the CONFIG's full ε set (not a dispatch's
+        subset: the compiled kernel must be reusable across
+        partial-resume dispatches, and the stamp must name the layout
+        the kernel actually used)."""
+        from dpcorr.models.estimators.common import k_pad_for
+
+        return k_pad_for(n, [e1 * e2 for e1, e2 in gcfg.eps_pairs])
+
     def xla_dispatch(cfg, to_run):
         """The XLA bucket dispatch — single source for phase 1 and the
         fetch-time fused fallback, so both stay bit-identical to
-        fused="off" by construction."""
+        fused="off" by construction. In ε-merged mode ε rides as a
+        per-element traced operand next to ρ (one compiled kernel per
+        n; GridConfig.bucket_merge)."""
         keys = jnp.concatenate([
             rng.rep_keys(rng.design_key(master, int(r.i)), gcfg.b)
             for r in to_run])
         rhos = jnp.repeat(jnp.asarray([r.rho for r in to_run], jnp.float32),
                           gcfg.b)
+        if merged:
+            eps1s = jnp.repeat(jnp.asarray([r.eps1 for r in to_run],
+                                           jnp.float32), gcfg.b)
+            eps2s = jnp.repeat(jnp.asarray([r.eps2 for r in to_run],
+                                           jnp.float32), gcfg.b)
+            cfg_noeps = dataclasses.replace(cfg, rho=0.0, seed=0,
+                                            eps1=1.0, eps2=1.0)
+            return sim_mod._run_detail_flat_eps(cfg_noeps, keys, rhos,
+                                                eps1s, eps2s,
+                                                merged_k_pad(cfg.n))
         cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
         if gcfg.backend == "bucketed-sharded":
             from dpcorr.parallel import run_detail_flat_sharded
@@ -249,7 +310,8 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
     # are a few KB of metrics per point, so keeping all buckets in flight
     # costs almost no HBM.
     pending = []
-    for _, grp in design.groupby(["n", "eps1", "eps2"], sort=False):
+    bucket_keys = ["n"] if merged else ["n", "eps1", "eps2"]
+    for _, grp in design.groupby(bucket_keys, sort=False):
         rows = list(grp.itertuples(index=False))
         t0 = time.perf_counter()
         # Same fail-loud-per-point semantics as the local backend: a broken
@@ -257,14 +319,19 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
         # aggregated RuntimeError is raised by run_grid at the end.
         try:
             cfg = gcfg.sim_config(rows[0]._asdict())
-            fused = _fused_bucket_ok(gcfg, cfg)
+            # an ε-merged bucket never fuses: subG is the only merged
+            # family and the fused subG kernel is retired (GridConfig)
+            fused = None if merged else _fused_bucket_ok(gcfg, cfg)
             paths = {int(r.i): (_design_path(out_dir, int(r.i))
                                 if out_dir else None)
                      for r in rows}
 
             def mk_stamps(suffix: str):
+                # ε replaced per row: in merged mode the bucket cfg
+                # carries only the FIRST row's ε (a no-op otherwise)
                 return {int(r.i): _stamp(dataclasses.replace(
-                            cfg, rho=float(r.rho))) + suffix
+                            cfg, rho=float(r.rho), eps1=float(r.eps1),
+                            eps2=float(r.eps2))) + suffix
                         for r in rows}
 
             def scan_cache(candidates, stamps):
@@ -278,7 +345,12 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                         to_run.append(r)
                 return to_run
 
-            stamps = mk_stamps("|fused=pallas" if fused else "")
+            if merged:
+                # k_pad is part of the dyn stream layout — stamp it so
+                # caches from grids with different ε sets never mix
+                merge_tag = "|geom=dyn,kpad=%d" % merged_k_pad(cfg.n)
+            stamps = mk_stamps("|fused=pallas" if fused
+                               else merge_tag if merged else "")
             to_run = scan_cache(rows, stamps)
             raw = None
             if to_run and fused:
@@ -383,7 +455,14 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
         ran = len(to_run)
         total_ran += ran
         timings.append({
-            "n": rows[0].n, "eps1": rows[0].eps1, "eps2": rows[0].eps2,
+            "n": rows[0].n,
+            # a merged bucket spans every ε-pair at this n — per-pair
+            # labels would be misleading, so they go NaN and the count
+            # says what was merged
+            "eps1": np.nan if merged else rows[0].eps1,
+            "eps2": np.nan if merged else rows[0].eps2,
+            "merged_eps_pairs": (len({(r.eps1, r.eps2) for r in rows})
+                                 if merged else 1),
             "points": len(rows), "points_run": ran, "fused": fused,
             "seconds": dispatch_s + fetch_s,
             "dispatch_s": dispatch_s, "fetch_s": fetch_s,
@@ -418,6 +497,7 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
     equivalent of the reference's ``seed = 1e6 + i`` (vert-cor.R:531).
     """
     validate_fused(gcfg.fused, gcfg.backend)
+    validate_bucket_merge(gcfg)
     design = gcfg.design_points()
     master = rng.master_key(gcfg.seed)
     out_dir = Path(gcfg.out_dir) if gcfg.out_dir else None

@@ -16,7 +16,7 @@ BMI-vs-Age DP correlation on wave 2 of the HRS long panel:
    the ε-dependent batch geometry (m, k) becomes in-kernel masked data
    (``correlation_ni_subg(dynamic_geometry=True)``), and the protocol
    direction is named explicitly (``sender="x"``), so no per-ε
-   recompile exists to hide (PERFORMANCE.md §ε-sweep: 9.2× on CPU).
+   recompile exists to hide (PERFORMANCE.md §ε-sweep: 11× on CPU).
 
 Everything below the ingest boundary is pure JAX on device; only the
 column extraction and the final pandas summaries run on host.
@@ -209,15 +209,16 @@ def point_estimates(cfg: HrsConfig = HrsConfig(), cols=None) -> HrsPointResult:
 # Python branch needs a concrete ε. The r04 design compiled one fused
 # kernel per ε (23 compiles ≈ 75 s of a 23-ε CPU sweep at small reps);
 # this compiles twice, total, for any grid size.
-@partial(jax.jit, static_argnums=(5,))
-def _sweep_ni_kernel(keys_ni, arrays, eps, lam_age, lam_bmi, alpha: float):
+@partial(jax.jit, static_argnums=(5, 6))
+def _sweep_ni_kernel(keys_ni, arrays, eps, lam_age, lam_bmi, alpha: float,
+                     k_pad: int | None = None):
     age_z, bmi_z = arrays
 
     def ni(k):
         r = correlation_ni_subg(k, age_z, bmi_z, eps, eps, alpha=alpha,
                                 lambda_x=lam_age, lambda_y=lam_bmi,
                                 randomize_batches=True, enforce_min_k=True,
-                                dynamic_geometry=True)
+                                dynamic_geometry=True, k_pad=k_pad)
         return r.rho_hat, r.ci_low, r.ci_high
 
     return jax.vmap(ni)(keys_ni)
@@ -273,6 +274,9 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
     lam_recvs = [float(lambda_receiver_from_noise(std.lam_age, std.lam_bmi,
                                                   float(e), delta))
                  for e in eps_grid]
+    from dpcorr.models.estimators.common import k_pad_for
+
+    k_pad = k_pad_for(n, [float(e) * float(e) for e in eps_grid])
     pending = []
     for eps_idx, eps in enumerate(eps_grid):
         eps = float(eps)
@@ -287,7 +291,7 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
         eps_t = jnp.float32(eps)
         pending.append((eps, (
             _sweep_ni_kernel(keys_ni, arrays, eps_t, std.lam_age,
-                             std.lam_bmi, cfg.alpha),
+                             std.lam_bmi, cfg.alpha, k_pad),
             _sweep_int_kernel(keys_int, arrays, eps_t, std.lam_age,
                               std.lam_bmi, jnp.float32(lam_recvs[eps_idx]),
                               jnp.float32(delta), cfg.mixquant_mode,

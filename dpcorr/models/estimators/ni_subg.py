@@ -44,7 +44,8 @@ def correlation_ni_subg(key: jax.Array, x: jax.Array, y: jax.Array,
                         lambda_x=None, lambda_y=None,
                         randomize_batches: bool = False,
                         enforce_min_k: bool = False,
-                        dynamic_geometry: bool = False) -> CorrResult:
+                        dynamic_geometry: bool = False,
+                        k_pad: int | None = None) -> CorrResult:
     """Clipped-batch DP correlation estimate + normal CI.
 
     ``dynamic_geometry=True`` accepts *traced* ε values: (m, k) become
@@ -66,8 +67,12 @@ def correlation_ni_subg(key: jax.Array, x: jax.Array, y: jax.Array,
     yc = clip_sym(y, lam2)
 
     if dynamic_geometry:
+        # k_pad: static bound on k from the caller's known ε set
+        # (common.k_pad_for) — shrinks every padded per-batch vector;
+        # None = the always-safe bound n
         return _ni_subg_dyn(key, xc, yc, n, eps1, eps2, lam1, lam2,
-                            alpha, randomize_batches, enforce_min_k)
+                            alpha, randomize_batches, enforce_min_k,
+                            n if k_pad is None else k_pad)
 
     m, k = batch_geometry(n, eps1, eps2, enforce_min_k=enforce_min_k)
     if randomize_batches:
@@ -95,29 +100,36 @@ def correlation_ni_subg(key: jax.Array, x: jax.Array, y: jax.Array,
 
 def _ni_subg_dyn(key, xc, yc, n: int, eps1, eps2, lam1, lam2,
                  alpha: float, randomize_batches: bool,
-                 enforce_min_k: bool) -> CorrResult:
+                 enforce_min_k: bool, k_pad: int) -> CorrResult:
     """Masked-geometry body: same math as the static path with (m, k) as
-    traced scalars and every per-batch vector padded to length n."""
+    traced scalars and every per-batch vector padded to ``k_pad``."""
     m, k = batch_geometry_dyn(n, eps1, eps2, enforce_min_k=enforce_min_k)
     if randomize_batches:
-        # full permutation; positions ≥ k·m fall into the discard bucket
-        # inside batch_means_dyn, so the first k·m elements — the ones
-        # the static path gathers — form the same randomized batches
+        # full permutation; positions ≥ k·m never reach a live batch
+        # (batch_means_dyn only gathers boundary prefix sums below k·m),
+        # so the first k·m elements — the ones the static path gathers —
+        # form the same randomized batches
         perm = jax.random.permutation(stream(key, "ni_subg/perm"), n)
         xc, yc = xc[perm], yc[perm]
 
     mf = m.astype(jnp.float32)
     kf = k.astype(jnp.float32)
-    xbar = batch_means_dyn(xc, m, k)
-    ybar = batch_means_dyn(yc, m, k)
-    xt = xbar + laplace(stream(key, "ni_subg/lap_x"), (n,),
+    xbar = batch_means_dyn(xc, m, k, k_pad)
+    ybar = batch_means_dyn(yc, m, k, k_pad)
+    xt = xbar + laplace(stream(key, "ni_subg/lap_x"), (k_pad,),
                         2.0 * lam1 / (mf * eps1))
-    yt = ybar + laplace(stream(key, "ni_subg/lap_y"), (n,),
+    yt = ybar + laplace(stream(key, "ni_subg/lap_y"), (k_pad,),
                         2.0 * lam2 / (mf * eps2))
 
-    valid = jnp.arange(n) < k
+    valid = jnp.arange(k_pad) < k
     prod = jnp.where(valid, xt * yt, 0.0)
     rho_hat = (mf / kf) * jnp.sum(prod)
+    # pad-bound tripwire: if the traced k ever exceeds the static pad
+    # (a caller passed a k_pad not derived from its real ε set), live
+    # batches would silently be dropped and the estimate biased — a
+    # traced condition can't raise, so poison the result instead; NaNs
+    # fail the aggregation/tests loudly
+    rho_hat = jnp.where(k > k_pad, jnp.nan, rho_hat)
 
     tj = mf * xt * yt
     mean_tj = jnp.sum(jnp.where(valid, tj, 0.0)) / kf

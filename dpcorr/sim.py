@@ -108,14 +108,32 @@ DETAIL_FIELDS = (
 )
 
 
-def _one_rep(key: jax.Array, rho: jax.Array, cfg: SimConfig) -> tuple:
+def _one_rep(key: jax.Array, rho: jax.Array, cfg: SimConfig,
+             eps=None, k_pad: int | None = None) -> tuple:
     """One Monte-Carlo replication: generate → estimate → metrics.
 
     The body of the reference's hot loop (vert-cor.R:392-419,
     ver-cor-subG.R:174-198), as a pure function of the rep key. ``rho`` is
     traced (not baked into the compilation cache) so one compiled kernel
     serves a whole ρ-sweep at fixed (n, ε) — the grid's shape bucket.
+
+    ``eps``: optional traced ``(ε₁, ε₂)`` pair overriding the config's
+    static values — the ε-merged bucket mode (``GridConfig.bucket_merge``):
+    the subG estimators run with in-kernel masked batch geometry and an
+    explicit protocol direction, so one compiled kernel serves every
+    ε-pair at a given n. subG families only (the sign estimators keep
+    static geometry), and the caller must guarantee ε₁ ≥ ε₂ (the named
+    ``sender="x"`` then matches the larger-ε rule the static path applies).
     """
+    if eps is not None and not cfg.use_subg:
+        raise ValueError("traced-eps replication (bucket_merge) is only "
+                         "supported for the subG families")
+    if eps is not None and cfg.stream_n_chunk:
+        # the streaming body's chunk geometry is static — silently
+        # running it at the cfg's placeholder ε would compute every
+        # point at the wrong privacy budget
+        raise ValueError("traced-eps replication (bucket_merge) does not "
+                         "compose with the streaming path")
     if cfg.stream_n_chunk:
         ni, it = _one_rep_streaming(key, rho, cfg)
         return _metrics_row(ni, it, rho)
@@ -125,15 +143,19 @@ def _one_rep(key: jax.Array, rho: jax.Array, cfg: SimConfig) -> tuple:
 
     if cfg.use_subg:
         real = cfg.subg_variant == "real"
-        ni = correlation_ni_subg(rng.stream(key, "ni"), x, y, cfg.eps1,
-                                 cfg.eps2, eta1=cfg.eta1, eta2=cfg.eta2,
+        e1, e2 = (cfg.eps1, cfg.eps2) if eps is None else eps
+        ni = correlation_ni_subg(rng.stream(key, "ni"), x, y, e1,
+                                 e2, eta1=cfg.eta1, eta2=cfg.eta2,
                                  alpha=cfg.alpha,
                                  randomize_batches=real,
-                                 enforce_min_k=real)
-        it = ci_int_subg(rng.stream(key, "int"), x, y, cfg.eps1, cfg.eps2,
+                                 enforce_min_k=real,
+                                 dynamic_geometry=eps is not None,
+                                 k_pad=k_pad)
+        it = ci_int_subg(rng.stream(key, "int"), x, y, e1, e2,
                          eta1=cfg.eta1, eta2=cfg.eta2,
                          alpha=cfg.alpha, variant=cfg.subg_variant,
-                         mixquant_mode=cfg.mixquant_mode)
+                         mixquant_mode=cfg.mixquant_mode,
+                         sender="x" if eps is not None else None)
     else:
         ni = ci_ni_signbatch(rng.stream(key, "ni"), x, y, cfg.eps1, cfg.eps2,
                              alpha=cfg.alpha, normalise=cfg.normalise)
@@ -261,6 +283,21 @@ def _run_detail_flat(cfg_norho: SimConfig, keys: jax.Array, rhos: jax.Array):
     just its cache entry)."""
     return chunked_vmap(lambda k, r: _one_rep(k, r, cfg_norho),
                         (keys, rhos), cfg_norho.chunk_size)
+
+
+@partial(jax.jit, static_argnums=(0, 5))
+def _run_detail_flat_eps(cfg_noeps: SimConfig, keys: jax.Array,
+                         rhos: jax.Array, eps1s: jax.Array,
+                         eps2s: jax.Array, k_pad: int | None = None):
+    """ε-merged bucket kernel: like :func:`_run_detail_flat` but ε is a
+    per-element traced operand too, so ONE compiled kernel serves every
+    (ρ, ε) design point at a given n (``GridConfig.bucket_merge="eps"``;
+    subG families only — see :func:`_one_rep`). ``k_pad``: static pad
+    bound for the per-batch vectors (common.k_pad_for)."""
+    return chunked_vmap(
+        lambda k, r, e1, e2: _one_rep(k, r, cfg_noeps, eps=(e1, e2),
+                                      k_pad=k_pad),
+        (keys, rhos, eps1s, eps2s), cfg_noeps.chunk_size)
 
 
 def _run_detail(cfg: SimConfig, key: jax.Array):

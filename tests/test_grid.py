@@ -252,3 +252,77 @@ def test_fused_fetch_failure_falls_back_to_xla(monkeypatch):
     off = run_grid(GridConfig(**SMALL, backend="bucketed"))
     pd.testing.assert_frame_equal(auto.detail_all, off.detail_all)
     assert not auto.timings["fused"].astype(bool).any()
+
+
+# ---- ε-merged compile buckets (bucket_merge="eps", r05) ----
+
+SUBG_SMALL = dict(n_grid=(400, 800), rho_grid=(0.2, 0.5),
+                  eps_pairs=((0.5, 0.5), (1.0, 1.0), (1.5, 0.5)), b=48,
+                  dgp="bounded_factor", use_subg=True, seed=9)
+
+
+def test_bucket_merge_groups_by_n_only():
+    mrg = run_grid(GridConfig(**SUBG_SMALL, backend="bucketed",
+                              bucket_merge="eps"))
+    assert len(mrg.timings) == 2                       # one bucket per n
+    assert list(mrg.timings["merged_eps_pairs"]) == [3, 3]
+    assert mrg.timings["eps1"].isna().all()            # per-pair labels gone
+    # every design point still produced b replications with its own ε
+    assert len(mrg.detail_all) == 12 * 48
+    assert set(map(tuple, mrg.detail_all[["eps1", "eps2"]]
+                   .drop_duplicates().values)) == set(SUBG_SMALL["eps_pairs"])
+
+
+def test_bucket_merge_statistically_matches_off():
+    """Merged buckets run the dynamic-geometry estimators — same math,
+    padded noise layout. INT is stream-identical (no geometry), NI
+    agrees to float-order effects; grid-level summaries must match
+    tightly."""
+    off = run_grid(GridConfig(**SUBG_SMALL, backend="bucketed"))
+    mrg = run_grid(GridConfig(**SUBG_SMALL, backend="bucketed",
+                              bucket_merge="eps"))
+    s_off = off.summ_all.set_index(["method", "n", "rho_true", "eps1"])
+    s_mrg = mrg.summ_all.set_index(["method", "n", "rho_true", "eps1"])
+    for col, tol in (("coverage", 0.11), ("mse", None)):
+        a = s_off[col].sort_index()
+        b = s_mrg[col].sort_index()
+        if tol is None:
+            np.testing.assert_allclose(a.values, b.values, rtol=0.35)
+        else:
+            assert (a - b).abs().max() <= tol
+    # INT rides the identical stream in both modes — exact agreement
+    int_off = s_off.loc["INT"].sort_index()
+    int_mrg = s_mrg.loc["INT"].sort_index()
+    np.testing.assert_allclose(int_off["coverage"].values,
+                               int_mrg["coverage"].values, atol=1e-6)
+
+
+def test_bucket_merge_validation():
+    import dataclasses as dc
+
+    base = GridConfig(**SUBG_SMALL, backend="bucketed", bucket_merge="eps")
+    with pytest.raises(ValueError, match="bucket_merge"):
+        run_grid(dc.replace(base, bucket_merge="bogus"))
+    with pytest.raises(ValueError, match="subG-only"):
+        run_grid(GridConfig(**SMALL, backend="bucketed",
+                            bucket_merge="eps"))
+    with pytest.raises(ValueError, match="bucketed"):
+        run_grid(dc.replace(base, backend="local"))
+    with pytest.raises(ValueError, match="ε₁ ≥ ε₂|eps"):
+        run_grid(dc.replace(base, eps_pairs=((0.5, 1.5),)))
+
+
+def test_bucket_merge_cache_stamps_never_mix(tmp_path):
+    """Merged results come from a different PRNG layout than "off" —
+    their per-point npz caches carry a "|geom=dyn" stamp, so neither
+    mode can silently serve the other's cached points."""
+    mrg_cfg = GridConfig(**SUBG_SMALL, backend="bucketed",
+                         bucket_merge="eps", out_dir=str(tmp_path))
+    first = run_grid(mrg_cfg)
+    again = run_grid(mrg_cfg)          # same mode -> full cache hit
+    assert again.timings["points_run"].sum() == 0
+    pd.testing.assert_frame_equal(first.detail_all, again.detail_all)
+    off_cfg = GridConfig(**SUBG_SMALL, backend="bucketed",
+                         out_dir=str(tmp_path))
+    off = run_grid(off_cfg)            # stamps differ -> everything re-runs
+    assert off.timings["points_run"].sum() == 12

@@ -1,0 +1,82 @@
+"""On-chip A/B for the ε-merged subG grid buckets (GridConfig.bucket_merge).
+
+CPU already measured the r05 progression (PERFORMANCE.md §bucket_merge:
+0.56× → 0.80× → 1.28× at the reference 120-point B=250 shape); the mode's
+real target is the TPU tunnel, where every compile costs 10-40 s and the
+r02 subG grid was compile-dominated (75.2 s wall for ~2 s of compute,
+r02_grid_fused_subg_tpu.json's "off" arm). This script runs the
+reference subG grid (ver-cor-subG.R:245-269) twice — ``bucket_merge="off"``
+(15 compiles) then ``"eps"`` (5) — and records walls, bucket counts, and
+grid-level statistical summaries of both runs.
+
+Run: python benchmarks/grid_merge_tpu.py [--b 250] [--out ...]
+Default output lands in /tmp quarantine — NEVER directly in
+benchmarks/results/: only harvest_r05.sh's validity gates (complete
+JSON + TPU device stamp) promote it to the checked-in
+r05_grid_merge_tpu.json, so a CPU smoke run can't overwrite banked TPU
+evidence under a _tpu-named file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from benchmarks.grid_fused_tpu import _summ_stats  # noqa: E402  (one impl)
+
+QUARANTINE = os.path.join(os.environ.get("TPU_R05_IN", "/tmp/tpu_r05"),
+                          "grid_merge.json")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--b", type=int, default=250)
+    ap.add_argument("--out", type=str, default=QUARANTINE)
+    args = ap.parse_args()
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+
+    import jax
+
+    from dpcorr.grid import GridConfig, run_grid
+
+    out = {"device": str(jax.devices()[0]), "b": args.b, "runs": {}}
+    for merge in ("off", "eps"):
+        gcfg = GridConfig(n_grid=(2500, 4000, 6000, 9000, 12000),
+                          dgp="bounded_factor", use_subg=True,
+                          b=args.b, backend="bucketed", bucket_merge=merge)
+        t0 = time.perf_counter()
+        res = run_grid(gcfg)
+        wall = time.perf_counter() - t0
+        t = res.timings
+        out["runs"][merge] = {
+            "wall_s": round(wall, 1),
+            "grid_reps_per_sec": round(float(
+                t["grid_reps_per_sec"].iloc[0]), 1),
+            "buckets": len(t),
+            "points": int(t["points"].sum()),
+            **_summ_stats(res),
+        }
+        print(merge, "->", json.dumps(out["runs"][merge]), flush=True)
+
+    o, m = out["runs"]["off"], out["runs"]["eps"]
+    out["merge_speedup_wall"] = round(o["wall_s"] / m["wall_s"], 3)
+    out["coverage_diff_NI"] = round(
+        abs(o["mean_coverage_NI"] - m["mean_coverage_NI"]), 4)
+    out["coverage_diff_INT"] = round(
+        abs(o["mean_coverage_INT"] - m["mean_coverage_INT"]), 4)
+
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print("wrote", args.out, flush=True)
+
+
+if __name__ == "__main__":
+    main()

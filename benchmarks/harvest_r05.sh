@@ -85,11 +85,13 @@ echo "== config5 / suite =="
 copy_tpu_jsonl "$IN/config5.jsonl" "$OUT/r05_tpu_config5.jsonl" stress_n1e6
 copy_tpu_jsonl "$IN/suite.jsonl" "$OUT/r05_tpu_suite.jsonl" stress_n1e6
 
-echo "== acceptance2 =="
-# the campaign writer is atomic per point (.partial.tmp until complete)
-# and stamps "device"; gate on both the criterion fields and the device
-if [ -s "$IN/acceptance_r05_tpu.json" ] \
-   && SRC="$IN/acceptance_r05_tpu.json" python - <<'PY'
+copy_checked_json() {  # copy_checked_json <src> <dst> <required-key>
+  # ONE parse + TPU-device gate for every whole-JSON artifact: the file
+  # must parse, contain <required-key> (only written when the producer
+  # ran to completion), and carry a TPU/axon device stamp — a truncated
+  # or CPU-fallback file must never be promoted under a _tpu name.
+  local src=$1 dst=$2 key=$3
+  if [ -s "$src" ] && SRC="$src" KEY="$key" python - <<'PY'
 import json, os, sys
 
 try:
@@ -97,15 +99,28 @@ try:
 except json.JSONDecodeError:
     sys.exit(1)
 dev = str(t.get("device", ""))
-ok = ("det_mc_pass" in t and t.get("points")
-      and ("TPU" in dev or "axon" in dev.lower()))
+ok = (os.environ["KEY"] in t and ("TPU" in dev or "axon" in dev.lower()))
 sys.exit(0 if ok else 1)
 PY
-then
-  cp "$IN/acceptance_r05_tpu.json" "$OUT/acceptance_r05_tpu.json"
-  echo "wrote $OUT/acceptance_r05_tpu.json"
-else
-  echo "SKIP $OUT/acceptance_r05_tpu.json (missing, truncated, or not TPU)"
+  then
+    cp "$src" "$dst"
+    echo "wrote $dst"
+  else
+    echo "SKIP $dst ($src missing, truncated, incomplete, or not TPU)"
+  fi
+}
+
+echo "== acceptance2 =="
+# the campaign writer is atomic per point (.partial.tmp until complete)
+copy_checked_json "$IN/acceptance_r05_tpu.json" \
+  "$OUT/acceptance_r05_tpu.json" det_mc_pass
+
+echo "== grid_merge A/B =="
+copy_checked_json "$IN/grid_merge.json" \
+  "$OUT/r05_grid_merge_tpu.json" merge_speedup_wall
+if [ -s "$OUT/r05_grid_merge_tpu.json" ]; then
+  SRC="$OUT/r05_grid_merge_tpu.json" python -c \
+    'import json, os; d = json.load(open(os.environ["SRC"])); print("merge speedup:", d["merge_speedup_wall"], "x")'
 fi
 
 echo "== roofline =="

@@ -17,14 +17,17 @@
 #                       1.03e-3 at MC SE 4.3e-4 — this halves the SE).
 #   4. suite          — full 5-config BASELINE suite (VERDICT r4 ask #2).
 #   5. roofline       — refresh the roofline + trace at r05 HEAD.
-#   6. pallas_boxmuller — gauss A/B baseline arm (usually compile-cached).
-#   7. pallas_ndtri   — gauss A/B's other arm, LEASHED to 480 s total
+#   6. grid_merge     — eps-merged subG bucket A/B (bucket_merge="eps",
+#                       pure XLA: 15 vs 5 compiles through the tunnel;
+#                       CPU already measured 1.28x, PERFORMANCE.md).
+#   7. pallas_boxmuller — gauss A/B baseline arm (usually compile-cached).
+#   8. pallas_ndtri   — gauss A/B's other arm, LEASHED to 480 s total
 #                       (VERDICT r4 ask #4: its uncached Mosaic compile
 #                       hung 900 s and wedged the tunnel at r04 03:36Z —
 #                       one bounded attempt, then the cap below retires
 #                       it). boxmuller stays the kernel default either
 #                       way (r04_pallas_boxmuller.json: 953,775 >= XLA).
-#   8. grid_fused_smoke — fused CLI grid end-to-end (--b 8; fused=auto
+#   9. grid_fused_smoke — fused CLI grid end-to-end (--b 8; fused=auto
 #                       Mosaic-compiles, so it lives in this block).
 #
 # grid_fused_subg is GONE: STATUS_r04's written deadline decision
@@ -169,6 +172,11 @@ all_steps() {
      --out "'$OUT'/roofline.json" \
      2>"'$OUT'/roofline.err" | tail -1 | grep -q reps_per_sec'
 
+  run_step grid_merge bash -c \
+    'timeout 2400 python benchmarks/grid_merge_tpu.py \
+     --out "'$OUT'/grid_merge.json" \
+     2>"'$OUT'/grid_merge.err" | tail -2 | grep -q wrote'
+
   # --- Mosaic-risky block: fresh kernel compiles, wedge suspects ---
 
   run_step pallas_boxmuller bash -c \
@@ -188,7 +196,7 @@ all_steps() {
      | tee "'$OUT'/grid_fused_smoke.txt" | grep -q "INT"'
 }
 
-STEP_NAMES="bench_default config5 acceptance2 suite roofline \
+STEP_NAMES="bench_default config5 acceptance2 suite roofline grid_merge \
 pallas_boxmuller pallas_ndtri grid_fused_smoke"
 
 # Steps whose own fresh Mosaic compile is the plausible wedge CAUSE; only

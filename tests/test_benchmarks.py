@@ -77,11 +77,12 @@ def test_queue_script_invokes_real_flags():
     import re
     from pathlib import Path
 
-    from benchmarks import acceptance_point2
+    from benchmarks import acceptance_point2, grid_merge_tpu
 
     repo = Path(__file__).parent.parent
     sh = (repo / "benchmarks" / "tpu_r05_queue.sh").read_text()
-    for script, mod in (("acceptance_point2.py", acceptance_point2),):
+    for script, mod in (("acceptance_point2.py", acceptance_point2),
+                        ("grid_merge_tpu.py", grid_merge_tpu)):
         valid = _parser_flags(mod)
         assert valid, script
         found = 0

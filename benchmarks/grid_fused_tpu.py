@@ -47,6 +47,31 @@ def _summ_stats(res):
     }
 
 
+def run_record(res, wall: float) -> dict:
+    """The shared per-arm record of a grid A/B artifact (this script and
+    grid_merge_tpu.py): wall, steady-state rate, bucket/point counts,
+    summary stats. Script-specific extras are added by the caller; the
+    harvest gates consume these shapes, so the common core lives once."""
+    t = res.timings
+    return {
+        "wall_s": round(wall, 1),
+        "grid_reps_per_sec": round(float(t["grid_reps_per_sec"].iloc[0]), 1),
+        "buckets": len(t),
+        "points": int(t["points"].sum()),
+        **_summ_stats(res),
+    }
+
+
+def ab_coverage_diffs(out: dict, a: str, b: str) -> None:
+    """Record |coverage difference| between two arms — both runs must
+    look like the same calibrated construction."""
+    ra, rb = out["runs"][a], out["runs"][b]
+    out["coverage_diff_NI"] = round(
+        abs(ra["mean_coverage_NI"] - rb["mean_coverage_NI"]), 4)
+    out["coverage_diff_INT"] = round(
+        abs(ra["mean_coverage_INT"] - rb["mean_coverage_INT"]), 4)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--b", type=int, default=250)
@@ -77,29 +102,17 @@ def main() -> None:
         t0 = time.perf_counter()
         res = run_grid(gcfg)
         wall = time.perf_counter() - t0
-        t = res.timings
-        n_points = int(t["points"].sum())
-        out["runs"][fused] = {
-            "wall_s": round(wall, 1),
-            "grid_reps_per_sec": round(float(
-                t["grid_reps_per_sec"].iloc[0]), 1),
-            "buckets": len(t),
-            "fused_buckets": int(t["fused"].astype(bool).sum()),
-            "points": n_points,
-            "total_reps": n_points * args.b,
-            **_summ_stats(res),
-        }
-        print(fused, "->", json.dumps(out["runs"][fused]), flush=True)
+        rec = run_record(res, wall)
+        rec["fused_buckets"] = int(res.timings["fused"].astype(bool).sum())
+        rec["total_reps"] = rec["points"] * args.b
+        out["runs"][fused] = rec
+        print(fused, "->", json.dumps(rec), flush=True)
 
     o, a = out["runs"]["off"], out["runs"][fused_mode]
     out["fused_speedup_wall"] = round(o["wall_s"] / a["wall_s"], 3)
     out["fused_speedup_rps"] = round(
         a["grid_reps_per_sec"] / o["grid_reps_per_sec"], 3)
-    # both runs must look like the same calibrated construction
-    out["coverage_diff_NI"] = round(
-        abs(o["mean_coverage_NI"] - a["mean_coverage_NI"]), 4)
-    out["coverage_diff_INT"] = round(
-        abs(o["mean_coverage_INT"] - a["mean_coverage_INT"]), 4)
+    ab_coverage_diffs(out, "off", fused_mode)
 
     path = args.out or RESULTS[args.family]
     with open(path, "w") as f:

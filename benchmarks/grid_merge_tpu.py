@@ -29,7 +29,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-from benchmarks.grid_fused_tpu import _summ_stats  # noqa: E402  (one impl)
+from benchmarks.grid_fused_tpu import (  # noqa: E402  (one impl of each)
+    ab_coverage_diffs,
+    run_record,
+)
 
 QUARANTINE = os.path.join(os.environ.get("TPU_R05_IN", "/tmp/tpu_r05"),
                           "grid_merge.json")
@@ -54,23 +57,12 @@ def main() -> None:
         t0 = time.perf_counter()
         res = run_grid(gcfg)
         wall = time.perf_counter() - t0
-        t = res.timings
-        out["runs"][merge] = {
-            "wall_s": round(wall, 1),
-            "grid_reps_per_sec": round(float(
-                t["grid_reps_per_sec"].iloc[0]), 1),
-            "buckets": len(t),
-            "points": int(t["points"].sum()),
-            **_summ_stats(res),
-        }
+        out["runs"][merge] = run_record(res, wall)
         print(merge, "->", json.dumps(out["runs"][merge]), flush=True)
 
     o, m = out["runs"]["off"], out["runs"]["eps"]
     out["merge_speedup_wall"] = round(o["wall_s"] / m["wall_s"], 3)
-    out["coverage_diff_NI"] = round(
-        abs(o["mean_coverage_NI"] - m["mean_coverage_NI"]), 4)
-    out["coverage_diff_INT"] = round(
-        abs(o["mean_coverage_INT"] - m["mean_coverage_INT"]), 4)
+    ab_coverage_diffs(out, "off", "eps")
 
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)

@@ -326,3 +326,20 @@ def test_bucket_merge_cache_stamps_never_mix(tmp_path):
                          out_dir=str(tmp_path))
     off = run_grid(off_cfg)            # stamps differ -> everything re-runs
     assert off.timings["points_run"].sum() == 12
+
+
+def test_bucket_merge_composes_with_mc_mixquant():
+    """The merged kernel's traced c* feeds mixquant_mc just like the
+    static path's — the mc mode (the construction-faithful twin) must
+    compose with bucket_merge, not only the det default."""
+    import dataclasses as dc
+
+    base = GridConfig(**{**SUBG_SMALL, "b": 24}, backend="bucketed",
+                      bucket_merge="eps", mixquant_mode="mc")
+    res = run_grid(base)
+    assert len(res.detail_all) == 12 * 24
+    cov = res.summ_all.groupby("method")["coverage"].mean()
+    assert 0.7 < float(cov["INT"]) <= 1.0
+    off = run_grid(dc.replace(base, bucket_merge="off"))
+    a = off.summ_all.groupby("method")["coverage"].mean()
+    assert abs(float(a["INT"]) - float(cov["INT"])) < 0.12

@@ -35,8 +35,24 @@ def main() -> None:
     ap.add_argument("--n", type=int, default=19_433,
                     help="sample size (default: HRS wave-2 complete cases)")
     ap.add_argument("--eps", type=float, default=2.0,
-                    help="ε1=ε2 (default: the HRS pipeline's ε_corr)")
+                    help="ε1 (= ε2 unless --eps2; default: the HRS "
+                         "pipeline's ε_corr)")
+    ap.add_argument("--eps2", type=float, default=None,
+                    help="ε2 when the pair is asymmetric (e.g. the grid "
+                         "scripts' (1.5, 0.5) pair)")
     ap.add_argument("--rho", type=float, default=0.5)
+    ap.add_argument("--variant", choices=["real", "grid"], default="real",
+                    help="subG estimator flavor: 'real' (the HRS/"
+                         "real-data construction, nsim=2000 mc) or "
+                         "'grid' (ver-cor-subG.R's, nsim=1000 mc — for "
+                         "extra points in the det/mc nsim-scaling "
+                         "attribution, tests/test_acceptance.py)")
+    ap.add_argument("--coverage-tol", dest="coverage_tol", type=float,
+                    default=0.0,
+                    help="widened |coverage-nominal| tolerance for "
+                         "constructions with intrinsic finite-n "
+                         "under-coverage (requires --tol-reason)")
+    ap.add_argument("--tol-reason", dest="tol_reason", default="")
     ap.add_argument("--log2b", type=int, default=20,
                     help="log2 of replications per mode (20 ⇒ MC SE ≈ "
                          "2.1e-4 on a 0.95 coverage)")
@@ -48,10 +64,28 @@ def main() -> None:
                     help="force a JAX platform (the site hook ignores "
                          "JAX_PLATFORMS env; this applies config.update "
                          "before backend init)")
-    ap.add_argument("--out", type=str,
-                    default=os.path.join(REPO, "benchmarks", "results",
-                                         "acceptance_r04.json"))
+    ap.add_argument("--out", type=str, default=None,
+                    help="output table path. Default: a variant-named "
+                         "file in the /tmp quarantine (TPU_R05_IN) — "
+                         "NEVER a checked-in benchmarks/results/ name, "
+                         "so a forgotten --out can't clobber banked "
+                         "evidence; promotion goes through harvest "
+                         "validity gates or an explicit reviewed copy")
     args = ap.parse_args()
+
+    # pure usage errors fail before the expensive jax import
+    if args.coverage_tol and not args.tol_reason:
+        ap.error("--coverage-tol requires --tol-reason (the acceptance "
+                 "table test insists on a recorded reason)")
+    if args.tol_reason and not args.coverage_tol:
+        ap.error("--tol-reason without --coverage-tol would be silently "
+                 "dropped from the artifact (run_campaign records the "
+                 "reason only for a nonzero tolerance)")
+    if args.out is None:
+        args.out = os.path.join(
+            os.environ.get("TPU_R05_IN", "/tmp/tpu_r05"),
+            f"acceptance_point_{args.variant}.json")
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
 
     import jax
 
@@ -59,16 +93,25 @@ def main() -> None:
         jax.config.update("jax_platforms", args.platform)
 
     from dpcorr.acceptance import AccPoint, run_campaign
-
+    eps2 = args.eps if args.eps2 is None else args.eps2
+    if args.variant == "real":
+        name = "subg_real_p2"
+        regime = ("real-data (v2) estimator pair at the HRS-like shape — "
+                  "second det/mc calibration point (VERDICT r3 #5); same "
+                  "construction as subg_real (real-data-sims.R:115-252)")
+    else:
+        name = "subg_grid_extra"
+        regime = ("grid (v1) subG estimator pair — extra det/mc point for "
+                  "the nsim=1000 flavor of the nsim-scaling attribution "
+                  "(ver-cor-subG.R:25-108; mc draws nsim=1000)")
     pt = AccPoint(
-        "subg_real_p2",
-        "real-data (v2) estimator pair at the HRS-like shape — second "
-        "det/mc calibration point (VERDICT r3 #5); same construction as "
-        "subg_real (real-data-sims.R:115-252)",
-        {"n": args.n, "rho": args.rho, "eps1": args.eps, "eps2": args.eps,
+        name, regime,
+        {"n": args.n, "rho": args.rho, "eps1": args.eps, "eps2": eps2,
          "dgp": "bounded_factor", "use_subg": True,
-         "subg_variant": "real"},
+         "subg_variant": args.variant},
         both_mixquant=True,
+        coverage_tol=args.coverage_tol,
+        tol_reason=args.tol_reason,
     )
     table = run_campaign(b=1 << args.log2b, block=args.block,
                          points=(pt,), chunk_size=args.chunk,

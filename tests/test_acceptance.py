@@ -164,11 +164,13 @@ def test_det_mc_gap_scales_inversely_with_reference_nsim():
     (``ci_int_subg``'s variant-aware default).
 
     Measured across every checked-in campaign table: the nsim=1000
-    points (sign_normal, subg_factor — r02, B≥1e6) sit at ~1.88e-3 and
-    the nsim=2000 points (subg_real flavor — r03/r04 campaigns, four
-    configs from n=1000 to n=19,433) at ~0.85-1.03e-3: a ratio of ~2.0
-    matching the nsim ratio exactly. A det-mode *error* would have no
-    reason to halve when the reference's own draw count doubles."""
+    points (sign_normal, subg_factor — r02, B≥1e6 — plus the r05
+    subg_grid_extra at the asymmetric (1.5, 0.5) pair) sit at
+    1.87-2.04e-3 and the nsim=2000 points (subg_real flavor — r03/r04
+    campaigns, four configs from n=1000 to n=19,433) at ~0.85-1.03e-3:
+    a group-mean ratio of ~2.0 matching the nsim ratio. A det-mode
+    *error* would have no reason to halve when the reference's own draw
+    count doubles."""
     by_nsim = {1000: [], 2000: []}
     for path in sorted(RESULTS_DIR.glob("acceptance_*.json")):
         table = json.loads(path.read_text())

@@ -266,17 +266,21 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
 
     merged = gcfg.bucket_merge == "eps"
 
-    def merged_k_pad(n: int) -> int:
+    def merged_k_pad(n: int, bucket_rows) -> int:
         """ONE derivation for both the kernel's static pad and the cache
-        stamp — computed from the CONFIG's full ε set (not a dispatch's
-        subset: the compiled kernel must be reusable across
-        partial-resume dispatches, and the stamp must name the layout
-        the kernel actually used)."""
+        stamp — computed from the BUCKET's full ε set (every design row
+        at this n, never a dispatch's cache-miss subset: the compiled
+        kernel must be reusable across partial-resume dispatches, and
+        the stamp must name the layout the kernel actually used).
+        Per-bucket rather than config-wide so a ragged external design
+        (the R bridge's seam) doesn't pay padding for ε-pairs this n
+        never runs."""
         from dpcorr.models.estimators.common import k_pad_for
 
-        return k_pad_for(n, [e1 * e2 for e1, e2 in gcfg.eps_pairs])
+        return k_pad_for(n, [float(r.eps1) * float(r.eps2)
+                             for r in bucket_rows])
 
-    def xla_dispatch(cfg, to_run):
+    def xla_dispatch(cfg, to_run, k_pad=None):
         """The XLA bucket dispatch — single source for phase 1 and the
         fetch-time fused fallback, so both stay bit-identical to
         fused="off" by construction. In ε-merged mode ε rides as a
@@ -295,8 +299,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             cfg_noeps = dataclasses.replace(cfg, rho=0.0, seed=0,
                                             eps1=1.0, eps2=1.0)
             return sim_mod._run_detail_flat_eps(cfg_noeps, keys, rhos,
-                                                eps1s, eps2s,
-                                                merged_k_pad(cfg.n))
+                                                eps1s, eps2s, k_pad)
         cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
         if gcfg.backend == "bucketed-sharded":
             from dpcorr.parallel import run_detail_flat_sharded
@@ -348,7 +351,10 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             if merged:
                 # k_pad is part of the dyn stream layout — stamp it so
                 # caches from grids with different ε sets never mix
-                merge_tag = "|geom=dyn,kpad=%d" % merged_k_pad(cfg.n)
+                bucket_k_pad = merged_k_pad(cfg.n, rows)
+                merge_tag = "|geom=dyn,kpad=%d" % bucket_k_pad
+            else:
+                bucket_k_pad = None
             stamps = mk_stamps("|fused=pallas" if fused
                                else merge_tag if merged else "")
             to_run = scan_cache(rows, stamps)
@@ -383,7 +389,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                     stamps = mk_stamps("")
                     to_run = scan_cache(to_run, stamps)
             if to_run and raw is None:
-                raw = xla_dispatch(cfg, to_run)
+                raw = xla_dispatch(cfg, to_run, k_pad=bucket_k_pad)
         except Exception as e:
             log.error("bucket (n=%d eps=(%.2f,%.2f), %d points) failed "
                       "at dispatch: %s",

@@ -219,3 +219,21 @@ def test_doctor_cli_json(tmp_path):
     rep = json.loads(r.stdout.strip().splitlines()[-1])
     assert "relay" in rep and "verdict" in rep
     assert rep["queue"] == {"state_dir": str(qdir), "present": False}
+
+
+def test_relay_ports_env_override(monkeypatch):
+    """DPCORR_RELAY_PORTS (comma-separated) overrides the baked-in relay
+    port list; an unparseable or empty override falls back to the
+    default instead of crashing the diagnostic tool."""
+    monkeypatch.delenv("DPCORR_RELAY_PORTS", raising=False)
+    assert doctor.relay_ports() == doctor.RELAY_PORTS
+    monkeypatch.setenv("DPCORR_RELAY_PORTS", "9001, 9002")
+    assert doctor.relay_ports() == (9001, 9002)
+    monkeypatch.setenv("DPCORR_RELAY_PORTS", "not,ports")
+    assert doctor.relay_ports() == doctor.RELAY_PORTS
+    monkeypatch.setenv("DPCORR_RELAY_PORTS", " , ")
+    assert doctor.relay_ports() == doctor.RELAY_PORTS
+    # check_relay defaults route through the override
+    monkeypatch.setenv("DPCORR_RELAY_PORTS", "1")  # port 1: always refused
+    rep = doctor.check_relay(timeout=0.2)
+    assert rep["checked"] == [1]

@@ -185,3 +185,29 @@ def test_validate_bridge_r_script_wellformed():
     frame = rbridge.run_design_rows(
         [{"n": 200, "rho": 0.1, "eps1": 1.0, "eps2": 1.0}], b=2)
     assert sys_cols <= set(map(str, frame.columns)) | {"n"}
+
+
+def test_run_design_rows_bucket_merge_subg():
+    """bucket_merge='eps' through the R seam: statistically the same
+    frame shape; eps_pairs are derived from the ROWS (not GridConfig's
+    defaults) so validation and the merged kernel's k_pad see the real ε
+    set. Non-bucketed backends reject the knob."""
+    import pytest
+
+    rows = [{"n": 400, "rho": 0.5, "eps1": 1.0, "eps2": 1.0},
+            {"n": 400, "rho": 0.5, "eps1": 1.5, "eps2": 0.5},
+            {"n": 600, "rho": 0.2, "eps1": 1.0, "eps2": 1.0}]
+    df = rbridge.run_design_rows(rows, b=16, dgp="bounded_factor",
+                                 use_subg=True, backend="bucketed",
+                                 bucket_merge="eps")
+    assert len(df) == 3 * 16
+    assert df.ni_hat.notna().all()  # the k_pad NaN tripwire never fired
+    assert df.ni_cover.isin([0.0, 1.0]).all()
+    with pytest.raises(ValueError, match="bucketed"):
+        rbridge.run_design_rows(rows, b=4, use_subg=True,
+                                dgp="bounded_factor", bucket_merge="eps")
+    # sign-family rows reject the subG-only knob via validate_bucket_merge
+    sign_rows = [{"n": 400, "rho": 0.5, "eps1": 1.0, "eps2": 1.0}]
+    with pytest.raises(ValueError, match="subG-only"):
+        rbridge.run_design_rows(sign_rows, b=4, backend="bucketed",
+                                bucket_merge="eps")

@@ -180,23 +180,26 @@ def validate_fused(fused: str, backend: str) -> None:
             f"fused={fused!r} requires backend='bucketed', got {backend!r}")
 
 
-def validate_bucket_merge(gcfg: GridConfig) -> None:
+def validate_bucket_merge(bucket_merge: str, backend: str,
+                          use_subg: bool, eps_pairs) -> None:
     """Fail-fast for the ε-merge knob (GridConfig.bucket_merge): the
     merged kernel exists only for the subG families on the single-device
     bucketed backend, and its named-sender contract needs ε₁ ≥ ε₂ on
-    every pair."""
-    if gcfg.bucket_merge not in ("off", "eps"):
+    every pair. Value-based signature (like :func:`validate_fused`) so
+    the R bridge — which builds its design from external rows — shares
+    the one implementation."""
+    if bucket_merge not in ("off", "eps"):
         raise ValueError(f"bucket_merge must be 'off' or 'eps', "
-                         f"got {gcfg.bucket_merge!r}")
-    if gcfg.bucket_merge == "off":
+                         f"got {bucket_merge!r}")
+    if bucket_merge == "off":
         return
-    if gcfg.backend != "bucketed":
-        raise ValueError("bucket_merge='eps' requires backend='bucketed', "
-                         f"got {gcfg.backend!r}")
-    if not gcfg.use_subg:
+    if backend != "bucketed":
+        raise ValueError(f"bucket_merge={bucket_merge!r} requires "
+                         f"backend='bucketed', got {backend!r}")
+    if not use_subg:
         raise ValueError("bucket_merge='eps' is subG-only: the sign "
                          "estimators have no dynamic-geometry variant")
-    bad = [(e1, e2) for e1, e2 in gcfg.eps_pairs if e1 < e2]
+    bad = [(e1, e2) for e1, e2 in eps_pairs if e1 < e2]
     if bad:
         raise ValueError(
             "bucket_merge='eps' names the sender as the ε₁ side, so every "
@@ -503,7 +506,8 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
     equivalent of the reference's ``seed = 1e6 + i`` (vert-cor.R:531).
     """
     validate_fused(gcfg.fused, gcfg.backend)
-    validate_bucket_merge(gcfg)
+    validate_bucket_merge(gcfg.bucket_merge, gcfg.backend, gcfg.use_subg,
+                          gcfg.eps_pairs)
     design = gcfg.design_points()
     master = rng.master_key(gcfg.seed)
     out_dir = Path(gcfg.out_dir) if gcfg.out_dir else None

@@ -43,31 +43,26 @@ def run_design_rows(rows: Sequence[Mapping], b: int = 250,
     # same fail-fast contract as grid.run_grid: a typo'd or silently
     # inapplicable fused value must not run the wrong path
     grid_mod.validate_fused(fused, backend)
-    if bucket_merge not in ("off", "eps"):
-        raise ValueError(f"bucket_merge must be 'off' or 'eps', "
-                         f"got {bucket_merge!r}")
-    if bucket_merge != "off" and backend != "bucketed":
-        raise ValueError(f"bucket_merge={bucket_merge!r} requires "
-                         f"backend='bucketed', got {backend!r}")
+    # eps_pairs for validation come from the ROWS' actual pairs (the
+    # merged kernel's ε₁ ≥ ε₂ sender contract must be checked against
+    # the design that will run, not GridConfig's defaults; the pad bound
+    # itself is derived per n-bucket from the same rows inside
+    # _run_grid_bucketed). Validated for EVERY backend so a wrong knob
+    # value fails identically whether or not the bucketed path runs.
+    row_pairs = tuple(sorted({(float(r["eps1"]), float(r["eps2"]))
+                              for r in rows}))
+    grid_mod.validate_bucket_merge(bucket_merge, backend, bool(use_subg),
+                                   row_pairs)
 
     if backend == "bucketed":
         # the grid speedup (one kernel per (n, ε) shape bucket, ρ traced,
         # dispatch-ahead) — reachable from R, bit-identical per point to
-        # the local path (both fold design_key(master, i)).
-        # eps_pairs MUST be the ROWS' actual pairs, not GridConfig's
-        # defaults: bucket_merge derives both its validation (ε₁ ≥ ε₂)
-        # and the merged kernel's static k_pad from gcfg.eps_pairs, and
-        # a mismatch would compute a pad for the wrong ε set (the
-        # kernel's NaN tripwire would catch it, but loudly-wrong beats
-        # silently-poisoned).
-        pairs = tuple(sorted({(float(r["eps1"]), float(r["eps2"]))
-                              for r in rows}))
+        # the local path (both fold design_key(master, i))
         gcfg = grid_mod.GridConfig(
             b=int(b), alpha=float(alpha), dgp=dgp, use_subg=bool(use_subg),
             normalise=bool(normalise), ci_mode=ci_mode, seed=int(seed),
             backend="bucketed", fused=fused, bucket_merge=bucket_merge,
-            eps_pairs=pairs)
-        grid_mod.validate_bucket_merge(gcfg)
+            eps_pairs=row_pairs)
         design = pd.DataFrame(
             [{"i": i, "n": int(r["n"]), "rho": float(r["rho"]),
               "eps1": float(r["eps1"]), "eps2": float(r["eps2"])}

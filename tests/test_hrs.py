@@ -101,3 +101,38 @@ def test_bootstrap(cols):
         assert s[meth]["sd"] > 0.0
     df2 = hrs.bootstrap(cols=cols, reps=48, chunk=16)
     assert np.allclose(df.ni_hat, df2.ni_hat)
+
+
+def test_sweep_int_kernel_ulp_identical_to_static_path(cols):
+    """The single-compile INT sweep kernel takes ε as a tracer but draws
+    from the same named substreams with the same math as the static
+    per-ε helper — outputs agree to float32 ulp noise (≤2 ulp, from
+    traced-vs-constant folding differences in the arithmetic; the
+    PRNG draws themselves are bit-equal). Anything beyond ulp noise
+    means the traced path's stream layout forked from the
+    estimator's."""
+    import jax.numpy as jnp
+
+    cfg = hrs.HrsConfig()
+    _, age, bmi = hrs.extract_wave(cols, cfg.wave)
+    std = hrs.standardize(age, bmi, cfg)
+    n = int(age.shape[0])
+    delta = 1.0 / n
+    eps = 1.3
+    lam_recv = float(hrs.lambda_receiver_from_noise(
+        std.lam_age, std.lam_bmi, eps, delta))
+    keys = hrs.rng.rep_keys(hrs.rng.master_key(11), 4)
+    kern = hrs._sweep_int_kernel(
+        keys, (std.age_z, std.bmi_z), jnp.float32(eps), std.lam_age,
+        std.lam_bmi, jnp.float32(lam_recv), jnp.float32(delta),
+        cfg.mixquant_mode, cfg.alpha)
+    import numpy as np
+
+    for i in range(4):
+        r = hrs._int_once(keys[i], std.age_z, std.bmi_z, eps, std.lam_age,
+                          std.lam_bmi, lam_recv, delta, cfg.alpha,
+                          cfg.mixquant_mode)
+        for got, want in ((kern[0][i], r.rho_hat), (kern[1][i], r.ci_low),
+                          (kern[2][i], r.ci_high)):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=4e-7, atol=6e-8)

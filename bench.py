@@ -138,9 +138,14 @@ def measure_steady_state(run_block, args_for, block_reps: int,
     """Compile, calibrate one block's wall-clock, then dispatch ~budget
     worth of blocks asynchronously and drain once (the host-fetch of the
     scalars is the only reliable completion barrier through the remote-TPU
-    tunnel). Returns (reps_per_sec, mean metrics). Shared by the bench
-    workers and ``benchmarks/roofline.py`` so a measured reps/sec always
-    means the same protocol."""
+    tunnel). Returns (reps_per_sec, mean metrics, per-block drain-latency
+    percentiles). Shared by the bench workers and
+    ``benchmarks/roofline.py`` so a measured reps/sec always means the
+    same protocol. The percentile estimator is the serving layer's
+    (dpcorr.serve.stats), so an offline p99 and the serve endpoint's p99
+    are the same statistic — under dispatch-ahead, later blocks drain
+    near-instantly, so a p99 far above p50 localizes tunnel stalls."""
+    from dpcorr.serve.stats import percentiles
 
     def _fetch(out):
         return tuple(float(x) for x in out)
@@ -154,10 +159,15 @@ def measure_steady_state(run_block, args_for, block_reps: int,
     t0 = time.perf_counter()
     futs = [run_block(args_for(2 + i), block_reps)
             for i in range(n_blocks)]
-    outs = [_fetch(f) for f in futs]
+    outs, drains = [], []
+    for f in futs:
+        tb = time.perf_counter()
+        outs.append(_fetch(f))
+        drains.append(time.perf_counter() - tb)
     elapsed = time.perf_counter() - t0
     means = tuple(sum(o[j] for o in outs) / len(outs) for j in range(3))
-    return n_blocks * block_reps / elapsed, means
+    lat = {k: round(v, 4) for k, v in percentiles(drains).items()}
+    return n_blocks * block_reps / elapsed, means, lat
 
 
 # --------------------------------------------------------------------------
@@ -229,7 +239,7 @@ def worker_main(mode: str, budget_s: float) -> None:
         # the tpu worker, after it exits, so the two never contend for the
         # (possibly exclusive) TPU client; a Mosaic compile hang here kills
         # only this process, never the already-captured XLA number.
-        p_rps, p_means = _measure(_pallas_block, lambda i: jnp.int32(i))
+        p_rps, p_means, p_lat = _measure(_pallas_block, lambda i: jnp.int32(i))
         print(json.dumps({
             "metric": METRIC, "value": round(p_rps, 1),
             "unit": "reps/sec/chip",
@@ -238,16 +248,18 @@ def worker_main(mode: str, budget_s: float) -> None:
                 "reps_per_sec": round(p_rps, 1),
                 "mse": round(p_means[0], 6),
                 "coverage": round(p_means[1], 4),
-                "ci_length": round(p_means[2], 4)}}},
+                "ci_length": round(p_means[2], 4),
+                "block_drain_s": p_lat}}},
         }), flush=True)
         return
 
-    xla_rps, xla_means = _measure(_xla_block,
-                                  lambda i: rng.design_key(key, i))
+    xla_rps, xla_means, xla_lat = _measure(_xla_block,
+                                           lambda i: rng.design_key(key, i))
     paths = {"xla": {"reps_per_sec": round(xla_rps, 1),
                      "mse": round(xla_means[0], 6),
                      "coverage": round(xla_means[1], 4),
-                     "ci_length": round(xla_means[2], 4)}}
+                     "ci_length": round(xla_means[2], 4),
+                     "block_drain_s": xla_lat}}
 
     if mode == "tpu":
         # Same kernel on the rbg key impl (the TPU hardware generator):
@@ -256,13 +268,14 @@ def worker_main(mode: str, budget_s: float) -> None:
         # sanity as pallas — different streams, same distributions.
         try:
             key_rbg = rng.master_key(impl="rbg")
-            rbg_rps, rbg_means = _measure(_xla_block,
-                                          lambda i: rng.design_key(key_rbg, i))
+            rbg_rps, rbg_means, rbg_lat = _measure(
+                _xla_block, lambda i: rng.design_key(key_rbg, i))
             if _sane(rbg_means, xla_means):
                 paths["xla_rbg"] = {"reps_per_sec": round(rbg_rps, 1),
                                     "mse": round(rbg_means[0], 6),
                                     "coverage": round(rbg_means[1], 4),
-                                    "ci_length": round(rbg_means[2], 4)}
+                                    "ci_length": round(rbg_means[2], 4),
+                                    "block_drain_s": rbg_lat}
             else:
                 paths["xla_rbg_skipped"] = f"sanity: {rbg_means}"
         except Exception as e:

@@ -77,7 +77,7 @@ def main() -> None:
     model = analytic_rep_model(bench.N, bench.EPS1, bench.EPS2)
 
     # --- lens 3: steady-state throughput (the bench's own protocol) -----
-    rps, _ = bench.measure_steady_state(
+    rps, _, _ = bench.measure_steady_state(
         fn, lambda i: rng.design_key(key, i), block, args.budget)
 
     peaks = peaks_for(platform)

@@ -1,0 +1,194 @@
+"""Load generator for the serving subsystem (ISSUE 1 acceptance).
+
+Drives ≥ 1,000 concurrent estimation requests from many client threads
+through an in-process :class:`dpcorr.serve.DpcorrServer` and verifies
+the three serving invariants end to end:
+
+1. **real coalescing** — batch-fill ratio (live requests per flushed
+   launch) > 1;
+2. **bit-identity** — every response equals the direct single-request
+   estimator call (``jit(single)``) on the same key-tree address; holds
+   exactly under the default ``exact`` batch engine for every family
+   (estimators.registry contract);
+3. **ledger refusal** — with the spend known in advance, the first
+   query that would overdraw a party's ε budget is refused and every
+   earlier one admitted.
+
+Prints one JSON document: serving stats snapshot + latency percentiles
++ throughput + the verification verdicts. Exit code 1 if any invariant
+fails, so the unattended queue can gate on it.
+
+Usage:
+    python benchmarks/serve_load.py [--requests 1000] [--clients 32]
+        [--n 500] [--max-batch 64] [--max-delay-ms 20] [--verify 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=1000)
+    ap.add_argument("--clients", type=int, default=32,
+                    help="concurrent client threads")
+    ap.add_argument("--n", type=int, default=500,
+                    help="observations per request")
+    ap.add_argument("--family", default="ni_sign")
+    ap.add_argument("--eps1", type=float, default=1.0)
+    ap.add_argument("--eps2", type=float, default=0.5)
+    ap.add_argument("--max-batch", dest="max_batch", type=int, default=64)
+    ap.add_argument("--max-delay-ms", dest="max_delay_ms", type=float,
+                    default=20.0)
+    ap.add_argument("--verify", type=int, default=64,
+                    help="responses to bit-check against direct calls")
+    ap.add_argument("--batch-mode", dest="batch_mode", default="exact",
+                    choices=["exact", "vector"],
+                    help="'vector' trades CI-endpoint bit-identity "
+                         "(≤1 ulp) for batch throughput; the bit check "
+                         "then verifies rho_hat only")
+    ap.add_argument("--platform", default="cpu", choices=["cpu", "tpu"])
+    ap.add_argument("--out-json", dest="out_json", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+
+    from dpcorr.models.estimators.registry import serving_entry
+    from dpcorr.serve import DpcorrServer, EstimateRequest, InProcessClient
+    from dpcorr.serve.ledger import BudgetExceededError, request_charges
+    from dpcorr.utils import rng
+
+    # Budget sized so the load itself always fits: the refusal probe
+    # below runs against dedicated parties with a tiny budget instead.
+    srv = DpcorrServer(budget=1e9, max_batch=args.max_batch,
+                       max_delay_s=args.max_delay_ms / 1000.0,
+                       max_queue=4 * args.requests,
+                       batch_mode=args.batch_mode)
+    cli = InProcessClient(srv)
+
+    rs = np.random.RandomState(7)
+    reqs = [EstimateRequest(
+        args.family,
+        rs.randn(args.n).astype(np.float32),
+        rs.randn(args.n).astype(np.float32),
+        args.eps1, args.eps2,
+        party_x=f"px{i % 8}", party_y=f"py{i % 8}", seed=i)
+        for i in range(args.requests)]
+
+    responses: dict[int, object] = {}
+    errors: list[str] = []
+    lock = threading.Lock()
+    per_client = -(-args.requests // args.clients)
+
+    def client(c: int) -> None:
+        futs = []
+        for i in range(c * per_client,
+                       min((c + 1) * per_client, args.requests)):
+            try:
+                futs.append((i, cli.submit(reqs[i])))
+            except Exception as e:  # refusal/overload is a failure here
+                with lock:
+                    errors.append(f"submit {i}: {type(e).__name__}: {e}")
+        for i, f in futs:
+            try:
+                r = f.result(timeout=300)
+                with lock:
+                    responses[i] = r
+            except Exception as e:
+                with lock:
+                    errors.append(f"result {i}: {type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    srv.close()
+
+    stats = cli.stats()
+    fill = stats["batch_fill_ratio"]
+
+    # -- invariant 2: bit-identity on a sample of responses --------------
+    single = jax.jit(serving_entry(args.family, args.eps1, args.eps2,
+                                   alpha=0.05, normalise=True))
+    master = rng.master_key(srv.seed)
+    step = max(1, len(responses) // max(args.verify, 1))
+    checked = mismatches = 0
+    check_ci = args.batch_mode == "exact"
+    for i in sorted(responses)[::step]:
+        r = responses[i]
+        d = single(rng.design_key(master, r.seed), reqs[i].x, reqs[i].y)
+        checked += 1
+        if float(d[0]) != r.rho_hat or (check_ci and (
+                float(d[1]) != r.ci_low or float(d[2]) != r.ci_high)):
+            mismatches += 1
+
+    # -- invariant 3: refusal exactly at budget exhaustion ---------------
+    probe = EstimateRequest(args.family, reqs[0].x, reqs[0].y,
+                            args.eps1, args.eps2,
+                            party_x="probe-x", party_y="probe-y")
+    spend = request_charges(probe)["probe-x"]
+    admit_budget = 3 * spend  # fits exactly 3 queries
+    srv2 = DpcorrServer(budget=1e9,
+                        per_party_budget={"probe-x": admit_budget,
+                                          "probe-y": admit_budget},
+                        max_delay_s=0.001)
+    admitted = 0
+    refused_at = None
+    for q in range(5):
+        try:
+            srv2.estimate(probe)
+            admitted += 1
+        except BudgetExceededError:
+            refused_at = q
+            break
+    srv2.close()
+
+    ok = {
+        "completed": len(responses) == args.requests and not errors,
+        "coalesced": fill > 1.0,
+        "bit_identical": checked > 0 and mismatches == 0,
+        "ledger_refusal": admitted == 3 and refused_at == 3,
+    }
+    out = {
+        "metric": "serve_load",
+        "requests": args.requests,
+        "clients": args.clients,
+        "n": args.n,
+        "family": args.family,
+        "batch_mode": args.batch_mode,
+        "wall_s": round(wall, 3),
+        "requests_per_sec": round(args.requests / wall, 1),
+        "batch_fill_ratio": round(fill, 2),
+        "bit_checked": checked,
+        "bit_mismatches": mismatches,
+        "refusal_probe": {"admitted": admitted, "refused_at": refused_at},
+        "ok": ok,
+        "errors": errors[:5],
+        "stats": stats,
+    }
+    blob = json.dumps(out, indent=2)
+    print(blob)
+    if args.out_json:
+        with open(args.out_json, "w") as f:
+            f.write(blob)
+    return 0 if all(ok.values()) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -203,6 +203,27 @@ def cmd_hrs_sweep(args):
         print("figures:", *(str(p) for p in paths))
 
 
+def cmd_serve(args):
+    """Online serving: micro-batched DP-correlation queries behind a
+    per-party ε-budget ledger (dpcorr.serve; docs/SERVING.md)."""
+    from dpcorr.serve import DpcorrServer, serve_http
+
+    server = DpcorrServer(
+        budget=args.budget, ledger_path=args.ledger,
+        seed=args.seed, max_batch=args.max_batch,
+        max_delay_s=args.max_delay_ms / 1000.0,
+        max_queue=args.max_queue, shard=args.shard,
+        batch_mode=args.batch_mode)
+    print(json.dumps({"serving": {"host": args.host, "port": args.port,
+                                  "budget": args.budget,
+                                  "ledger": args.ledger,
+                                  "max_batch": args.max_batch,
+                                  "max_delay_ms": args.max_delay_ms,
+                                  "batch_mode": args.batch_mode}}),
+          flush=True)
+    serve_http(server, host=args.host, port=args.port)
+
+
 def cmd_doctor(args):
     from dpcorr.utils import doctor
 
@@ -244,6 +265,36 @@ def main(argv=None):
     # the flag, not function identity, so future jax-free subcommands
     # just set it too)
     pd_.set_defaults(fn=cmd_doctor, platform=None, jax_free=True)
+
+    ps_ = sub.add_parser("serve", help="online micro-batched DP-correlation "
+                         "service with a per-party privacy-budget ledger "
+                         "(docs/SERVING.md)")
+    ps_.add_argument("--host", default="127.0.0.1")
+    ps_.add_argument("--port", type=int, default=8321)
+    ps_.add_argument("--budget", type=float, default=100.0,
+                     help="default per-party ε budget (basic composition)")
+    ps_.add_argument("--ledger", default=None,
+                     help="ledger persistence path (JSON); restarts resume "
+                          "the spend table, so budgets survive crashes")
+    ps_.add_argument("--max-batch", dest="max_batch", type=int, default=64,
+                     help="flush a bucket at this many live requests")
+    ps_.add_argument("--max-delay-ms", dest="max_delay_ms", type=float,
+                     default=5.0,
+                     help="flush a bucket once its oldest request has "
+                          "waited this long")
+    ps_.add_argument("--max-queue", dest="max_queue", type=int, default=4096,
+                     help="backpressure: refuse admissions beyond this many "
+                          "pending requests")
+    ps_.add_argument("--shard", default="auto", choices=["auto", "off"],
+                     help="shard wide flushes over the device mesh")
+    ps_.add_argument("--batch-mode", dest="batch_mode", default="exact",
+                     choices=["exact", "vector"],
+                     help="batch engine: 'exact' (lax.map; bit-identical "
+                          "to direct calls) or 'vector' (vmap; faster, CI "
+                          "endpoints within 1 ulp — see docs/SERVING.md)")
+    ps_.add_argument("--seed", type=int, default=2025)
+    ps_.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+    ps_.set_defaults(fn=cmd_serve)
     backends_by_cmd = {
         "grid": ("local", "sharded", "bucketed", "bucketed-sharded"),
         "grid-subg": ("local", "sharded", "bucketed", "bucketed-sharded"),

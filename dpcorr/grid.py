@@ -205,6 +205,12 @@ def validate_bucket_merge(bucket_merge: str, backend: str,
             "bucket_merge='eps' names the sender as the ε₁ side, so every "
             f"pair needs ε₁ ≥ ε₂; violating pairs: {bad} (swap the "
             "columns, or use bucket_merge='off')")
+    # merged buckets trace ε (batch_geometry_dyn's f32 rule) where the
+    # unmerged path uses the static f64 rule — surface, once, any pair
+    # sitting in the ~1e-6 band where the two choose adjacent m
+    from dpcorr.models.estimators.common import warn_f32_geometry_band_once
+
+    warn_f32_geometry_band_once(eps_pairs, where="validate_bucket_merge")
 
 
 def _fused_bucket_ok(gcfg: GridConfig, cfg: SimConfig) -> str | None:
@@ -401,7 +407,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                             if int(r.i) not in details)
             continue
         pending.append((rows, to_run, raw, stamps, paths, fused, cfg,
-                        time.perf_counter() - t0))
+                        mk_stamps, time.perf_counter() - t0))
 
     # Phase 2 — fetch in dispatch order; device-side failures surface here.
     # Per-bucket wall times overlap under dispatch-ahead (a later bucket's
@@ -410,7 +416,8 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
     # ``grid_reps_per_sec``, total reps over the whole two-phase wall clock.
     t_fetch0 = time.perf_counter()
     total_ran = 0
-    for rows, to_run, raw, stamps, paths, fused, cfg, dispatch_s in pending:
+    for (rows, to_run, raw, stamps, paths, fused, cfg, mk_stamps,
+         dispatch_s) in pending:
         t0 = time.perf_counter()
         try:
             if to_run:
@@ -429,8 +436,10 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                         "fetch: %s -- retrying via XLA", cfg.n, cfg.eps1,
                         cfg.eps2, e)
                     fused = None
-                    stamps = {int(r.i): _stamp(dataclasses.replace(
-                        cfg, rho=float(r.rho))) for r in to_run}
+                    # the dispatch-phase stamp derivation, suffix-free —
+                    # NOT an inline re-derivation, which would drop the
+                    # per-row ε replacement merged buckets rely on
+                    stamps = mk_stamps("")
                     still = []
                     for r in to_run:
                         i = int(r.i)
@@ -477,7 +486,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             "dispatch_s": dispatch_s, "fetch_s": fetch_s,
         })
     wall = (time.perf_counter() - t_fetch0) + sum(
-        t[7] for t in pending)  # fetch phase + all dispatch times
+        t[8] for t in pending)  # fetch phase + all dispatch times
     grid_rps = np.nan if not total_ran else total_ran * gcfg.b / wall
     for t in timings:
         t["grid_reps_per_sec"] = grid_rps

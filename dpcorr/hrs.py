@@ -274,8 +274,13 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
     lam_recvs = [float(lambda_receiver_from_noise(std.lam_age, std.lam_bmi,
                                                   float(e), delta))
                  for e in eps_grid]
-    from dpcorr.models.estimators.common import k_pad_for
+    from dpcorr.models.estimators.common import (k_pad_for,
+                                                 warn_f32_geometry_band_once)
 
+    # the sweep traces ε through the f32 geometry rule; flag (once) any
+    # grid value in the ~1e-6 band where f32 and f64 pick adjacent m
+    warn_f32_geometry_band_once([(float(e), float(e)) for e in eps_grid],
+                                n=n, where="hrs.eps_sweep")
     k_pad = k_pad_for(n, [float(e) * float(e) for e in eps_grid])
     pending = []
     for eps_idx, eps in enumerate(eps_grid):

@@ -31,6 +31,10 @@ from dpcorr.models.estimators.int_sign import (  # noqa: F401
 )
 from dpcorr.models.estimators.ni_subg import correlation_ni_subg  # noqa: F401
 from dpcorr.models.estimators.int_subg import ci_int_subg  # noqa: F401
+from dpcorr.models.estimators.registry import (  # noqa: F401
+    FAMILIES,
+    serving_entry,
+)
 from dpcorr.models.estimators.streaming import (  # noqa: F401
     array_chunk_fn,
     choose_n_chunk,

@@ -3,11 +3,14 @@ sample sd."""
 
 from __future__ import annotations
 
+import logging
 import math
 from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+log = logging.getLogger(__name__)
 
 
 class CorrResult(NamedTuple):
@@ -76,6 +79,62 @@ def batch_geometry_dyn(n: int, eps1, eps2,
         k = jnp.where(fallback, 2, k)
         m = jnp.where(fallback, n // 2, m)
     return m, k
+
+
+#: entry points that have already warned about the f32 geometry band
+#: (one warning per entry point per process, not per design row)
+_F32_BAND_WARNED: set[str] = set()
+
+
+def f32_geometry_band(eps_pairs, n: int | None = None) -> list[tuple]:
+    """ε pairs where the traced-f32 rule (:func:`batch_geometry_dyn`)
+    picks a different batch size m than the static f64 rule
+    (:func:`batch_geometry`).
+
+    The dyn kernel evaluates ``ceil(q·(1−1e-6))`` on an f32
+    ``q = 8/(ε₁ε₂)``, so any pair whose q lands within ~1e-6 of an
+    integer from *below* in f64 but not in f32 (or vice versa) sits in a
+    disagreement band where the two paths choose adjacent m — a real,
+    designed-in property of the snap-down guard (see
+    :func:`batch_geometry_dyn`), not a bug, but one that silently
+    changes (m, k) and hence the estimate when a design is moved between
+    the static and merged/swept backends. Returns
+    ``[(eps1, eps2, m_static, m_dyn), ...]`` (empty = no band hits);
+    ``n`` applies the m ≤ n cap when known.
+    """
+    import numpy as np
+
+    hits = []
+    for eps1, eps2 in eps_pairs:
+        m64 = math.ceil(8.0 / (float(eps1) * float(eps2)))
+        q32 = np.float32(8.0) / (np.float32(eps1) * np.float32(eps2))
+        m32 = int(math.ceil(float(np.float32(q32 * np.float32(1.0 - 1e-6)))))
+        if n is not None:
+            m64, m32 = min(m64, n), min(m32, n)
+        if m64 != m32:
+            hits.append((float(eps1), float(eps2), m64, m32))
+    return hits
+
+
+def warn_f32_geometry_band_once(eps_pairs, n: int | None = None,
+                                where: str = "eps-sweep") -> list[tuple]:
+    """Log-once guard for the f32/f64 m-disagreement band, called at the
+    entry points that mix the two geometry paths (grid ε-merge
+    validation, HRS ε-sweep). Returns the band hits so callers can act
+    on them; logs at most one warning per ``where`` per process."""
+    hits = f32_geometry_band(eps_pairs, n=n)
+    if hits and where not in _F32_BAND_WARNED:
+        _F32_BAND_WARNED.add(where)
+        log.warning(
+            "%s: %d ε pair(s) sit in the ~1e-6 f32/f64 batch-geometry "
+            "band — the traced (f32) rule picks a different m than the "
+            "static (f64) rule, e.g. eps=(%.6g,%.6g): m_static=%d vs "
+            "m_dyn=%d. Estimates from the merged/swept path will differ "
+            "from the static path for these pairs (adjacent batch "
+            "design, both valid).",
+            where, len(hits), hits[0][0], hits[0][1], hits[0][2],
+            hits[0][3])
+    return hits
 
 
 def k_pad_for(n: int, eps_products) -> int:

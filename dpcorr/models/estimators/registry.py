@@ -1,0 +1,84 @@
+"""Uniform batchable entry points over the four estimator families.
+
+The serving layer (``dpcorr.serve``) batches concurrent requests from
+*different* clients into one ``vmap`` launch, so it needs every family
+behind ONE signature it can vmap without per-family plumbing:
+
+    single(key, x, y) -> (rho_hat, ci_low, ci_high)
+
+``serving_entry`` closes over everything that is static per compile
+bucket (family, ε-pair, α, normalise) and drops ``CorrResult.aux`` —
+the documented pre-vmap-boundary contract (common.CorrResult: aux is
+host-side extras, never crosses a vmap).
+
+Bit-reproducibility contract (measured on CPU 2026-08-05, all four
+families, n ∈ {137, 500, 1024, 10000}; pinned by tests/test_serve.py):
+
+- ``jax.lax.map`` over ``single`` (the serving layer's default
+  ``exact`` batch engine) is **bit-identical** to ``jit(single)`` on
+  every lane — the scalar program is compiled once and looped, so
+  batching cannot change results. Holds under ``shard_map`` over the
+  ``rep`` mesh too.
+- ``jit(vmap(single))`` (the ``vector`` engine): ``rho_hat`` is
+  bit-identical to the direct call for every family; the CI endpoints
+  can differ by 1 ulp (~6e-8, data- and n-dependent) because XLA's
+  vectorized codegen reassociates the CI arithmetic differently from
+  the scalar program. Lanes ARE bit-identical across batch widths ≥ 2,
+  so within the vector engine coalescing still never changes results —
+  only the scalar/vector boundary differs.
+
+ε is a *static* closure argument here (one compiled kernel per ε-pair
+bucket): the interactive families branch on concrete ε floats at trace
+time (sender selection, normal/laplace CI switch), so a traced-ε merged
+serving kernel would need the same explicit-direction treatment as the
+HRS sweep (``ci_int_subg(sender=...)``) — future work, noted in
+docs/SERVING.md.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from dpcorr.models.estimators.int_sign import ci_int_signflip
+from dpcorr.models.estimators.int_subg import ci_int_subg
+from dpcorr.models.estimators.ni_sign import ci_ni_signbatch
+from dpcorr.models.estimators.ni_subg import correlation_ni_subg
+
+#: Families the serving layer accepts, in SURVEY.md §2.2 order.
+FAMILIES: tuple[str, ...] = ("ni_sign", "int_sign", "ni_subg", "int_subg")
+
+
+def serving_entry(family: str, eps1: float, eps2: float,
+                  alpha: float = 0.05,
+                  normalise: bool = True) -> Callable:
+    """The uniform single-request callable for one compile bucket.
+
+    ``normalise`` applies to the sign families only (private centering
+    before the sign transform, vert-cor.R:211-215); the subG families
+    clip with data-independent λ_n bounds instead and ignore it.
+    """
+    if family not in FAMILIES:
+        raise ValueError(f"unknown estimator family {family!r}; "
+                         f"expected one of {FAMILIES}")
+
+    if family == "ni_sign":
+        def single(key: jax.Array, x: jax.Array, y: jax.Array):
+            r = ci_ni_signbatch(key, x, y, eps1, eps2, alpha=alpha,
+                                normalise=normalise)
+            return r.rho_hat, r.ci_low, r.ci_high
+    elif family == "int_sign":
+        def single(key: jax.Array, x: jax.Array, y: jax.Array):
+            r = ci_int_signflip(key, x, y, eps1, eps2, alpha=alpha,
+                                normalise=normalise)
+            return r.rho_hat, r.ci_low, r.ci_high
+    elif family == "ni_subg":
+        def single(key: jax.Array, x: jax.Array, y: jax.Array):
+            r = correlation_ni_subg(key, x, y, eps1, eps2, alpha=alpha)
+            return r.rho_hat, r.ci_low, r.ci_high
+    else:  # int_subg
+        def single(key: jax.Array, x: jax.Array, y: jax.Array):
+            r = ci_int_subg(key, x, y, eps1, eps2, alpha=alpha)
+            return r.rho_hat, r.ci_low, r.ci_high
+    return single

@@ -15,6 +15,7 @@ invert, TPU-style (SURVEY.md §2.3):
 
 from dpcorr.parallel.mesh import rep_mesh, local_device_count  # noqa: F401
 from dpcorr.parallel.backend import (  # noqa: F401
+    make_serve_batch_sharded,
     run_detail_sharded,
     run_detail_flat_sharded,
     run_summary_sharded,

@@ -58,7 +58,16 @@ def init_distributed(coordinator_address: str, num_processes: int,
     if platform:
         jax.config.update("jax_platforms", platform)
     if local_device_count:
-        jax.config.update("jax_num_cpu_devices", local_device_count)
+        try:
+            jax.config.update("jax_num_cpu_devices", local_device_count)
+        except AttributeError:
+            # jax < 0.5: no config option; the XLA_FLAGS equivalent is
+            # read at backend init, which hasn't happened yet here
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{local_device_count}").strip()
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

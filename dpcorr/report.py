@@ -271,6 +271,24 @@ def fig_hrs_sweep(summ: pd.DataFrame, rho_np: float | None = None, out=None):
     return _save(fig, out)
 
 
+def serve_stats_frame(snapshot: dict) -> pd.DataFrame:
+    """Flatten a serving stats snapshot (serve.ServeStats.snapshot) into
+    a tidy (metric, value) frame — the shape ``benchmarks/serve_load.py``
+    prints and a dashboard would ingest. Nested groups flatten with
+    dotted keys (``latency_s.p99``, ``ledger.parties.<p>.spent``)."""
+    rows = []
+
+    def walk(prefix, obj):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                walk(f"{prefix}.{k}" if prefix else str(k), v)
+        else:
+            rows.append({"metric": prefix, "value": obj})
+
+    walk("", snapshot)
+    return pd.DataFrame(rows, columns=["metric", "value"])
+
+
 def render_all(grid_detail: pd.DataFrame | None = None,
                grid_summ: pd.DataFrame | None = None,
                hrs_summ: pd.DataFrame | None = None,

@@ -1,0 +1,52 @@
+"""Online serving subsystem (ISSUE 1): micro-batched DP-correlation
+queries with a per-party privacy-budget ledger.
+
+The offline layers answer *campaigns* (grids of design points, B
+replications each); this package answers *queries*: a client holds an
+(x, y) sample pair and wants one DP estimate now. The pieces, bottom
+up — each module's docstring carries its own contract:
+
+- :mod:`request`   — request/response types; coalescing bucket and
+  compile-signature keys.
+- :mod:`ledger`    — per-party ε accounting under basic composition:
+  refusal before execution, write-ahead persistence (no double-spend
+  across restarts).
+- :mod:`kernels`   — compiled-kernel cache keyed on (signature, padded
+  batch width); optional mesh sharding of wide flushes.
+- :mod:`stats`     — live counters: queue depth, flush sizes,
+  batch-fill ratio, latency percentiles, ε spend.
+- :mod:`coalescer` — the micro-batcher: per-bucket queues, size/age
+  flush policy, backpressure, unbatched degradation.
+- :mod:`server`    — composition root + in-process client + stdlib
+  HTTP front end (``python -m dpcorr serve``).
+
+See docs/SERVING.md for the end-to-end story and the bit-identity
+contract (estimators.registry).
+"""
+
+from dpcorr.serve.coalescer import (  # noqa: F401
+    Coalescer,
+    ServerOverloadedError,
+)
+from dpcorr.serve.kernels import KernelCache, pad_batch  # noqa: F401
+from dpcorr.serve.ledger import (  # noqa: F401
+    BudgetExceededError,
+    PrivacyLedger,
+    request_charges,
+)
+from dpcorr.serve.request import (  # noqa: F401
+    BucketKey,
+    EstimateRequest,
+    EstimateResponse,
+    KernelKey,
+    bucket_key,
+    kernel_key,
+    pad_n,
+)
+from dpcorr.serve.server import (  # noqa: F401
+    DpcorrServer,
+    InProcessClient,
+    make_http_server,
+    serve_http,
+)
+from dpcorr.serve.stats import ServeStats, percentiles  # noqa: F401

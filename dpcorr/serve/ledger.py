@@ -1,0 +1,168 @@
+"""Per-party privacy-budget ledger under basic composition.
+
+The reference handles privacy accounting implicitly: a grid run spends
+exactly the (ε₁, ε₂) its design row names, once, offline. An online
+service has no such luxury — each admitted query *permanently* consumes
+budget from the data owners it touches, and the correctness invariant
+is that the sum of admitted spends never exceeds a party's configured
+budget, across restarts. This module is that invariant:
+
+- **Basic composition** (the paper's setting — pure ε-DP Laplace
+  mechanisms): total spend per party is the plain sum of per-query ε.
+  :func:`request_charges` maps a request to its per-party spend: ε₁
+  against x's owner and ε₂ against y's, doubled for the sign families
+  under ``normalise`` because the private centering pass spends the
+  same ε again before the sign-batch release (vert-cor.R:211-215; the
+  subG families clip with data-independent λ_n bounds instead, so they
+  spend once).
+- **Refusal before execution**: :meth:`PrivacyLedger.charge` is
+  all-or-nothing across the request's parties and raises
+  :class:`BudgetExceededError` without mutating anything if *any* party
+  would exceed its budget. The server charges at admission, before the
+  kernel runs.
+- **Write-ahead persistence**: when constructed with a path, the spend
+  table is fsync-rename persisted *before* ``charge`` returns, so a
+  server killed at any point can never have answered a query whose
+  spend is not on disk. A restart therefore under-counts never,
+  over-counts at most the in-flight queries that were admitted but
+  never answered — the safe direction for privacy.
+
+Thread-safe: one lock around check+spend+persist (the coalescer admits
+from many client threads).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Mapping
+
+from dpcorr.serve.request import EstimateRequest
+
+_STATE_VERSION = 1
+
+
+class BudgetExceededError(Exception):
+    """Admission refused: the query would overdraw a party's ε budget."""
+
+    def __init__(self, party: str, spent: float, charge: float,
+                 budget: float):
+        self.party = party
+        self.spent = spent
+        self.charge = charge
+        self.budget = budget
+        super().__init__(
+            f"party {party!r}: spent {spent:.6g} + charge {charge:.6g} "
+            f"> budget {budget:.6g}")
+
+
+def request_charges(req: EstimateRequest) -> dict[str, float]:
+    """Per-party ε spend of one request under basic composition.
+
+    Sign families with ``normalise`` privately center each variable
+    first, spending that side's ε a second time (see module docstring);
+    a request whose two sides name the same party accumulates both
+    charges against it.
+    """
+    factor = 2.0 if (req.family in ("ni_sign", "int_sign")
+                     and req.normalise) else 1.0
+    charges: dict[str, float] = {}
+    for party, eps in ((req.party_x, req.eps1 * factor),
+                       (req.party_y, req.eps2 * factor)):
+        charges[party] = charges.get(party, 0.0) + float(eps)
+    return charges
+
+
+class PrivacyLedger:
+    """Cumulative per-party ε under basic composition, with refusal.
+
+    ``budget``: default per-party budget; ``per_party`` overrides it for
+    named parties. ``path``: JSON persistence file — loaded on
+    construction (restart continuity) and rewritten atomically on every
+    successful charge.
+    """
+
+    def __init__(self, budget: float, path: str | None = None,
+                 per_party: Mapping[str, float] | None = None):
+        if budget <= 0.0:
+            raise ValueError(f"budget must be positive, got {budget}")
+        self.budget = float(budget)
+        self.per_party = dict(per_party or {})
+        self.path = path
+        self._lock = threading.Lock()
+        self._spent: dict[str, float] = {}
+        if path and os.path.exists(path):
+            with open(path) as f:
+                state = json.load(f)
+            if state.get("version") != _STATE_VERSION:
+                raise ValueError(
+                    f"ledger state {path!r} has version "
+                    f"{state.get('version')!r}, expected {_STATE_VERSION}")
+            self._spent = {str(k): float(v)
+                           for k, v in state["spent"].items()}
+
+    def budget_for(self, party: str) -> float:
+        return float(self.per_party.get(party, self.budget))
+
+    def spent(self, party: str) -> float:
+        with self._lock:
+            return self._spent.get(party, 0.0)
+
+    def remaining(self, party: str) -> float:
+        with self._lock:
+            return self.budget_for(party) - self._spent.get(party, 0.0)
+
+    def charge(self, charges: Mapping[str, float]) -> None:
+        """Atomically spend ``{party: ε}`` across all named parties.
+
+        All-or-nothing: if any party would exceed its budget the whole
+        charge is refused (no partial spend) and
+        :class:`BudgetExceededError` raised for the first violator. On
+        success the new state is durably persisted before returning.
+        """
+        for party, eps in charges.items():
+            if eps < 0.0:
+                raise ValueError(f"negative charge {eps} for {party!r}")
+        with self._lock:
+            for party, eps in charges.items():
+                spent = self._spent.get(party, 0.0)
+                # strict >: a charge landing exactly on the budget is
+                # admitted (the budget is a spend *cap*, not an open bound)
+                if spent + eps > self.budget_for(party) + 1e-12:
+                    raise BudgetExceededError(party, spent, eps,
+                                              self.budget_for(party))
+            for party, eps in charges.items():
+                self._spent[party] = self._spent.get(party, 0.0) + eps
+            self._persist_locked()
+
+    def charge_request(self, req: EstimateRequest) -> dict[str, float]:
+        """Charge one request's spend; returns what was charged."""
+        charges = request_charges(req)
+        self.charge(charges)
+        return charges
+
+    def snapshot(self) -> dict:
+        """Point-in-time accounting view (the stats endpoint's shape)."""
+        with self._lock:
+            return {
+                "budget_default": self.budget,
+                "parties": {
+                    p: {"spent": s, "budget": self.budget_for(p),
+                        "remaining": self.budget_for(p) - s}
+                    for p, s in sorted(self._spent.items())},
+            }
+
+    def _persist_locked(self) -> None:
+        """Atomic write-ahead persist (caller holds the lock): tmp +
+        fsync + rename, so a crash mid-write leaves the previous state
+        intact and a completed charge is never lost."""
+        if not self.path:
+            return
+        state = {"version": _STATE_VERSION, "spent": self._spent}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)

@@ -1,0 +1,131 @@
+"""Request/response types and bucket signatures for the serving layer.
+
+Two levels of grouping, both explicit:
+
+- :func:`bucket_key` — the **coalescing** bucket ``(family, padded-n,
+  ε-pair, α, normalise)``. Requests landing in the same bucket are held
+  together by the coalescer and flushed as one unit; n is quantized to
+  the next power of two so near-miss sample sizes share a flush queue
+  (and its timer) instead of each opening a singleton bucket.
+- :func:`kernel_key` — the **compile** signature: the bucket key plus
+  the *exact* n. Shapes are static in every estimator kernel
+  (common.batch_geometry), so a flushed bucket launches one vmap batch
+  per distinct n it contains; at steady state traffic per client is
+  fixed-n and a flush is a single launch. The compiled-kernel cache
+  (serve.kernels) is keyed here, so the number of live compilations is
+  bounded by live (family, n, ε) combinations, not by request count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import numpy as np
+
+from dpcorr.models.estimators.registry import FAMILIES
+
+#: Smallest padded-n bucket — below this every n shares one bucket.
+MIN_N_BUCKET = 64
+
+
+def pad_n(n: int, floor: int = MIN_N_BUCKET) -> int:
+    """Next power of two ≥ max(n, floor): the coalescing n-bucket."""
+    v = max(int(n), floor)
+    return 1 << (v - 1).bit_length()
+
+
+class BucketKey(NamedTuple):
+    """Coalescing bucket: which requests may share a flush."""
+
+    family: str
+    n_pad: int
+    eps1: float
+    eps2: float
+    alpha: float
+    normalise: bool
+
+
+class KernelKey(NamedTuple):
+    """Compile signature: which requests share one vmap-batched kernel."""
+
+    family: str
+    n: int
+    eps1: float
+    eps2: float
+    alpha: float
+    normalise: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class EstimateRequest:
+    """One online DP-correlation query.
+
+    ``party_x`` / ``party_y`` name the data owners whose privacy budget
+    the query spends (ε₁ against x's owner, ε₂ against y's — doubled
+    for sign families with ``normalise``, see serve.ledger). ``seed``
+    pins the request's noise stream for reproducible replays; ``None``
+    lets the server assign one from its admission counter.
+    """
+
+    family: str
+    x: np.ndarray
+    y: np.ndarray
+    eps1: float
+    eps2: float
+    party_x: str = "party-x"
+    party_y: str = "party-y"
+    alpha: float = 0.05
+    normalise: bool = True
+    seed: int | None = None
+
+    def __post_init__(self):
+        if self.family not in FAMILIES:
+            raise ValueError(f"unknown estimator family {self.family!r}; "
+                             f"expected one of {FAMILIES}")
+        x = np.asarray(self.x, dtype=np.float32)
+        y = np.asarray(self.y, dtype=np.float32)
+        if x.ndim != 1 or y.ndim != 1 or x.shape != y.shape:
+            raise ValueError(f"x and y must be equal-length 1-D vectors, "
+                             f"got {x.shape} and {y.shape}")
+        if x.shape[0] < 2:
+            raise ValueError(f"need at least two observations, "
+                             f"got n={x.shape[0]}")
+        if not (self.eps1 > 0.0 and self.eps2 > 0.0):
+            raise ValueError(f"eps must be positive, got "
+                             f"({self.eps1}, {self.eps2})")
+        object.__setattr__(self, "x", x)
+        object.__setattr__(self, "y", y)
+
+    @property
+    def n(self) -> int:
+        return int(self.x.shape[0])
+
+
+def bucket_key(req: EstimateRequest) -> BucketKey:
+    return BucketKey(req.family, pad_n(req.n), float(req.eps1),
+                     float(req.eps2), float(req.alpha), bool(req.normalise))
+
+
+def kernel_key(req: EstimateRequest) -> KernelKey:
+    return KernelKey(req.family, req.n, float(req.eps1), float(req.eps2),
+                     float(req.alpha), bool(req.normalise))
+
+
+@dataclasses.dataclass(frozen=True)
+class EstimateResponse:
+    """The answer plus serving metadata (how the request was executed)."""
+
+    rho_hat: float
+    ci_low: float
+    ci_high: float
+    #: True when the request ran inside a coalesced vmap batch; False on
+    #: the unbatched degradation path (bucket never filled / batch-path
+    #: failure fallback).
+    batched: bool
+    #: number of live requests in the flushed launch (1 when unbatched)
+    batch_size: int
+    #: admission-to-completion wall seconds
+    latency_s: float
+    #: seed the noise stream was derived from (replayable)
+    seed: int

@@ -1,0 +1,201 @@
+"""The online DP-correlation server: admission → ledger → coalescer.
+
+:class:`DpcorrServer` is the in-process composition root the tests and
+the load generator drive directly; :func:`serve_http` wraps it in a
+stdlib threaded HTTP front end for ``python -m dpcorr serve``:
+
+- ``POST /estimate`` — one request (JSON body; arrays as lists) →
+  estimate, or 403 (budget refused) / 429 (overloaded) / 400 (invalid).
+- ``GET /stats`` — live counters + ledger snapshot (serve.stats shape).
+- ``GET /healthz`` — liveness.
+
+Admission order is the privacy invariant: the ledger is charged (and
+durably persisted) BEFORE the request is enqueued, so no query ever
+computes without its spend on disk; a crash after charge and before
+answer wastes budget rather than leaking it (ledger module docstring).
+
+Request noise streams extend the repo's key-tree contract (utils.rng):
+``master(server seed) → fold_in(request seed)`` — a request that pins
+``seed`` is exactly replayable against the same server seed, and the
+bit-identity tests recompute it the same way.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+
+from dpcorr.serve.coalescer import Coalescer, ServerOverloadedError
+from dpcorr.serve.kernels import KernelCache
+from dpcorr.serve.ledger import BudgetExceededError, PrivacyLedger
+from dpcorr.serve.request import EstimateRequest, EstimateResponse
+from dpcorr.serve.stats import ServeStats
+from dpcorr.utils import rng
+
+
+class DpcorrServer:
+    """In-process serving stack. Thread-safe; close() drains."""
+
+    def __init__(self, budget: float = 100.0,
+                 ledger_path: str | None = None,
+                 per_party_budget=None,
+                 seed: int = rng.MASTER_SEED,
+                 max_batch: int = 64, max_delay_s: float = 0.005,
+                 max_queue: int = 4096, shard: str = "auto",
+                 batch_mode: str = "exact"):
+        self.seed = seed
+        self.stats = ServeStats()
+        self.ledger = PrivacyLedger(budget, path=ledger_path,
+                                    per_party=per_party_budget)
+        self.cache = KernelCache(stats=self.stats, shard=shard,
+                                 mode=batch_mode)
+        self.coalescer = Coalescer(self.cache, self.stats,
+                                   max_batch=max_batch,
+                                   max_delay_s=max_delay_s,
+                                   max_queue=max_queue)
+        self._master = None
+        self._master_lock = threading.Lock()
+        self._req_counter = itertools.count()
+
+    def _request_key(self, seed: int):
+        with self._master_lock:
+            if self._master is None:
+                # deferred: no jax touch until the first admission
+                self._master = rng.master_key(self.seed)
+        return rng.design_key(self._master, seed)
+
+    # -- API -------------------------------------------------------------
+    def submit(self, req: EstimateRequest) -> Future:
+        """Admit one request: charge the ledger (may raise
+        BudgetExceededError), then enqueue (may raise
+        ServerOverloadedError). Returns a Future[EstimateResponse]."""
+        seed = req.seed if req.seed is not None else next(self._req_counter)
+        key = self._request_key(seed)
+        try:
+            self.ledger.charge_request(req)
+        except BudgetExceededError:
+            self.stats.refused_budget()
+            raise
+        self.stats.admitted()
+        return self.coalescer.submit(req, key, seed)
+
+    def estimate(self, req: EstimateRequest,
+                 timeout: float | None = 60.0) -> EstimateResponse:
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(req).result(timeout=timeout)
+
+    def stats_snapshot(self) -> dict:
+        return self.stats.snapshot(ledger_snapshot=self.ledger.snapshot())
+
+    def close(self) -> None:
+        self.coalescer.close()
+
+
+class InProcessClient:
+    """The client surface tests and the load generator program against —
+    the same calls a network client would make, minus the wire."""
+
+    def __init__(self, server: DpcorrServer):
+        self._server = server
+
+    def submit(self, req: EstimateRequest) -> Future:
+        return self._server.submit(req)
+
+    def estimate(self, req: EstimateRequest,
+                 timeout: float | None = 60.0) -> EstimateResponse:
+        return self._server.estimate(req, timeout=timeout)
+
+    def stats(self) -> dict:
+        return self._server.stats_snapshot()
+
+
+# ---------------------------------------------------------------- HTTP ----
+def _request_from_json(body: dict) -> EstimateRequest:
+    try:
+        return EstimateRequest(
+            family=body["family"],
+            x=np.asarray(body["x"], dtype=np.float32),
+            y=np.asarray(body["y"], dtype=np.float32),
+            eps1=float(body["eps1"]), eps2=float(body["eps2"]),
+            party_x=str(body.get("party_x", "party-x")),
+            party_y=str(body.get("party_y", "party-y")),
+            alpha=float(body.get("alpha", 0.05)),
+            normalise=bool(body.get("normalise", True)),
+            seed=(int(body["seed"]) if body.get("seed") is not None
+                  else None))
+    except KeyError as e:
+        raise ValueError(f"missing required field {e.args[0]!r}") from e
+
+
+def _response_json(resp: EstimateResponse) -> dict:
+    return {"rho_hat": resp.rho_hat, "ci_low": resp.ci_low,
+            "ci_high": resp.ci_high, "batched": resp.batched,
+            "batch_size": resp.batch_size,
+            "latency_s": round(resp.latency_s, 6), "seed": resp.seed}
+
+
+def make_http_server(server: DpcorrServer, host: str = "127.0.0.1",
+                     port: int = 8321):
+    """Build (not start) the threaded HTTP front end; the caller owns
+    ``serve_forever`` / ``shutdown`` so tests can run it on a thread."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, payload: dict) -> None:
+            blob = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def do_GET(self):  # noqa: N802 (stdlib handler casing)
+            if self.path == "/stats":
+                self._send(200, server.stats_snapshot())
+            elif self.path == "/healthz":
+                self._send(200, {"ok": True})
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/estimate":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = _request_from_json(json.loads(self.rfile.read(length)))
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": str(e)})
+                return
+            try:
+                resp = server.estimate(req)
+            except BudgetExceededError as e:
+                self._send(403, {"error": str(e), "refused": "budget"})
+            except ServerOverloadedError as e:
+                self._send(429, {"error": str(e), "refused": "overload"})
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            else:
+                self._send(200, _response_json(resp))
+
+        def log_message(self, *args):  # quiet by default
+            pass
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def serve_http(server: DpcorrServer, host: str = "127.0.0.1",
+               port: int = 8321) -> None:
+    """Run the HTTP front end until interrupted (the CLI entry)."""
+    httpd = make_http_server(server, host=host, port=port)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        server.close()

@@ -1,0 +1,133 @@
+"""Serving observability: counters, latency percentiles, fill ratios.
+
+The grid driver reports throughput after the fact (grid.py timings
+frame); an online server needs live counters an operator can poll while
+traffic flows. One :class:`ServeStats` instance is shared by the
+coalescer, kernel cache and server; ``snapshot()`` is the single JSON
+shape exposed by the ``/stats`` endpoint, ``benchmarks/serve_load.py``
+and the tests.
+
+:func:`percentiles` is the one quantile implementation shared with the
+offline bench (bench.py block-latency reporting) so a reported p99
+always means the same estimator (nearest-rank).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Iterable, Sequence
+
+
+def percentiles(values: Iterable[float],
+                qs: Sequence[float] = (0.5, 0.99)) -> dict[str, float]:
+    """Nearest-rank percentiles, keyed ``"p50"``-style. Empty input →
+    empty dict (callers render absent, not fake-zero, metrics)."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return {}
+    out = {}
+    for q in qs:
+        rank = max(0, min(len(vals) - 1, int(round(q * len(vals))) - 1))
+        out[f"p{int(q * 100)}"] = vals[rank]
+    return out
+
+
+class ServeStats:
+    """Thread-safe serving counters.
+
+    Counters are monotone totals (Prometheus-counter style) except
+    ``queue_depth`` (a gauge maintained by the coalescer) and the
+    latency reservoir (last ``reservoir`` completions — bounded memory,
+    recency-biased percentiles, same trade-off as production servers'
+    sliding-window summaries).
+    """
+
+    def __init__(self, reservoir: int = 8192):
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.requests_refused_budget = 0
+        self.requests_refused_overload = 0
+        self.requests_failed = 0
+        self.batches_flushed = 0
+        self.batched_requests = 0
+        self.unbatched_requests = 0
+        self.flush_size_max = 0
+        self.kernel_compiles = 0
+        self.kernel_hits = 0
+        self.queue_depth = 0
+        self._latencies: deque[float] = deque(maxlen=reservoir)
+
+    # -- recording -------------------------------------------------------
+    def admitted(self) -> None:
+        with self._lock:
+            self.requests_total += 1
+
+    def refused_budget(self) -> None:
+        with self._lock:
+            self.requests_refused_budget += 1
+
+    def refused_overload(self) -> None:
+        with self._lock:
+            self.requests_refused_overload += 1
+
+    def failed(self, k: int = 1) -> None:
+        with self._lock:
+            self.requests_failed += k
+
+    def flushed(self, size: int, batched: bool) -> None:
+        with self._lock:
+            self.batches_flushed += 1
+            self.flush_size_max = max(self.flush_size_max, size)
+            if batched:
+                self.batched_requests += size
+            else:
+                self.unbatched_requests += size
+
+    def kernel(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.kernel_hits += 1
+            else:
+                self.kernel_compiles += 1
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(float(seconds))
+
+    # -- reading ---------------------------------------------------------
+    def batch_fill_ratio(self) -> float:
+        """Mean live requests per flushed launch — the number the load
+        test gates on (> 1 means real coalescing happened)."""
+        with self._lock:
+            if not self.batches_flushed:
+                return 0.0
+            return (self.batched_requests + self.unbatched_requests) \
+                / self.batches_flushed
+
+    def snapshot(self, ledger_snapshot: dict | None = None) -> dict:
+        with self._lock:
+            done = self.batched_requests + self.unbatched_requests
+            snap = {
+                "requests_total": self.requests_total,
+                "requests_refused_budget": self.requests_refused_budget,
+                "requests_refused_overload": self.requests_refused_overload,
+                "requests_failed": self.requests_failed,
+                "batches_flushed": self.batches_flushed,
+                "batched_requests": self.batched_requests,
+                "unbatched_requests": self.unbatched_requests,
+                "batch_fill_ratio": (done / self.batches_flushed
+                                     if self.batches_flushed else 0.0),
+                "flush_size_max": self.flush_size_max,
+                "kernel_compiles": self.kernel_compiles,
+                "kernel_hits": self.kernel_hits,
+                "queue_depth": self.queue_depth,
+                "latency_s": percentiles(self._latencies),
+            }
+        if ledger_snapshot is not None:
+            snap["ledger"] = ledger_snapshot
+        return snap

@@ -77,8 +77,14 @@ def default_queue_dir() -> str:
         return env
     if os.path.isdir("/tmp/tpu_r05"):
         return "/tmp/tpu_r05"
-    legacy = os.environ.get("TPU_R04_IN") or "/tmp/tpu_r04"
-    return legacy if os.path.isdir(legacy) else "/tmp/tpu_r05"
+    # an explicitly-set TPU_R04_IN is honored unconditionally, exactly
+    # like TPU_R05_IN above — the operator pointed at it, report on it
+    # even if it doesn't exist yet; only the *default* legacy dir must
+    # prove itself with an isdir check
+    legacy = os.environ.get("TPU_R04_IN")
+    if legacy:
+        return legacy
+    return "/tmp/tpu_r04" if os.path.isdir("/tmp/tpu_r04") else "/tmp/tpu_r05"
 
 
 def check_relay(ports=None, timeout=2.0) -> dict:

@@ -142,6 +142,14 @@ def test_queue_dir_resolution_matches_queue_script(monkeypatch):
     monkeypatch.setattr(doctor.os.path, "isdir",
                         lambda p: p == "/data/r04")
     assert doctor.default_queue_dir() == "/data/r04"
+    # an explicit TPU_R04_IN is honored even before its dir exists —
+    # same rule as TPU_R05_IN (an operator override is a statement of
+    # intent, not a claim the queue already ran)
+    monkeypatch.setattr(doctor.os.path, "isdir", lambda p: False)
+    assert doctor.default_queue_dir() == "/data/r04"
+    # the *default* legacy dir still has to prove itself
+    monkeypatch.delenv("TPU_R04_IN", raising=False)
+    assert doctor.default_queue_dir() == "/tmp/tpu_r05"
 
 
 def test_probe_skipped_when_relay_dead(monkeypatch, tmp_path):

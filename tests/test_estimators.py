@@ -47,6 +47,34 @@ class TestBatchGeometry:
         with pytest.raises(ValueError):
             batch_geometry(0, 1.0, 1.0)
 
+    def test_f32_band_detects_real_dyn_disagreement(self):
+        """f32_geometry_band predicts exactly where the traced f32 rule
+        (batch_geometry_dyn) departs from the static f64 rule: ε=1.1547
+        puts q=8/ε² within f32-ulp of 6, so the snap-down guard picks
+        m=6 where f64 ceils to 7."""
+        from dpcorr.models.estimators.common import (batch_geometry_dyn,
+                                                     f32_geometry_band)
+
+        e = 1.1547
+        hits = f32_geometry_band([(e, e)], n=1000)
+        assert hits == [(e, e, 7, 6)]
+        assert batch_geometry(1000, e, e)[0] == 7
+        assert int(batch_geometry_dyn(1000, e, e)[0]) == 6
+        # ordinary pairs sit nowhere near the band
+        assert f32_geometry_band([(1.0, 0.5), (1.0, 1.0)], n=1000) == []
+
+    def test_f32_band_warns_once_per_entry_point(self, caplog):
+        import dpcorr.models.estimators.common as common
+
+        common._F32_BAND_WARNED.discard("test-entry")
+        with caplog.at_level("WARNING", logger=common.__name__):
+            hits = common.warn_f32_geometry_band_once(
+                [(1.1547, 1.1547)], where="test-entry")
+            assert hits and len(caplog.records) == 1
+            common.warn_f32_geometry_band_once(
+                [(1.1547, 1.1547)], where="test-entry")
+            assert len(caplog.records) == 1  # logged once, found twice
+
 
 class TestNiSign:
     def test_deterministic(self):

@@ -69,6 +69,19 @@ def test_multihost_requires_out_dir():
         run_grid_multihost(GridConfig(**GCFG), n_hosts=2)
 
 
+def _jax_supports_multiprocess_cpu() -> bool:
+    # jax < 0.5 CPU backends reject cross-process computations outright
+    # ("Multiprocess computations aren't implemented on the CPU
+    # backend") — the local 2-process cluster rehearsal needs the CPU
+    # collectives stack that ships with newer jax
+    import jax
+
+    return tuple(int(x) for x in jax.__version__.split(".")[:2]) >= (0, 5)
+
+
+@pytest.mark.skipif(not _jax_supports_multiprocess_cpu(),
+                    reason="multiprocess CPU collectives unimplemented "
+                           "in this jax's CPU backend")
 def test_distributed_cluster_matches_single_host(tmp_path, monkeypatch):
     """VERDICT r2 #7: the fan-out over a *real* ``jax.distributed``
     runtime — a local 2-process CPU cluster (2 virtual devices per worker,

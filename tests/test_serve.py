@@ -1,0 +1,486 @@
+"""Serving subsystem tests (ISSUE 1): ledger accounting, coalescer
+bit-identity, kernel cache, backpressure, stats, HTTP front end, and an
+in-process concurrent load drive.
+
+The bit-identity reference is always the *direct* single-request call —
+``jit(single)`` of the same ``serving_entry`` closure on the same
+key-tree address — which the default ``exact`` batch engine must match
+bit-for-bit (estimators.registry contract).
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from dpcorr.models.estimators.registry import FAMILIES, serving_entry
+from dpcorr.serve import (
+    BudgetExceededError,
+    DpcorrServer,
+    EstimateRequest,
+    InProcessClient,
+    KernelCache,
+    PrivacyLedger,
+    ServeStats,
+    ServerOverloadedError,
+    make_http_server,
+    request_charges,
+)
+from dpcorr.serve.kernels import pad_batch
+from dpcorr.serve.request import bucket_key, kernel_key, pad_n
+from dpcorr.serve.stats import percentiles
+from dpcorr.utils import rng
+
+
+def _mk_req(n=96, family="ni_sign", seed=None, i=0, **kw):
+    rs = np.random.RandomState(100 + i)
+    return EstimateRequest(family, rs.randn(n).astype(np.float32),
+                          rs.randn(n).astype(np.float32),
+                          1.0, 0.5, seed=seed, **kw)
+
+
+def _direct(server, req):
+    """The reference answer: the plain jitted single-request program on
+    the request's key-tree address (server-seed → fold_in(request seed))."""
+    single = serving_entry(req.family, req.eps1, req.eps2,
+                           alpha=req.alpha, normalise=req.normalise)
+    key = rng.design_key(rng.master_key(server.seed), req.seed)
+    return tuple(float(v) for v in jax.jit(single)(key, req.x, req.y))
+
+
+# ---------------------------------------------------------------- units ----
+
+def test_pad_n_buckets():
+    assert pad_n(2) == 64          # floor
+    assert pad_n(64) == 64
+    assert pad_n(65) == 128
+    assert pad_n(500) == 512
+    assert pad_n(512) == 512
+    assert pad_n(513) == 1024
+
+
+def test_pad_batch():
+    assert [pad_batch(b) for b in (1, 2, 3, 4, 5, 13, 16, 17)] == \
+        [1, 2, 4, 4, 8, 16, 16, 32]
+
+
+def test_request_validation():
+    with pytest.raises(ValueError, match="unknown estimator family"):
+        _mk_req(family="nope")
+    with pytest.raises(ValueError, match="equal-length"):
+        EstimateRequest("ni_sign", np.zeros(8, np.float32),
+                        np.zeros(9, np.float32), 1.0, 1.0)
+    with pytest.raises(ValueError, match="eps must be positive"):
+        EstimateRequest("ni_sign", np.zeros(8, np.float32),
+                        np.zeros(8, np.float32), 0.0, 1.0)
+    with pytest.raises(ValueError, match="at least two"):
+        EstimateRequest("ni_sign", np.zeros(1, np.float32),
+                        np.zeros(1, np.float32), 1.0, 1.0)
+
+
+def test_bucket_vs_kernel_key():
+    a, b = _mk_req(n=400, i=0), _mk_req(n=500, i=1)
+    assert bucket_key(a) == bucket_key(b)      # both pad to 512
+    assert kernel_key(a) != kernel_key(b)      # exact n differs
+    c = _mk_req(n=400, family="int_sign", i=2)
+    assert bucket_key(a) != bucket_key(c)
+
+
+# --------------------------------------------------------------- ledger ----
+
+def test_request_charges_composition():
+    # sign family + normalise: private centering doubles each side's spend
+    r = _mk_req(family="ni_sign", party_x="a", party_y="b")
+    assert request_charges(r) == {"a": 2.0, "b": 1.0}
+    # subG families clip with data-independent bounds: spend once
+    r = _mk_req(family="ni_subg", party_x="a", party_y="b")
+    assert request_charges(r) == {"a": 1.0, "b": 0.5}
+    # same party on both sides accumulates
+    r = _mk_req(family="int_sign", party_x="a", party_y="a")
+    assert request_charges(r) == {"a": 3.0}
+    r = _mk_req(family="ni_sign", normalise=False, party_x="a", party_y="b")
+    assert request_charges(r) == {"a": 1.0, "b": 0.5}
+
+
+def test_ledger_arithmetic_and_refusal():
+    led = PrivacyLedger(budget=5.0)
+    led.charge({"a": 2.0, "b": 1.0})
+    led.charge({"a": 2.0})
+    assert led.spent("a") == pytest.approx(4.0)
+    assert led.remaining("a") == pytest.approx(1.0)
+    # exact landing on the cap is admitted (strict >)
+    led.charge({"a": 1.0})
+    assert led.remaining("a") == pytest.approx(0.0)
+    with pytest.raises(BudgetExceededError) as ei:
+        led.charge({"a": 1e-6})
+    assert ei.value.party == "a"
+    # refused charge must not partially mutate any party (all-or-nothing)
+    before_b = led.spent("b")
+    with pytest.raises(BudgetExceededError):
+        led.charge({"b": 0.5, "a": 1.0})
+    assert led.spent("b") == before_b
+
+
+def test_ledger_per_party_override():
+    led = PrivacyLedger(budget=100.0, per_party={"tight": 1.0})
+    led.charge({"tight": 1.0, "loose": 50.0})
+    with pytest.raises(BudgetExceededError):
+        led.charge({"tight": 0.1})
+    led.charge({"loose": 50.0})
+
+
+def test_ledger_persistence_across_restart(tmp_path):
+    path = str(tmp_path / "ledger.json")
+    led = PrivacyLedger(budget=3.0, path=path)
+    led.charge({"a": 2.0})
+    # simulated crash + restart: a fresh process loads the spend table
+    led2 = PrivacyLedger(budget=3.0, path=path)
+    assert led2.spent("a") == pytest.approx(2.0)
+    led2.charge({"a": 1.0})
+    # the same query again would double-spend — must refuse
+    with pytest.raises(BudgetExceededError):
+        led2.charge({"a": 1.0})
+    # third incarnation still sees the full spend
+    led3 = PrivacyLedger(budget=3.0, path=path)
+    assert led3.spent("a") == pytest.approx(3.0)
+    state = json.load(open(path))
+    assert state["version"] == 1 and state["spent"]["a"] == pytest.approx(3.0)
+
+
+def test_ledger_persist_is_write_ahead(tmp_path):
+    """The spend is on disk before charge() returns — a crash after a
+    successful charge can never resurrect the budget."""
+    path = str(tmp_path / "ledger.json")
+    led = PrivacyLedger(budget=10.0, path=path)
+    led.charge({"a": 4.0})
+    on_disk = json.load(open(path))["spent"]["a"]
+    assert on_disk == pytest.approx(4.0)
+
+
+def test_ledger_rejects_unknown_state_version(tmp_path):
+    path = tmp_path / "ledger.json"
+    path.write_text(json.dumps({"version": 99, "spent": {}}))
+    with pytest.raises(ValueError, match="version"):
+        PrivacyLedger(budget=1.0, path=str(path))
+
+
+# ---------------------------------------------------------------- stats ----
+
+def test_percentiles_nearest_rank():
+    vals = list(range(1, 101))
+    p = percentiles(vals)
+    assert p == {"p50": 50, "p99": 99}
+    assert percentiles([]) == {}
+    assert percentiles([7.0]) == {"p50": 7.0, "p99": 7.0}
+
+
+def test_stats_fill_ratio_and_snapshot():
+    st = ServeStats()
+    assert st.batch_fill_ratio() == 0.0
+    st.flushed(8, batched=True)
+    st.flushed(1, batched=False)
+    assert st.batch_fill_ratio() == pytest.approx(4.5)
+    snap = st.snapshot(ledger_snapshot={"budget_default": 1.0,
+                                        "parties": {}})
+    assert snap["batches_flushed"] == 2
+    assert snap["flush_size_max"] == 8
+    assert snap["ledger"]["budget_default"] == 1.0
+
+
+def test_serve_stats_frame():
+    from dpcorr.report import serve_stats_frame
+
+    st = ServeStats()
+    st.admitted()
+    st.flushed(4, batched=True)
+    st.observe_latency(0.01)
+    df = serve_stats_frame(st.snapshot(
+        ledger_snapshot={"budget_default": 2.0,
+                         "parties": {"a": {"spent": 1.0, "budget": 2.0,
+                                           "remaining": 1.0}}}))
+    metrics = dict(zip(df["metric"], df["value"]))
+    assert metrics["requests_total"] == 1
+    assert metrics["ledger.parties.a.spent"] == 1.0
+    assert metrics["latency_s.p50"] == pytest.approx(0.01)
+
+
+# -------------------------------------------------------------- kernels ----
+
+def test_kernel_cache_counts_compiles_and_hits():
+    cache = KernelCache(shard="off")
+    kk = kernel_key(_mk_req(n=64))
+    f1, _ = cache.get(kk, 4)
+    f2, _ = cache.get(kk, 4)
+    assert f1 is f2
+    assert cache.stats.kernel_compiles == 1
+    assert cache.stats.kernel_hits == 1
+    # different padded width = different compiled signature
+    cache.get(kk, 8)
+    assert cache.stats.kernel_compiles == 2
+
+
+def test_kernel_cache_rejects_bad_modes():
+    with pytest.raises(ValueError, match="shard"):
+        KernelCache(shard="maybe")
+    with pytest.raises(ValueError, match="mode"):
+        KernelCache(mode="fast")
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_exact_batch_bit_identical_to_direct(family):
+    """The exact engine's batched lanes — including padding truncation
+    (b=5 pads to 8) — are bit-identical to jit(single) for EVERY family."""
+    n, b = 96, 5
+    single = serving_entry(family, 1.0, 0.5)
+    js = jax.jit(single)
+    cache = KernelCache(shard="off", mode="exact")
+    kk = kernel_key(_mk_req(n=n, family=family))
+    master = rng.master_key(11)
+    rs = np.random.RandomState(3)
+    xs = rs.randn(b, n).astype(np.float32)
+    ys = rs.randn(b, n).astype(np.float32)
+    import jax.numpy as jnp
+    keys = jnp.stack([rng.design_key(master, i) for i in range(b)])
+    out = cache.run_batch(kk, keys, xs, ys)
+    assert out[0].shape == (b,)
+    for i in range(b):
+        ref = tuple(float(v) for v in js(keys[i], xs[i], ys[i]))
+        got = tuple(float(out[j][i]) for j in range(3))
+        assert got == ref, (family, i)
+
+
+def test_vector_batch_rho_exact_and_width_invariant():
+    """The vector engine: rho_hat bit-identical to direct, CI within
+    1 ulp; lanes bit-identical across batch widths ≥ 2."""
+    n, b = 96, 8
+    single = serving_entry("ni_sign", 1.0, 0.5)
+    js = jax.jit(single)
+    cache = KernelCache(shard="off", mode="vector")
+    kk = kernel_key(_mk_req(n=n))
+    master = rng.master_key(11)
+    rs = np.random.RandomState(3)
+    xs = rs.randn(b, n).astype(np.float32)
+    ys = rs.randn(b, n).astype(np.float32)
+    import jax.numpy as jnp
+    keys = jnp.stack([rng.design_key(master, i) for i in range(b)])
+    full = cache.run_batch(kk, keys, xs, ys)
+    for i in range(b):
+        ref = tuple(float(v) for v in js(keys[i], xs[i], ys[i]))
+        assert float(full[0][i]) == ref[0]
+        np.testing.assert_allclose(
+            [float(full[1][i]), float(full[2][i])], ref[1:], rtol=3e-7)
+    # width invariance: the first two lanes served as a pair match the
+    # same lanes served in the width-8 batch, bit for bit
+    pair = cache.run_batch(kk, keys[:2], xs[:2], ys[:2])
+    for j in range(3):
+        assert float(pair[j][0]) == float(full[j][0])
+        assert float(pair[j][1]) == float(full[j][1])
+
+
+def test_sharded_batch_bit_identical(devices):
+    """With the batch axis split over the 8-device mesh, exact-engine
+    lanes still match jit(single) bit-for-bit."""
+    n, b = 96, 16  # 16 % 8 == 0 → sharded path
+    single = serving_entry("ni_sign", 1.0, 0.5)
+    js = jax.jit(single)
+    cache = KernelCache(shard="auto", mode="exact")
+    kk = kernel_key(_mk_req(n=n))
+    master = rng.master_key(11)
+    rs = np.random.RandomState(3)
+    xs = rs.randn(b, n).astype(np.float32)
+    ys = rs.randn(b, n).astype(np.float32)
+    import jax.numpy as jnp
+    keys = jnp.stack([rng.design_key(master, i) for i in range(b)])
+    shards = cache._n_shards(pad_batch(b))
+    assert shards == 8
+    out = cache.run_batch(kk, keys, xs, ys)
+    for i in range(0, b, 3):
+        ref = tuple(float(v) for v in js(keys[i], xs[i], ys[i]))
+        assert tuple(float(out[j][i]) for j in range(3)) == ref
+
+
+# --------------------------------------------------------------- server ----
+
+def test_server_estimate_matches_direct_call():
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+    try:
+        req = _mk_req(seed=42)
+        resp = srv.estimate(req)
+        assert _direct(srv, req) == (resp.rho_hat, resp.ci_low, resp.ci_high)
+        assert resp.seed == 42 and resp.batch_size == 1
+    finally:
+        srv.close()
+
+
+def test_server_concurrent_load_coalesces_and_bit_matches():
+    """An in-process load drive: concurrent clients, one bucket; asserts
+    fill ratio > 1 and every response bit-identical to the direct call."""
+    n_req, n_clients = 192, 8
+    srv = DpcorrServer(budget=1e6, max_batch=32, max_delay_s=0.05,
+                       max_queue=4 * n_req, shard="off")
+    cli = InProcessClient(srv)
+    reqs = [_mk_req(seed=i, i=i) for i in range(n_req)]
+    out: dict[int, object] = {}
+    lock = threading.Lock()
+    per = n_req // n_clients
+
+    def worker(c):
+        futs = [(i, cli.submit(reqs[i]))
+                for i in range(c * per, (c + 1) * per)]
+        for i, f in futs:
+            r = f.result(timeout=120)
+            with lock:
+                out[i] = r
+    try:
+        ts = [threading.Thread(target=worker, args=(c,))
+              for c in range(n_clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        srv.close()
+    assert len(out) == n_req
+    snap = cli.stats()
+    assert snap["batch_fill_ratio"] > 1.0
+    assert snap["batched_requests"] > 0
+    for i in (0, 7, 63, 100, n_req - 1):
+        r = out[i]
+        assert _direct(srv, reqs[i]) == (r.rho_hat, r.ci_low, r.ci_high), i
+
+
+def test_server_refuses_over_budget_first_query():
+    """The first query that would overdraw is refused; earlier ones all
+    admitted — the acceptance criterion, at the server boundary."""
+    req = _mk_req(seed=1)  # ni_sign+normalise: spends 2*eps1 on party_x
+    charges = request_charges(req)
+    budget = 3 * charges["party-x"]
+    srv = DpcorrServer(budget=1e6,
+                       per_party_budget={"party-x": budget},
+                       max_delay_s=0.001, shard="off")
+    try:
+        for _ in range(3):
+            srv.estimate(req)
+        with pytest.raises(BudgetExceededError):
+            srv.estimate(req)
+        snap = srv.stats_snapshot()
+        assert snap["requests_total"] == 3
+        assert snap["requests_refused_budget"] == 1
+        assert snap["ledger"]["parties"]["party-x"]["remaining"] == \
+            pytest.approx(0.0)
+    finally:
+        srv.close()
+
+
+def test_server_refusal_spends_nothing():
+    req = _mk_req(seed=1)
+    srv = DpcorrServer(budget=1e6, per_party_budget={"party-x": 0.5},
+                       max_delay_s=0.001, shard="off")
+    try:
+        with pytest.raises(BudgetExceededError):
+            srv.submit(req)
+        assert srv.ledger.spent("party-x") == 0.0
+        assert srv.ledger.spent("party-y") == 0.0
+    finally:
+        srv.close()
+
+
+def test_server_ledger_survives_restart(tmp_path):
+    path = str(tmp_path / "ledger.json")
+    req = _mk_req(seed=1)
+    budget = 2 * request_charges(req)["party-x"]
+    srv = DpcorrServer(budget=1e6, ledger_path=path,
+                       per_party_budget={"party-x": budget},
+                       max_delay_s=0.001, shard="off")
+    srv.estimate(req)
+    srv.close()  # "crash" after one answered query
+    srv2 = DpcorrServer(budget=1e6, ledger_path=path,
+                        per_party_budget={"party-x": budget},
+                        max_delay_s=0.001, shard="off")
+    try:
+        srv2.estimate(req)  # second query still fits
+        with pytest.raises(BudgetExceededError):
+            srv2.estimate(req)  # third would double-spend — refused
+    finally:
+        srv2.close()
+
+
+def test_coalescer_backpressure_sheds_load():
+    # a delay window far longer than the test: nothing flushes while we
+    # overfill the queue
+    srv = DpcorrServer(budget=1e6, max_batch=1024, max_delay_s=30.0,
+                       max_queue=4, shard="off")
+    try:
+        futs = [srv.submit(_mk_req(seed=i)) for i in range(4)]
+        with pytest.raises(ServerOverloadedError):
+            srv.submit(_mk_req(seed=99))
+        assert srv.stats.requests_refused_overload == 1
+    finally:
+        srv.close()  # close drains: the 4 pending still get answers
+    for f in futs:
+        assert f.result(timeout=60).rho_hat == f.result().rho_hat
+
+
+def test_server_assigns_seeds_when_unpinned():
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+    try:
+        r1 = srv.estimate(_mk_req(seed=None, i=0))
+        r2 = srv.estimate(_mk_req(seed=None, i=0))
+        # distinct admission-counter seeds → distinct noise draws on
+        # identical data
+        assert r1.seed != r2.seed
+        assert r1.rho_hat != r2.rho_hat
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------------------- HTTP ----
+
+def test_http_endpoints_smoke():
+    srv = DpcorrServer(budget=1e6,
+                       per_party_budget={"tiny": 0.1},
+                       max_delay_s=0.001, shard="off")
+    httpd = make_http_server(srv, host="127.0.0.1", port=0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+
+    def post(payload, expect):
+        try:
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/estimate", data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})) as r:
+                assert r.status == expect
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            assert e.code == expect
+            return json.load(e)
+
+    try:
+        with urllib.request.urlopen(f"{base}/healthz") as r:
+            assert json.load(r) == {"ok": True}
+        req = _mk_req(seed=5)
+        body = {"family": "ni_sign", "x": req.x.tolist(),
+                "y": req.y.tolist(), "eps1": 1.0, "eps2": 0.5, "seed": 5}
+        got = post(body, 200)
+        assert _direct(srv, req) == (got["rho_hat"], got["ci_low"],
+                                     got["ci_high"])
+        # invalid request → 400
+        post({"family": "nope", "x": [1, 2], "y": [1, 2],
+              "eps1": 1, "eps2": 1}, 400)
+        # over-budget party → 403
+        refused = post(dict(body, party_x="tiny"), 403)
+        assert refused["refused"] == "budget"
+        with urllib.request.urlopen(f"{base}/stats") as r:
+            snap = json.load(r)
+        assert snap["requests_total"] == 1
+        assert snap["requests_refused_budget"] == 1
+        assert "ledger" in snap
+    finally:
+        httpd.shutdown()
+        srv.close()

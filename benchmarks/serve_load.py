@@ -66,7 +66,12 @@ def main() -> int:
     import numpy as np
 
     from dpcorr.models.estimators.registry import serving_entry
-    from dpcorr.serve import DpcorrServer, EstimateRequest, InProcessClient
+    from dpcorr.serve import (
+        DpcorrServer,
+        EstimateRequest,
+        InProcessClient,
+        pinned_request_key,
+    )
     from dpcorr.serve.ledger import BudgetExceededError, request_charges
     from dpcorr.utils import rng
 
@@ -132,7 +137,10 @@ def main() -> int:
     check_ci = args.batch_mode == "exact"
     for i in sorted(responses)[::step]:
         r = responses[i]
-        d = single(rng.design_key(master, r.seed), reqs[i].x, reqs[i].y)
+        # requests pin their seeds, so the reference recomputes the
+        # content-bound pinned-subtree key (serve.server contract)
+        d = single(pinned_request_key(master, reqs[i], r.seed),
+                   reqs[i].x, reqs[i].y)
         checked += 1
         if float(d[0]) != r.rho_hat or (check_ci and (
                 float(d[1]) != r.ci_low or float(d[2]) != r.ci_high)):

@@ -213,7 +213,7 @@ def cmd_serve(args):
         seed=args.seed, max_batch=args.max_batch,
         max_delay_s=args.max_delay_ms / 1000.0,
         max_queue=args.max_queue, shard=args.shard,
-        batch_mode=args.batch_mode)
+        batch_mode=args.batch_mode, max_kernels=args.max_kernels)
     print(json.dumps({"serving": {"host": args.host, "port": args.port,
                                   "budget": args.budget,
                                   "ledger": args.ledger,
@@ -292,6 +292,11 @@ def main(argv=None):
                      help="batch engine: 'exact' (lax.map; bit-identical "
                           "to direct calls) or 'vector' (vmap; faster, CI "
                           "endpoints within 1 ulp — see docs/SERVING.md)")
+    ps_.add_argument("--max-kernels", dest="max_kernels", type=int,
+                     default=128,
+                     help="LRU cap on live compiled kernels (signatures "
+                          "include exact n, so unbounded n-sweeps would "
+                          "otherwise grow compilations without limit)")
     ps_.add_argument("--seed", type=int, default=2025)
     ps_.add_argument("--platform", default=None, choices=["cpu", "tpu"])
     ps_.set_defaults(fn=cmd_serve)

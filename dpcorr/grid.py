@@ -338,7 +338,11 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                                 if out_dir else None)
                      for r in rows}
 
-            def mk_stamps(suffix: str):
+            # cfg/rows bound as defaults: the closure rides the pending
+            # tuple into phase 2, and the loop variables it would
+            # otherwise capture are function-scoped — by fetch time they
+            # hold the LAST bucket's values, not this one's
+            def mk_stamps(suffix: str, cfg=cfg, rows=rows):
                 # ε replaced per row: in merged mode the bucket cfg
                 # carries only the FIRST row's ε (a no-op otherwise)
                 return {int(r.i): _stamp(dataclasses.replace(

@@ -47,6 +47,7 @@ from dpcorr.serve.server import (  # noqa: F401
     DpcorrServer,
     InProcessClient,
     make_http_server,
+    pinned_request_key,
     serve_http,
 )
 from dpcorr.serve.stats import ServeStats, percentiles  # noqa: F401

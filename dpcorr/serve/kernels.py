@@ -31,6 +31,8 @@ tests/test_serve.py).
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from typing import Callable
 
 import numpy as np
@@ -51,20 +53,32 @@ class KernelCache:
     ``jax.jit`` already memoizes compilations, but an explicit cache (a)
     makes the signature an auditable object instead of an implicit
     closure identity — rebuilding ``serving_entry`` closures per flush
-    would defeat jit's cache entirely — and (b) feeds the
-    compile/hit counters the stats endpoint reports.
+    would defeat jit's cache entirely — (b) feeds the
+    compile/hit counters the stats endpoint reports, and (c) bounds
+    live compilations: signatures include the exact n, so a client
+    sweeping sample sizes would otherwise grow the kernel set without
+    limit in a long-running server. ``max_kernels`` caps it with LRU
+    eviction (evicting our reference also releases the underlying jit
+    wrapper and its executables); the live count is a stats gauge
+    (``kernel_cache_size``). Steady-state traffic — a working set
+    smaller than the cap — still never recompiles.
     """
 
     def __init__(self, stats: ServeStats | None = None,
-                 shard: str = "auto", mode: str = "exact"):
+                 shard: str = "auto", mode: str = "exact",
+                 max_kernels: int = 128):
         if shard not in ("auto", "off"):
             raise ValueError(f"shard must be 'auto' or 'off', got {shard!r}")
         if mode not in ("exact", "vector"):
             raise ValueError(f"mode must be 'exact' or 'vector', got {mode!r}")
+        if max_kernels < 1:
+            raise ValueError(f"max_kernels must be >= 1, got {max_kernels}")
         self.stats = stats or ServeStats()
         self.shard = shard
         self.mode = mode
-        self._fns: dict[tuple, Callable] = {}
+        self.max_kernels = max_kernels
+        self._lock = threading.Lock()
+        self._fns: OrderedDict[tuple, Callable] = OrderedDict()
 
     def _n_shards(self, b_pad: int) -> int:
         """How many mesh shards this launch uses (1 = unsharded)."""
@@ -84,10 +98,12 @@ class KernelCache:
 
         shards = self._n_shards(b_pad)
         cache_key = (kkey, b_pad, shards)
-        fn = self._fns.get(cache_key)
-        if fn is not None:
-            self.stats.kernel(hit=True)
-            return fn, shards
+        with self._lock:
+            fn = self._fns.get(cache_key)
+            if fn is not None:
+                self._fns.move_to_end(cache_key)  # LRU freshness
+                self.stats.kernel(hit=True)
+                return fn, shards
         single = serving_entry(kkey.family, kkey.eps1, kkey.eps2,
                                alpha=kkey.alpha, normalise=kkey.normalise)
         if shards > 1:
@@ -100,8 +116,13 @@ class KernelCache:
             fn = jax.jit(
                 lambda keys, xs, ys: jax.lax.map(
                     lambda t: single(*t), (keys, xs, ys)))
-        self._fns[cache_key] = fn
-        self.stats.kernel(hit=False)
+        with self._lock:
+            self._fns[cache_key] = fn
+            self._fns.move_to_end(cache_key)
+            while len(self._fns) > self.max_kernels:
+                self._fns.popitem(last=False)  # evict least-recently-used
+            self.stats.kernel(hit=False)
+            self.stats.set_kernel_cache_size(len(self._fns))
         return fn, shards
 
     def run_batch(self, kkey: KernelKey, keys, xs: np.ndarray,

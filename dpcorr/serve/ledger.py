@@ -26,6 +26,10 @@ budget, across restarts. This module is that invariant:
   spend is not on disk. A restart therefore under-counts never,
   over-counts at most the in-flight queries that were admitted but
   never answered — the safe direction for privacy.
+- **Refund only for never-executed queries**:
+  :meth:`PrivacyLedger.refund` reverses a charge when the server can
+  prove no kernel ran (the enqueue itself refused the request), so
+  backpressure sheds load without consuming ε.
 
 Thread-safe: one lock around check+spend+persist (the coalescer admits
 from many client threads).
@@ -141,6 +145,26 @@ class PrivacyLedger:
         charges = request_charges(req)
         self.charge(charges)
         return charges
+
+    def refund(self, charges: Mapping[str, float]) -> None:
+        """Reverse a charge whose query provably never executed.
+
+        Only valid when no kernel ran and nothing was released under
+        the charged ε — the server uses it when the enqueue itself
+        refuses an already-charged request (queue backpressure), so
+        sustained overload cannot drain budgets to exhaustion with zero
+        queries served. The reversal is persisted like a charge; spends
+        clamp at zero so a stray refund can only err toward privacy
+        (over-counting), never under-counting.
+        """
+        for party, eps in charges.items():
+            if eps < 0.0:
+                raise ValueError(f"negative refund {eps} for {party!r}")
+        with self._lock:
+            for party, eps in charges.items():
+                self._spent[party] = max(
+                    0.0, self._spent.get(party, 0.0) - eps)
+            self._persist_locked()
 
     def snapshot(self) -> dict:
         """Point-in-time accounting view (the stats endpoint's shape)."""

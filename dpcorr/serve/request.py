@@ -64,8 +64,11 @@ class EstimateRequest:
     ``party_x`` / ``party_y`` name the data owners whose privacy budget
     the query spends (ε₁ against x's owner, ε₂ against y's — doubled
     for sign families with ``normalise``, see serve.ledger). ``seed``
-    pins the request's noise stream for reproducible replays; ``None``
-    lets the server assign one from its admission counter.
+    pins the request's noise stream for reproducible replays of this
+    exact request — the stream is bound to the request content
+    (server.pinned_request_key), so reusing a seed over different data
+    draws independent noise rather than enabling differencing. ``None``
+    lets the server assign a stream from its per-boot subtree.
     """
 
     family: str
@@ -127,5 +130,7 @@ class EstimateResponse:
     batch_size: int
     #: admission-to-completion wall seconds
     latency_s: float
-    #: seed the noise stream was derived from (replayable)
+    #: seed the noise stream was derived from — replayable only when
+    #: the request pinned it (server-assigned streams also fold in a
+    #: per-boot nonce, deliberately not reproducible across restarts)
     seed: int

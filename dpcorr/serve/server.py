@@ -13,17 +13,38 @@ Admission order is the privacy invariant: the ledger is charged (and
 durably persisted) BEFORE the request is enqueued, so no query ever
 computes without its spend on disk; a crash after charge and before
 answer wastes budget rather than leaking it (ledger module docstring).
+The one exception is a request the enqueue itself refuses (queue
+backpressure / closed coalescer): no kernel ran and nothing was
+released, so the charge is reversed before the refusal propagates —
+overload sheds load, it must not drain budgets.
 
-Request noise streams extend the repo's key-tree contract (utils.rng):
-``master(server seed) → fold_in(request seed)`` — a request that pins
-``seed`` is exactly replayable against the same server seed, and the
-bit-identity tests recompute it the same way.
+Request noise streams extend the repo's key-tree contract (utils.rng)
+with two disjoint named subtrees under the server's master key. The
+privacy requirement is that two admissions NEVER share a noise stream
+unless they are the same query — a repeated stream over different data
+lets a client difference the Laplace noise away, voiding the ledger's
+composition accounting:
+
+- **pinned** (``req.seed`` set): ``stream(master, "serve/pinned") →
+  fold_in(seed) → fold_in(sha256(request content))`` — see
+  :func:`pinned_request_key`, which the bit-identity tests and
+  ``benchmarks/serve_load.py`` recompute. Replaying the same seed with
+  the SAME request is exactly reproducible; the same seed over
+  different data lands on an independent stream.
+- **assigned** (``req.seed is None``): ``stream(master, "serve/boot")
+  → fold_in(boot nonce) → fold_in(admission counter)``. The nonce is
+  drawn fresh from the OS CSPRNG at every server construction, so
+  counter reuse across restarts (the counter restarts at 0; the ledger
+  does not) cannot repeat a stream, and assigned streams can never
+  collide with the pinned subtree.
 """
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
+import secrets
 import threading
 from concurrent.futures import Future
 
@@ -37,6 +58,37 @@ from dpcorr.serve.stats import ServeStats
 from dpcorr.utils import rng
 
 
+def request_digest_words(req: EstimateRequest) -> tuple[int, ...]:
+    """The request's kernel inputs as eight 31-bit ``fold_in`` words —
+    a 248-bit SHA-256 content binding, far past birthday range for any
+    realistic query volume. Everything the noise touches is digested
+    (family, ε, α, normalise, the data vectors); party names are not,
+    as they only route budget accounting."""
+    h = hashlib.sha256()
+    h.update(req.family.encode())
+    h.update(np.asarray([req.eps1, req.eps2, req.alpha],
+                        dtype=np.float64).tobytes())
+    h.update(b"\x01" if req.normalise else b"\x00")
+    h.update(req.x.tobytes())
+    h.update(req.y.tobytes())
+    d = h.digest()
+    return tuple(int.from_bytes(d[4 * i:4 * i + 4], "big") & 0x7FFFFFFF
+                 for i in range(8))
+
+
+def pinned_request_key(master, req: EstimateRequest, seed: int):
+    """Noise key for a client-pinned seed: the seed folded into the
+    dedicated pinned subtree, then bound to the request content, so a
+    seed replayed over different data yields an independent stream (the
+    anti-differencing guarantee) while an identical request stays
+    exactly reproducible. This is the single derivation the server, the
+    bit-identity tests and the load generator all share."""
+    key = rng.design_key(rng.stream(master, "serve/pinned"), seed)
+    for w in request_digest_words(req):
+        key = rng.design_key(key, w)
+    return key
+
+
 class DpcorrServer:
     """In-process serving stack. Thread-safe; close() drains."""
 
@@ -46,13 +98,13 @@ class DpcorrServer:
                  seed: int = rng.MASTER_SEED,
                  max_batch: int = 64, max_delay_s: float = 0.005,
                  max_queue: int = 4096, shard: str = "auto",
-                 batch_mode: str = "exact"):
+                 batch_mode: str = "exact", max_kernels: int = 128):
         self.seed = seed
         self.stats = ServeStats()
         self.ledger = PrivacyLedger(budget, path=ledger_path,
                                     per_party=per_party_budget)
         self.cache = KernelCache(stats=self.stats, shard=shard,
-                                 mode=batch_mode)
+                                 mode=batch_mode, max_kernels=max_kernels)
         self.coalescer = Coalescer(self.cache, self.stats,
                                    max_batch=max_batch,
                                    max_delay_s=max_delay_s,
@@ -60,13 +112,26 @@ class DpcorrServer:
         self._master = None
         self._master_lock = threading.Lock()
         self._req_counter = itertools.count()
+        # fresh per construction: makes counter-assigned streams unique
+        # across restarts even though the counter itself restarts at 0
+        # (module docstring — the ledger persists, the counter must not
+        # need to)
+        self._boot_nonce = secrets.randbits(31)
 
-    def _request_key(self, seed: int):
+    def _master_locked(self):
         with self._master_lock:
             if self._master is None:
                 # deferred: no jax touch until the first admission
                 self._master = rng.master_key(self.seed)
-        return rng.design_key(self._master, seed)
+        return self._master
+
+    def _request_key(self, req: EstimateRequest, seed: int):
+        master = self._master_locked()
+        if req.seed is not None:
+            return pinned_request_key(master, req, seed)
+        return rng.design_key(
+            rng.design_key(rng.stream(master, "serve/boot"),
+                           self._boot_nonce), seed)
 
     # -- API -------------------------------------------------------------
     def submit(self, req: EstimateRequest) -> Future:
@@ -74,14 +139,22 @@ class DpcorrServer:
         BudgetExceededError), then enqueue (may raise
         ServerOverloadedError). Returns a Future[EstimateResponse]."""
         seed = req.seed if req.seed is not None else next(self._req_counter)
-        key = self._request_key(seed)
+        key = self._request_key(req, seed)
         try:
-            self.ledger.charge_request(req)
+            charges = self.ledger.charge_request(req)
         except BudgetExceededError:
             self.stats.refused_budget()
             raise
+        try:
+            fut = self.coalescer.submit(req, key, seed)
+        except Exception:
+            # the enqueue refused (backpressure / closed): no kernel ran
+            # and nothing was released, so reversing the charge is safe —
+            # shed load must not consume ε (ledger.refund)
+            self.ledger.refund(charges)
+            raise
         self.stats.admitted()
-        return self.coalescer.submit(req, key, seed)
+        return fut
 
     def estimate(self, req: EstimateRequest,
                  timeout: float | None = 60.0) -> EstimateResponse:

@@ -55,6 +55,7 @@ class ServeStats:
         self.flush_size_max = 0
         self.kernel_compiles = 0
         self.kernel_hits = 0
+        self.kernel_cache_size = 0
         self.queue_depth = 0
         self._latencies: deque[float] = deque(maxlen=reservoir)
 
@@ -95,6 +96,12 @@ class ServeStats:
         with self._lock:
             self.queue_depth = depth
 
+    def set_kernel_cache_size(self, n: int) -> None:
+        """Gauge: live compiled kernels held by the LRU-bounded cache
+        (serve.kernels) — lets an operator see eviction pressure."""
+        with self._lock:
+            self.kernel_cache_size = n
+
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
             self._latencies.append(float(seconds))
@@ -125,6 +132,7 @@ class ServeStats:
                 "flush_size_max": self.flush_size_max,
                 "kernel_compiles": self.kernel_compiles,
                 "kernel_hits": self.kernel_hits,
+                "kernel_cache_size": self.kernel_cache_size,
                 "queue_depth": self.queue_depth,
                 "latency_s": percentiles(self._latencies),
             }

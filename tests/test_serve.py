@@ -28,6 +28,7 @@ from dpcorr.serve import (
     ServeStats,
     ServerOverloadedError,
     make_http_server,
+    pinned_request_key,
     request_charges,
 )
 from dpcorr.serve.kernels import pad_batch
@@ -45,10 +46,11 @@ def _mk_req(n=96, family="ni_sign", seed=None, i=0, **kw):
 
 def _direct(server, req):
     """The reference answer: the plain jitted single-request program on
-    the request's key-tree address (server-seed → fold_in(request seed))."""
+    the request's key-tree address (the pinned subtree — seed folded
+    into stream(master, "serve/pinned"), then content-bound)."""
     single = serving_entry(req.family, req.eps1, req.eps2,
                            alpha=req.alpha, normalise=req.normalise)
-    key = rng.design_key(rng.master_key(server.seed), req.seed)
+    key = pinned_request_key(rng.master_key(server.seed), req, req.seed)
     return tuple(float(v) for v in jax.jit(single)(key, req.x, req.y))
 
 
@@ -228,6 +230,35 @@ def test_kernel_cache_rejects_bad_modes():
         KernelCache(shard="maybe")
     with pytest.raises(ValueError, match="mode"):
         KernelCache(mode="fast")
+    with pytest.raises(ValueError, match="max_kernels"):
+        KernelCache(max_kernels=0)
+
+
+def test_kernel_cache_lru_bounded():
+    """Signatures include the exact n, so an n-sweeping client would
+    grow the cache without bound; the LRU cap holds it at max_kernels
+    and the live count is a stats gauge (REVIEW: low)."""
+    cache = KernelCache(shard="off", max_kernels=2)
+    kks = [kernel_key(_mk_req(n=64 + j)) for j in range(3)]
+    for kk in kks:
+        cache.get(kk, 4)
+    assert len(cache._fns) == 2
+    assert cache.stats.kernel_cache_size == 2
+    # kks[0] was evicted (least recently used) → re-get recompiles,
+    # displacing kks[1]; cache is now [kks[2], kks[0]]
+    compiles = cache.stats.kernel_compiles
+    cache.get(kks[0], 4)
+    assert cache.stats.kernel_compiles == compiles + 1
+    assert (kks[1], 4, 1) not in cache._fns
+    # a hit refreshes recency: touching kks[2] makes kks[0] the LRU,
+    # so the next insert evicts kks[0] and keeps kks[2]
+    hits = cache.stats.kernel_hits
+    cache.get(kks[2], 4)
+    assert cache.stats.kernel_hits == hits + 1
+    cache.get(kernel_key(_mk_req(n=200)), 4)
+    assert (kks[0], 4, 1) not in cache._fns
+    assert (kks[2], 4, 1) in cache._fns
+    assert cache.stats.snapshot()["kernel_cache_size"] == 2
 
 
 @pytest.mark.parametrize("family", FAMILIES)
@@ -409,6 +440,47 @@ def test_server_ledger_survives_restart(tmp_path):
         srv2.close()
 
 
+def test_overload_shed_refunds_budget():
+    """A 429 must not consume ε: the charge lands before the enqueue,
+    so a queue-refused request gets its spend reversed — retrying
+    clients under sustained overload can't drain budgets with zero
+    queries served (REVIEW: medium)."""
+    srv = DpcorrServer(budget=1e6, max_batch=1024, max_delay_s=30.0,
+                       max_queue=2, shard="off")
+    try:
+        futs = [srv.submit(_mk_req(seed=i)) for i in range(2)]
+        spent_before = srv.ledger.spent("party-x")
+        for _ in range(3):  # repeated sheds refund every time
+            with pytest.raises(ServerOverloadedError):
+                srv.submit(_mk_req(seed=99))
+        assert srv.ledger.spent("party-x") == pytest.approx(spent_before)
+        assert srv.stats.requests_refused_overload == 3
+        # admitted counter counts only successfully enqueued requests
+        assert srv.stats.requests_total == 2
+    finally:
+        srv.close()
+    for f in futs:
+        f.result(timeout=60)
+
+
+def test_ledger_refund_reverses_and_clamps(tmp_path):
+    path = str(tmp_path / "ledger.json")
+    led = PrivacyLedger(budget=3.0, path=path)
+    led.charge({"a": 2.0, "b": 1.0})
+    led.refund({"a": 2.0})
+    assert led.spent("a") == pytest.approx(0.0)
+    assert led.spent("b") == pytest.approx(1.0)
+    # the reversal is persisted like a charge
+    led2 = PrivacyLedger(budget=3.0, path=path)
+    assert led2.spent("a") == pytest.approx(0.0)
+    # over-refund clamps at zero (errs toward privacy) and negative
+    # refunds are rejected outright
+    led.refund({"b": 5.0})
+    assert led.spent("b") == 0.0
+    with pytest.raises(ValueError, match="negative refund"):
+        led.refund({"a": -1.0})
+
+
 def test_coalescer_backpressure_sheds_load():
     # a delay window far longer than the test: nothing flushes while we
     # overfill the queue
@@ -436,6 +508,69 @@ def test_server_assigns_seeds_when_unpinned():
         assert r1.rho_hat != r2.rho_hat
     finally:
         srv.close()
+
+
+def test_assigned_streams_differ_across_restarts():
+    """The counter restarts at 0 on every boot while the ledger does
+    not — without the per-boot nonce the first unpinned query of every
+    incarnation would reuse one noise stream, letting a client
+    difference the noise away across restarts (REVIEW: high)."""
+    req = _mk_req(seed=None, i=0)
+    rhos = []
+    for _ in range(2):  # two "boots" of the same configuration
+        srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+        try:
+            r = srv.estimate(req)
+            assert r.seed == 0  # same counter seed both times ...
+            rhos.append(r.rho_hat)
+        finally:
+            srv.close()
+    assert rhos[0] != rhos[1]  # ... but independent noise streams
+
+
+def test_pinned_seed_bound_to_request_content():
+    """A repeated pinned seed over DIFFERENT data must draw independent
+    noise (no differencing), while the identical request stays exactly
+    replayable — across server incarnations."""
+    a, b = _mk_req(seed=7, i=0), _mk_req(seed=7, i=1)
+    # the two derived keys differ although seed and bucket coincide
+    master = rng.master_key(rng.MASTER_SEED)
+    ka = pinned_request_key(master, a, 7)
+    kb = pinned_request_key(master, b, 7)
+    assert not np.array_equal(jax.random.key_data(ka),
+                              jax.random.key_data(kb))
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+    try:
+        ra, rb = srv.estimate(a), srv.estimate(b)
+    finally:
+        srv.close()
+    # noise independence: identical seed, different data → the noisy
+    # answers are not related by the data-only difference
+    assert ra.rho_hat != rb.rho_hat
+    # exact replay of the identical request survives a restart
+    srv2 = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+    try:
+        ra2 = srv2.estimate(a)
+    finally:
+        srv2.close()
+    assert (ra.rho_hat, ra.ci_low, ra.ci_high) == \
+        (ra2.rho_hat, ra2.ci_low, ra2.ci_high)
+
+
+def test_pinned_and_assigned_subtrees_disjoint():
+    """A client pinning seed k and the server assigning counter seed k
+    must not share a stream: the subtrees are separated by named-stream
+    tags under the master key."""
+    req = _mk_req(seed=3, i=0)
+    master = rng.master_key(rng.MASTER_SEED)
+    pinned = pinned_request_key(master, req, 3)
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+    try:
+        unpinned = srv._request_key(_mk_req(seed=None, i=0), 3)
+    finally:
+        srv.close()
+    assert not np.array_equal(jax.random.key_data(pinned),
+                              jax.random.key_data(unpinned))
 
 
 # ----------------------------------------------------------------- HTTP ----

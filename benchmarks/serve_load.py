@@ -12,7 +12,12 @@ the three serving invariants end to end:
    (estimators.registry contract);
 3. **ledger refusal** — with the spend known in advance, the first
    query that would overdraw a party's ε budget is refused and every
-   earlier one admitted.
+   earlier one admitted;
+4. **metrics consistency** (ISSUE 2) — the Prometheus ``GET /metrics``
+   exposition scraped over real HTTP agrees numerically with the
+   ``GET /stats`` snapshot (both views read the same obs registry);
+5. **tracing** (with ``--trace``) — the span JSONL log parses strictly
+   and is non-empty, the same gate CI applies to the uploaded artifact.
 
 Prints one JSON document: serving stats snapshot + latency percentiles
 + throughput + the verification verdicts. Exit code 1 if any invariant
@@ -57,6 +62,10 @@ def main() -> int:
                          "then verifies rho_hat only")
     ap.add_argument("--platform", default="cpu", choices=["cpu", "tpu"])
     ap.add_argument("--out-json", dest="out_json", default=None)
+    ap.add_argument("--trace", default=None,
+                    help="span-trace JSONL path: enables the obs tracer "
+                         "for the run and gates on a non-empty, "
+                         "parseable span log (the CI artifact check)")
     args = ap.parse_args()
 
     import jax
@@ -74,6 +83,11 @@ def main() -> int:
     )
     from dpcorr.serve.ledger import BudgetExceededError, request_charges
     from dpcorr.utils import rng
+
+    if args.trace:
+        from dpcorr.obs import trace as obs_trace
+
+        obs_trace.configure(args.trace)
 
     # Budget sized so the load itself always fits: the refusal probe
     # below runs against dedicated parties with a tiny budget instead.
@@ -128,6 +142,58 @@ def main() -> int:
     stats = cli.stats()
     fill = stats["batch_fill_ratio"]
 
+    # -- single source of truth: /metrics must agree with /stats ---------
+    # (ISSUE 2 acceptance) scrape the real HTTP endpoints — same server,
+    # same registry — and cross-check counter/gauge values numerically.
+    import urllib.request
+
+    from dpcorr.obs import parse_exposition
+    from dpcorr.serve.server import make_http_server
+
+    httpd = make_http_server(srv, port=0)
+    http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    http_thread.start()
+    port = httpd.server_address[1]
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics") as resp:
+        metrics_text = resp.read().decode()
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats") as resp:
+        stats_http = json.load(resp)
+    httpd.shutdown()
+    series = parse_exposition(metrics_text)
+    completed = (stats_http["batched_requests"]
+                 + stats_http["unbatched_requests"])
+    expected = {
+        "dpcorr_serve_requests_total": stats_http["requests_total"],
+        "dpcorr_serve_batches_flushed_total":
+            stats_http["batches_flushed"],
+        'dpcorr_serve_requests_completed_total{mode="batched"}':
+            stats_http["batched_requests"],
+        'dpcorr_serve_requests_completed_total{mode="unbatched"}':
+            stats_http["unbatched_requests"],
+        "dpcorr_serve_latency_seconds_count": completed,
+        "dpcorr_serve_kernel_compiles_total":
+            stats_http["kernel_compiles"],
+        "dpcorr_serve_kernel_cache_hits_total":
+            stats_http["kernel_hits"],
+        "dpcorr_serve_kernel_cache_size":
+            stats_http["kernel_cache_size"],
+        "dpcorr_serve_queue_depth": stats_http["queue_depth"],
+    }
+    # a zero-valued labelled child may legitimately be absent from the
+    # exposition (never incremented), hence the 0.0 default
+    metrics_mismatches = {
+        k: {"metrics": series.get(k, 0.0), "stats": float(want)}
+        for k, want in expected.items()
+        if series.get(k, 0.0) != float(want)}
+
+    trace_spans = None
+    if args.trace:
+        from dpcorr.obs import read_spans
+
+        # strict parse: an unparseable line raises and fails the run
+        trace_spans = len(read_spans(args.trace))
+
     # -- invariant 2: bit-identity on a sample of responses --------------
     single = jax.jit(serving_entry(args.family, args.eps1, args.eps2,
                                    alpha=0.05, normalise=True))
@@ -172,7 +238,10 @@ def main() -> int:
         "coalesced": fill > 1.0,
         "bit_identical": checked > 0 and mismatches == 0,
         "ledger_refusal": admitted == 3 and refused_at == 3,
+        "metrics_consistent": not metrics_mismatches,
     }
+    if args.trace:
+        ok["traced"] = trace_spans is not None and trace_spans > 0
     out = {
         "metric": "serve_load",
         "requests": args.requests,
@@ -186,6 +255,9 @@ def main() -> int:
         "bit_checked": checked,
         "bit_mismatches": mismatches,
         "refusal_probe": {"admitted": admitted, "refused_at": refused_at},
+        "metrics_mismatches": metrics_mismatches,
+        "trace": args.trace,
+        "trace_spans": trace_spans,
         "ok": ok,
         "errors": errors[:5],
         "stats": stats,

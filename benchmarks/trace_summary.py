@@ -1,9 +1,17 @@
-"""Summarize a ``jax.profiler`` trace directory into an op-time table.
+"""Summarize a trace — a ``jax.profiler`` dir OR an obs span JSONL log.
 
-Turns the Perfetto-style ``*.trace.json.gz`` that ``jax.profiler.trace``
-writes (under ``<dir>/plugins/profile/<ts>/``) into the numbers
-PERFORMANCE.md §roofline cites: total wall window, device-resident time
-of the jit'd program, and the top fusions by accumulated duration.
+Two input shapes, one CLI:
+
+- a profiler **directory**: turns the Perfetto-style ``*.trace.json.gz``
+  that ``jax.profiler.trace`` writes (under
+  ``<dir>/plugins/profile/<ts>/``) into the numbers PERFORMANCE.md
+  §roofline cites — total wall window, device-resident time of the
+  jit'd program, and the top fusions by accumulated duration;
+- a span **JSONL file** (``dpcorr.obs.trace`` output, e.g. ``serve
+  --trace``): per-span-name count / total / p50 / p99 durations via
+  :func:`summarize_spans`, using the serving stack's own nearest-rank
+  percentile implementation so a p99 here means the same thing as the
+  ``/stats`` p99.
 
 The reference has no profiling at all (SURVEY.md §5 "Tracing/profiling:
 absent"); this is the TPU build's observability half of that subsystem —
@@ -13,6 +21,7 @@ Usage::
 
     python -m benchmarks.trace_summary benchmarks/results/trace_r04
     python -m benchmarks.trace_summary <dir> --top 10 --json
+    python -m benchmarks.trace_summary /tmp/serve_spans.jsonl --json
 
 Heuristics (kept deliberately simple and assert-guarded): JAX emits the
 compiled program as a ``jit_<name>(...)`` slice with XLA ops
@@ -89,13 +98,57 @@ def summarize_trace(trace_dir: str, top: int = 8) -> dict:
     }
 
 
+def summarize_spans(path_or_spans, top: int = 0) -> dict:
+    """Reduce an obs span JSONL log (or pre-loaded span list) to
+    per-span-name aggregates: {spans, names: {name: {count, total_s,
+    p50_s, p99_s}}}, names ordered by total time descending (all of
+    them unless ``top`` truncates). Strict input: a bad line raises
+    (obs.trace.read_spans), matching the CI artifact gate."""
+    from dpcorr.obs.trace import read_spans
+    from dpcorr.serve.stats import percentiles
+
+    spans = (read_spans(path_or_spans) if isinstance(path_or_spans, str)
+             else path_or_spans)
+    by_name: dict[str, list[float]] = collections.defaultdict(list)
+    for sp in spans:
+        by_name[sp["name"]].append(float(sp["dur_s"]))
+    rows = []
+    for name, durs in by_name.items():
+        pct = percentiles(durs)
+        rows.append((name, {
+            "count": len(durs),
+            "total_s": round(sum(durs), 6),
+            "p50_s": round(pct["p50"], 6),
+            "p99_s": round(pct["p99"], 6),
+        }))
+    rows.sort(key=lambda kv: kv[1]["total_s"], reverse=True)
+    if top:
+        rows = rows[:top]
+    return {"spans": len(spans), "names": dict(rows)}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace_dir")
+    ap.add_argument("trace_dir",
+                    help="jax.profiler trace dir, or an obs span JSONL "
+                         "file (dpcorr serve --trace)")
     ap.add_argument("--top", type=int, default=8)
     ap.add_argument("--json", action="store_true",
                     help="print the full summary as one JSON object")
     args = ap.parse_args()
+
+    if os.path.isfile(args.trace_dir):
+        s = summarize_spans(args.trace_dir)
+        if args.json:
+            print(json.dumps(s))
+            return
+        print(f"{s['spans']} spans")
+        print(f"{'name':<24} {'count':>7} {'total_s':>10} "
+              f"{'p50_s':>10} {'p99_s':>10}")
+        for name, r in s["names"].items():
+            print(f"{name:<24} {r['count']:>7} {r['total_s']:>10.4f} "
+                  f"{r['p50_s']:>10.6f} {r['p99_s']:>10.6f}")
+        return
 
     s = summarize_trace(args.trace_dir, args.top)
     if args.json:

@@ -13,6 +13,13 @@ Replaces the reference's "source the script" workflow (README.md:28-46):
                   clients, compile cache, queue markers; no reference
                   analogue — SURVEY.md §5 failure detection is absent
                   there)
+- ``serve``       online micro-batched DP-correlation service
+                  (docs/SERVING.md)
+- ``obs``         telemetry tooling (docs/OBSERVABILITY.md): ``obs
+                  budget`` replays a ledger audit trail into the
+                  per-party ε-spend timeline; ``obs chrome`` converts a
+                  span JSONL log to Chrome trace-event format for
+                  Perfetto
 
 Grids persist per-design-point ``.npz`` + parquet tables into ``--out`` and
 resume from them (the reference only saves one blob at the end).
@@ -206,22 +213,65 @@ def cmd_hrs_sweep(args):
 def cmd_serve(args):
     """Online serving: micro-batched DP-correlation queries behind a
     per-party ε-budget ledger (dpcorr.serve; docs/SERVING.md)."""
+    from dpcorr.obs import trace as obs_trace
     from dpcorr.serve import DpcorrServer, serve_http
 
+    if args.trace:
+        # the process tracer, so grid/profiling spans from in-server
+        # kernels land in the same log as the serve lifecycle spans
+        obs_trace.configure(args.trace)
     server = DpcorrServer(
         budget=args.budget, ledger_path=args.ledger,
         seed=args.seed, max_batch=args.max_batch,
         max_delay_s=args.max_delay_ms / 1000.0,
         max_queue=args.max_queue, shard=args.shard,
-        batch_mode=args.batch_mode, max_kernels=args.max_kernels)
+        batch_mode=args.batch_mode, max_kernels=args.max_kernels,
+        audit=args.audit)
     print(json.dumps({"serving": {"host": args.host, "port": args.port,
                                   "budget": args.budget,
                                   "ledger": args.ledger,
                                   "max_batch": args.max_batch,
                                   "max_delay_ms": args.max_delay_ms,
-                                  "batch_mode": args.batch_mode}}),
+                                  "batch_mode": args.batch_mode,
+                                  "trace": args.trace,
+                                  "audit": args.audit}}),
           flush=True)
     serve_http(server, host=args.host, port=args.port)
+
+
+def cmd_obs_budget(args):
+    """Replay a privacy-budget audit trail (docs/OBSERVABILITY.md):
+    per-event ε timeline plus the replayed per-party spend table, which
+    must equal the ledger snapshot's ``spent`` values."""
+    from dpcorr.obs import read_events, replay, timeline
+
+    events = read_events(args.audit)
+    rows = timeline(events, party=args.party)
+    totals = replay(events)
+    if args.party is not None:
+        totals = {args.party: totals.get(args.party, 0.0)}
+    if args.json:
+        print(json.dumps({"events": len(events), "timeline": rows,
+                          "spent": totals}, indent=2))
+        return
+    for r in rows:
+        after = " ".join(f"{p}={s:.6g}"
+                         for p, s in sorted(r["spent_after"].items()))
+        print(f"[{r['seq']:6d}] {r['kind']:<8} "
+              f"trace={r['trace_id'] or '-':<17} {after}")
+    print(f"{len(events)} events; replayed spend:")
+    for p, s in sorted(totals.items()):
+        print(f"  {p}: {s:.6g}")
+
+
+def cmd_obs_chrome(args):
+    """Convert a span JSONL log to Chrome trace-event JSON (open in
+    Perfetto / chrome://tracing)."""
+    from dpcorr.obs import read_spans, write_chrome_trace
+
+    n = len(read_spans(args.trace))
+    write_chrome_trace(args.trace, args.out)
+    print(f"wrote {args.out} ({n} spans)")
 
 
 def cmd_doctor(args):
@@ -299,7 +349,33 @@ def main(argv=None):
                           "otherwise grow compilations without limit)")
     ps_.add_argument("--seed", type=int, default=2025)
     ps_.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+    ps_.add_argument("--trace", default=None,
+                     help="span-trace JSONL path (docs/OBSERVABILITY.md); "
+                          "also settable via DPCORR_TRACE")
+    ps_.add_argument("--audit", default=None,
+                     help="privacy-budget audit-trail JSONL path; replay "
+                          "it with `dpcorr obs budget --audit PATH`")
     ps_.set_defaults(fn=cmd_serve)
+
+    po_ = sub.add_parser("obs", help="telemetry tooling: audit-trail "
+                         "replay and Chrome-trace export "
+                         "(docs/OBSERVABILITY.md)")
+    obs_sub = po_.add_subparsers(dest="obs_cmd", required=True)
+    pob = obs_sub.add_parser("budget", help="per-party ε-spend timeline "
+                             "replayed from a ledger audit trail")
+    pob.add_argument("--audit", required=True,
+                     help="audit-trail JSONL path (serve --audit)")
+    pob.add_argument("--party", default=None,
+                     help="restrict the timeline to one party")
+    pob.add_argument("--json", action="store_true")
+    pob.set_defaults(fn=cmd_obs_budget, platform=None, jax_free=True)
+    poc = obs_sub.add_parser("chrome", help="convert a span JSONL log "
+                             "to Chrome trace-event JSON (Perfetto)")
+    poc.add_argument("--trace", required=True,
+                     help="span-trace JSONL path (serve --trace)")
+    poc.add_argument("--out", required=True,
+                     help="output Chrome trace JSON path")
+    poc.set_defaults(fn=cmd_obs_chrome, platform=None, jax_free=True)
     backends_by_cmd = {
         "grid": ("local", "sharded", "bucketed", "bucketed-sharded"),
         "grid-subg": ("local", "sharded", "bucketed", "bucketed-sharded"),

@@ -28,6 +28,7 @@ import numpy as np
 import pandas as pd
 
 from dpcorr import sim as sim_mod
+from dpcorr.obs import trace as obs_trace
 from dpcorr.sim import SimConfig
 from dpcorr.utils import rng
 
@@ -272,6 +273,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
     import jax.numpy as jnp
 
     details, timings, failures = {}, [], []
+    tr = obs_trace.tracer()
 
     merged = gcfg.bucket_merge == "eps"
 
@@ -326,6 +328,10 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
     for _, grp in design.groupby(bucket_keys, sort=False):
         rows = list(grp.itertuples(index=False))
         t0 = time.perf_counter()
+        # one span per bucket compile+launch (parents under grid.run via
+        # the thread-local stack; a no-op null span when tracing is off)
+        dsp = tr.start_span("grid.dispatch", n=int(rows[0].n),
+                            points=len(rows))
         # Same fail-loud-per-point semantics as the local backend: a broken
         # bucket is recorded and the remaining buckets still run; one
         # aggregated RuntimeError is raised by run_grid at the end.
@@ -409,7 +415,11 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                       rows[0].n, rows[0].eps1, rows[0].eps2, len(rows), e)
             failures.extend((int(r.i), e) for r in rows
                             if int(r.i) not in details)
+            dsp.set(error=type(e).__name__)
+            dsp.end()
             continue
+        dsp.set(points_run=len(to_run), fused=bool(fused))
+        dsp.end()
         pending.append((rows, to_run, raw, stamps, paths, fused, cfg,
                         mk_stamps, time.perf_counter() - t0))
 
@@ -423,6 +433,8 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
     for (rows, to_run, raw, stamps, paths, fused, cfg, mk_stamps,
          dispatch_s) in pending:
         t0 = time.perf_counter()
+        fsp = tr.start_span("grid.fetch", n=int(rows[0].n),
+                            points=len(rows), points_run=len(to_run))
         try:
             if to_run:
                 try:
@@ -472,7 +484,10 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                       rows[0].n, rows[0].eps1, rows[0].eps2, len(rows), e)
             failures.extend((int(r.i), e) for r in rows
                             if int(r.i) not in details)
+            fsp.set(error=type(e).__name__)
+            fsp.end()
             continue
+        fsp.end()
         fetch_s = time.perf_counter() - t0
         ran = len(to_run)
         total_ran += ran
@@ -527,59 +542,76 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
     if out_dir:
         out_dir.mkdir(parents=True, exist_ok=True)
 
-    if gcfg.backend in ("bucketed", "bucketed-sharded"):
-        by_i, timings, failures = _run_grid_bucketed(gcfg, design, master,
-                                                     out_dir, mesh=mesh)
+    # the root span of one grid execution: grid.dispatch / grid.fetch /
+    # grid.point children parent under it via the thread-local stack
+    # (dpcorr.obs.trace; a no-op when no tracer is configured)
+    tr = obs_trace.tracer()
+    with tr.span("grid.run", backend=gcfg.backend, points=len(design),
+                 b=gcfg.b):
+        if gcfg.backend in ("bucketed", "bucketed-sharded"):
+            by_i, timings, failures = _run_grid_bucketed(
+                gcfg, design, master, out_dir, mesh=mesh)
+            _raise_if_failed(failures, len(design))
+            detail_all = _assemble_details(design, by_i, gcfg.b)
+            summ_all = summarize_grid(detail_all)
+            if out_dir:
+                _persist_tables(out_dir, detail_all, summ_all)
+            return GridResult(detail_all, summ_all, pd.DataFrame(timings))
+
+        details, timings, failures = [], [], []
+        for row in design.itertuples(index=False):
+            i = int(row.i)
+            path = _design_path(out_dir, i) if out_dir else None
+            t0 = time.perf_counter()
+            psp = tr.start_span("grid.point", i=i, n=int(row.n),
+                                rho=float(row.rho))
+            try:
+                cfg = gcfg.sim_config(row._asdict())
+                # Cache entries are valid only for the exact SimConfig
+                # (and PRNG impl) that produced them; mismatch = miss.
+                stamp = _stamp(cfg)
+                detail = _load_cached(path, gcfg.resume, stamp)
+                cached = detail is not None
+                if not cached:
+                    res = _run_point(gcfg, cfg, rng.design_key(master, i),
+                                     mesh)
+                    detail = {k: np.asarray(v)
+                              for k, v in res.detail.items()}
+                    if path is not None:
+                        np.savez(path, config_stamp=stamp, **detail)
+            except Exception as e:  # fail loudly per point (SURVEY.md §5)
+                log.error("design point %d (n=%d rho=%.2f eps=(%.2f,%.2f))"
+                          " failed: %s",
+                          i, row.n, row.rho, row.eps1, row.eps2, e)
+                failures.append((i, e))
+                psp.set(error=type(e).__name__)
+                psp.end()
+                continue
+            psp.set(cached=cached)
+            psp.end()
+            dt = time.perf_counter() - t0
+            timings.append({"i": i, "n": row.n, "rho": row.rho,
+                            "eps1": row.eps1, "eps2": row.eps2,
+                            "seconds": dt, "cached": cached,
+                            "reps_per_sec": (np.nan if cached
+                                             else gcfg.b / dt)})
+
+            frame = pd.DataFrame(detail)
+            frame.insert(0, "repl", np.arange(1, gcfg.b + 1))
+            # metadata join (vert-cor.R:557-565)
+            frame["n"] = row.n
+            frame["rho_true"] = row.rho
+            frame["eps1"] = row.eps1
+            frame["eps2"] = row.eps2
+            details.append(frame)
+
         _raise_if_failed(failures, len(design))
-        detail_all = _assemble_details(design, by_i, gcfg.b)
+
+        detail_all = pd.concat(details, ignore_index=True)
         summ_all = summarize_grid(detail_all)
         if out_dir:
             _persist_tables(out_dir, detail_all, summ_all)
         return GridResult(detail_all, summ_all, pd.DataFrame(timings))
-
-    details, timings, failures = [], [], []
-    for row in design.itertuples(index=False):
-        i = int(row.i)
-        path = _design_path(out_dir, i) if out_dir else None
-        t0 = time.perf_counter()
-        try:
-            cfg = gcfg.sim_config(row._asdict())
-            # Cache entries are valid only for the exact SimConfig (and
-            # PRNG impl) that produced them; mismatch = miss.
-            stamp = _stamp(cfg)
-            detail = _load_cached(path, gcfg.resume, stamp)
-            cached = detail is not None
-            if not cached:
-                res = _run_point(gcfg, cfg, rng.design_key(master, i), mesh)
-                detail = {k: np.asarray(v) for k, v in res.detail.items()}
-                if path is not None:
-                    np.savez(path, config_stamp=stamp, **detail)
-        except Exception as e:  # fail loudly per design point (SURVEY.md §5)
-            log.error("design point %d (n=%d rho=%.2f eps=(%.2f,%.2f)) failed: %s",
-                      i, row.n, row.rho, row.eps1, row.eps2, e)
-            failures.append((i, e))
-            continue
-        dt = time.perf_counter() - t0
-        timings.append({"i": i, "n": row.n, "rho": row.rho, "eps1": row.eps1,
-                        "eps2": row.eps2, "seconds": dt, "cached": cached,
-                        "reps_per_sec": np.nan if cached else gcfg.b / dt})
-
-        frame = pd.DataFrame(detail)
-        frame.insert(0, "repl", np.arange(1, gcfg.b + 1))
-        # metadata join (vert-cor.R:557-565)
-        frame["n"] = row.n
-        frame["rho_true"] = row.rho
-        frame["eps1"] = row.eps1
-        frame["eps2"] = row.eps2
-        details.append(frame)
-
-    _raise_if_failed(failures, len(design))
-
-    detail_all = pd.concat(details, ignore_index=True)
-    summ_all = summarize_grid(detail_all)
-    if out_dir:
-        _persist_tables(out_dir, detail_all, summ_all)
-    return GridResult(detail_all, summ_all, pd.DataFrame(timings))
 
 
 def _persist_tables(out_dir: Path, detail_all: pd.DataFrame,

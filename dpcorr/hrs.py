@@ -35,6 +35,7 @@ import pandas as pd
 
 from dpcorr.io.rds import read_rds_table
 from dpcorr.models.estimators import ci_int_subg, correlation_ni_subg
+from dpcorr.obs import trace as obs_trace
 from dpcorr.ops.lambdas import lambda_from_priv, lambda_receiver_from_noise
 from dpcorr.ops.standardize import dp_sd, standardize_dp
 from dpcorr.utils import rng
@@ -282,9 +283,16 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
     warn_f32_geometry_band_once([(float(e), float(e)) for e in eps_grid],
                                 n=n, where="hrs.eps_sweep")
     k_pad = k_pad_for(n, [float(e) * float(e) for e in eps_grid])
+    # span model mirrors the grid driver's: one hrs.eps_sweep root, a
+    # dispatch child per ε in phase 1 and a fetch child per ε in phase 2
+    # (explicit parent= so the two loops need no thread-local stack)
+    tr = obs_trace.tracer()
+    root = tr.start_span("hrs.eps_sweep", n=n, n_eps=len(eps_grid),
+                         reps=reps)
     pending = []
     for eps_idx, eps in enumerate(eps_grid):
         eps = float(eps)
+        dsp = tr.start_span("hrs.dispatch", parent=root, eps=eps)
         # per-(method, ε, rep) keys — the key-tree analogue of the
         # reference's seed formulas 10+37·rep+1000·eps_idx / 20+41·rep+...
         k_eps = rng.design_key(master, eps_idx)
@@ -301,11 +309,14 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
                               std.lam_bmi, jnp.float32(lam_recvs[eps_idx]),
                               jnp.float32(delta), cfg.mixquant_mode,
                               cfg.alpha))))
+        dsp.end()
 
     runs = []
     for eps, out in pending:
+        fsp = tr.start_span("hrs.fetch", parent=root, eps=eps)
         (ni_hat, ni_lo, ni_hi), (int_hat, int_lo, int_hi) = jax.tree.map(
             np.asarray, out)
+        fsp.end()
         for meth, hat, lo, hi in (("NI", ni_hat, ni_lo, ni_hi),
                                   ("INT", int_hat, int_lo, int_hi)):
             runs.append(pd.DataFrame({
@@ -328,6 +339,7 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
     }).reset_index()
     summ.attrs["runs"] = runs_df
     summ.attrs["rho_np"] = std.rho_np
+    root.end()
     return summ
 
 

@@ -1,0 +1,52 @@
+"""Unified telemetry layer (ISSUE 2): spans, metrics, budget audit.
+
+Before this package, the repo had three disjoint reporting paths — the
+serve-local ``ServeStats`` JSON blob, the grid driver's timings frame,
+and raw ``jax.profiler`` dumps — none of which could correlate a slow
+p99 with the compile storm or budget refusal that caused it. The obs
+package is the one spine they now share:
+
+- :mod:`trace`   — span tracer: context-manager API, trace/span IDs,
+  JSONL log, Chrome trace-event export (Perfetto-viewable). The serve
+  request lifecycle, the grid driver's dispatch/fetch phases and
+  ``hrs.eps_sweep`` are instrumented with it.
+- :mod:`metrics` — process-wide registry (counters, gauges, bucketed
+  histograms) with Prometheus text exposition; ``ServeStats``, the
+  kernel cache and the ledger publish through it, and the HTTP server
+  serves it at ``GET /metrics``.
+- :mod:`audit`   — the privacy-budget audit trail: every ledger
+  charge/refund/refusal as a structured event carrying the request's
+  trace ID; ``python -m dpcorr obs budget`` replays it into the
+  per-party ε-spend timeline.
+
+See docs/OBSERVABILITY.md for the span model, metric names and the
+audit-trail format.
+"""
+
+from dpcorr.obs.audit import (  # noqa: F401
+    AuditTrail,
+    read_events,
+    replay,
+    timeline,
+)
+from dpcorr.obs.metrics import (  # noqa: F401
+    CONTENT_TYPE,
+    LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+    parse_exposition,
+)
+from dpcorr.obs.trace import (  # noqa: F401
+    Span,
+    SpanContext,
+    Tracer,
+    configure,
+    current_span,
+    read_spans,
+    to_chrome_trace,
+    tracer,
+    write_chrome_trace,
+)

@@ -41,6 +41,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from dpcorr.obs import trace as obs_trace
 from dpcorr.serve.kernels import KernelCache
 from dpcorr.serve.request import (
     EstimateRequest,
@@ -62,16 +63,23 @@ class _Pending:
     seed: int
     future: Future
     t_enq: float
+    #: the request's root span (serve.request), opened on the client
+    #: thread at admission and ended here when the future resolves —
+    #: how one trace ID links admission to flush across threads. The
+    #: disabled tracer's null span when tracing is off.
+    span: object = obs_trace._NULL_SPAN
 
 
 class Coalescer:
     def __init__(self, cache: KernelCache, stats: ServeStats,
                  max_batch: int = 64, max_delay_s: float = 0.005,
-                 max_queue: int = 4096):
+                 max_queue: int = 4096,
+                 tracer: obs_trace.Tracer | None = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.cache = cache
         self.stats = stats
+        self.tracer = tracer if tracer is not None else obs_trace.tracer()
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
         self.max_queue = max_queue
@@ -86,10 +94,15 @@ class Coalescer:
         self._thread.start()
 
     # -- admission -------------------------------------------------------
-    def submit(self, req: EstimateRequest, key, seed: int) -> Future:
-        """Enqueue one admitted request; resolves to EstimateResponse."""
+    def submit(self, req: EstimateRequest, key, seed: int,
+               span=None) -> Future:
+        """Enqueue one admitted request; resolves to EstimateResponse.
+        ``span`` is the request's root span (or None/null when
+        untraced); it rides the queue so the flush thread can parent
+        its spans under the same trace ID."""
         fut: Future = Future()
-        p = _Pending(req, key, seed, fut, time.perf_counter())
+        p = _Pending(req, key, seed, fut, time.perf_counter(),
+                     span if span is not None else obs_trace._NULL_SPAN)
         with self._cond:
             if self._closed:
                 raise RuntimeError("coalescer is closed")
@@ -154,28 +167,46 @@ class Coalescer:
     # -- execution -------------------------------------------------------
     def _flush(self, group: list[_Pending]) -> None:
         """Run one flushed bucket: dispatch every exact-n subgroup, then
-        fetch (dispatch-ahead), resolving futures with responses."""
+        fetch (dispatch-ahead), resolving futures with responses.
+
+        Span model (docs/OBSERVABILITY.md): every rider gets its own
+        ``serve.flush`` span parented under its request's trace, so one
+        trace ID follows the request from admission into the launch
+        that served it; the physical launch itself is one
+        ``serve.kernel`` span (dispatch through fetch barrier) under
+        the first rider's flush span, carrying the batch size."""
         by_kernel: dict[tuple, list[_Pending]] = {}
         for p in group:
             by_kernel.setdefault(kernel_key(p.req), []).append(p)
 
         launches = []
         for kkey, ps in by_kernel.items():
+            fspans = [self.tracer.start_span(
+                "serve.flush", parent=p.span.context,
+                family=kkey.family, n=kkey.n, batch_size=len(ps))
+                for p in ps]
+            ksp = self.tracer.start_span(
+                "serve.kernel", parent=fspans[0],
+                family=kkey.family, n=kkey.n, batch_size=len(ps))
             try:
-                launches.append((kkey, ps, self._dispatch(kkey, ps)))
+                raw = self._dispatch(kkey, ps)
             except Exception:
                 # batched dispatch failed — degrade this subgroup
-                launches.append((kkey, ps, None))
+                raw = None
+                ksp.set(error="dispatch")
+            launches.append((kkey, ps, raw, fspans, ksp))
 
-        for kkey, ps, raw in launches:
+        for kkey, ps, raw, fspans, ksp in launches:
             batched = len(ps) > 1 and raw is not None
             if raw is not None:
                 try:
                     raw = tuple(np.asarray(a) for a in raw)  # fetch barrier
                 except Exception:
                     raw, batched = None, False
+                    ksp.set(error="fetch")
+            ksp.end()
             if raw is None:
-                self._flush_unbatched(kkey, ps)
+                self._flush_unbatched(kkey, ps, fspans)
                 continue
             self.stats.flushed(len(ps), batched=batched)
             t_done = time.perf_counter()
@@ -186,6 +217,13 @@ class Coalescer:
                     rho_hat=float(raw[0][j]), ci_low=float(raw[1][j]),
                     ci_high=float(raw[2][j]), batched=batched,
                     batch_size=len(ps), latency_s=lat, seed=p.seed))
+                fspans[j].set(batched=batched)
+                fspans[j].end()
+                # the respond point: the request's root span closes with
+                # its end-to-end latency
+                p.span.set(latency_s=lat, batch_size=len(ps),
+                           batched=batched)
+                p.span.end()
 
     def _dispatch(self, kkey, ps: list[_Pending]):
         """Launch one exact-n subgroup asynchronously (no fetch)."""
@@ -211,10 +249,13 @@ class Coalescer:
                                     np.stack([p.req.x]),
                                     np.stack([p.req.y]))
 
-    def _flush_unbatched(self, kkey, ps: list[_Pending]) -> None:
+    def _flush_unbatched(self, kkey, ps: list[_Pending],
+                         fspans=None) -> None:
         """Batch-path failure fallback: serve each rider individually;
         only requests that fail on their own fail."""
-        for p in ps:
+        for idx, p in enumerate(ps):
+            sp = fspans[idx] if fspans else obs_trace._NULL_SPAN
+            sp.set(degraded=True)
             try:
                 raw = self._run_direct(kkey, p)
                 self.stats.flushed(1, batched=False)
@@ -224,9 +265,16 @@ class Coalescer:
                     rho_hat=float(raw[0][0]), ci_low=float(raw[1][0]),
                     ci_high=float(raw[2][0]), batched=False,
                     batch_size=1, latency_s=lat, seed=p.seed))
+                sp.end()
+                p.span.set(latency_s=lat, batch_size=1, batched=False)
+                p.span.end()
             except Exception as e:
                 self.stats.failed()
                 p.future.set_exception(e)
+                sp.set(error=type(e).__name__)
+                sp.end()
+                p.span.set(error=type(e).__name__)
+                p.span.end()
 
     # -- lifecycle -------------------------------------------------------
     def close(self, timeout: float = 30.0) -> None:

@@ -30,6 +30,15 @@ budget, across restarts. This module is that invariant:
   :meth:`PrivacyLedger.refund` reverses a charge when the server can
   prove no kernel ran (the enqueue itself refused the request), so
   backpressure sheds load without consuming ε.
+- **Audit trail + metrics** (ISSUE 2): constructed with an
+  :class:`dpcorr.obs.AuditTrail`, every charge/refund/refusal is
+  appended as a structured event carrying the caller's trace ID —
+  ``python -m dpcorr obs budget`` replays the trail into this ledger's
+  spend table. Constructed with an obs registry, per-party spend and
+  the charge/refund/refusal totals are published as Prometheus series
+  next to the serving counters. Both are observers: the fsync-rename
+  snapshot stays the accounting source of truth, and the trail line is
+  written only after the charge is durably persisted.
 
 Thread-safe: one lock around check+spend+persist (the coalescer admits
 from many client threads).
@@ -42,6 +51,8 @@ import os
 import threading
 from typing import Mapping
 
+from dpcorr.obs.audit import AuditTrail
+from dpcorr.obs.metrics import Registry
 from dpcorr.serve.request import EstimateRequest
 
 _STATE_VERSION = 1
@@ -88,14 +99,26 @@ class PrivacyLedger:
     """
 
     def __init__(self, budget: float, path: str | None = None,
-                 per_party: Mapping[str, float] | None = None):
+                 per_party: Mapping[str, float] | None = None,
+                 audit: AuditTrail | None = None,
+                 registry: Registry | None = None):
         if budget <= 0.0:
             raise ValueError(f"budget must be positive, got {budget}")
         self.budget = float(budget)
         self.per_party = dict(per_party or {})
         self.path = path
+        self.audit = audit
         self._lock = threading.Lock()
         self._spent: dict[str, float] = {}
+        self._events = self._spent_gauge = None
+        if registry is not None:
+            self._events = registry.counter(
+                "dpcorr_ledger_events_total",
+                "Ledger mutations by kind", labelnames=("kind",))
+            self._spent_gauge = registry.gauge(
+                "dpcorr_ledger_spent_eps",
+                "Cumulative per-party eps spend under basic composition",
+                labelnames=("party",))
         if path and os.path.exists(path):
             with open(path) as f:
                 state = json.load(f)
@@ -105,6 +128,14 @@ class PrivacyLedger:
                     f"{state.get('version')!r}, expected {_STATE_VERSION}")
             self._spent = {str(k): float(v)
                            for k, v in state["spent"].items()}
+            self._publish_locked()
+
+    def _publish_locked(self) -> None:
+        """Mirror the spend table into the per-party gauge (caller holds
+        the lock, or is the constructor before any concurrency)."""
+        if self._spent_gauge is not None:
+            for party, spent in self._spent.items():
+                self._spent_gauge.set(spent, party=party)
 
     def budget_for(self, party: str) -> float:
         return float(self.per_party.get(party, self.budget))
@@ -117,13 +148,16 @@ class PrivacyLedger:
         with self._lock:
             return self.budget_for(party) - self._spent.get(party, 0.0)
 
-    def charge(self, charges: Mapping[str, float]) -> None:
+    def charge(self, charges: Mapping[str, float],
+               trace_id: str | None = None) -> None:
         """Atomically spend ``{party: ε}`` across all named parties.
 
         All-or-nothing: if any party would exceed its budget the whole
         charge is refused (no partial spend) and
         :class:`BudgetExceededError` raised for the first violator. On
         success the new state is durably persisted before returning.
+        ``trace_id`` stamps the audit event so a budget decision joins
+        the request's span chain.
         """
         for party, eps in charges.items():
             if eps < 0.0:
@@ -134,19 +168,35 @@ class PrivacyLedger:
                 # strict >: a charge landing exactly on the budget is
                 # admitted (the budget is a spend *cap*, not an open bound)
                 if spent + eps > self.budget_for(party) + 1e-12:
+                    if self._events is not None:
+                        self._events.inc(kind="refusal")
+                    if self.audit is not None:
+                        self.audit.record(
+                            "refusal", charges, trace_id=trace_id,
+                            party=party, spent=spent,
+                            budget=self.budget_for(party))
                     raise BudgetExceededError(party, spent, eps,
                                               self.budget_for(party))
             for party, eps in charges.items():
                 self._spent[party] = self._spent.get(party, 0.0) + eps
             self._persist_locked()
+            # observers fire only after the spend is durably on disk —
+            # a crash here under-reports the audit view, never the budget
+            if self._events is not None:
+                self._events.inc(kind="charge")
+            self._publish_locked()
+            if self.audit is not None:
+                self.audit.record("charge", charges, trace_id=trace_id)
 
-    def charge_request(self, req: EstimateRequest) -> dict[str, float]:
+    def charge_request(self, req: EstimateRequest,
+                       trace_id: str | None = None) -> dict[str, float]:
         """Charge one request's spend; returns what was charged."""
         charges = request_charges(req)
-        self.charge(charges)
+        self.charge(charges, trace_id=trace_id)
         return charges
 
-    def refund(self, charges: Mapping[str, float]) -> None:
+    def refund(self, charges: Mapping[str, float],
+               trace_id: str | None = None) -> None:
         """Reverse a charge whose query provably never executed.
 
         Only valid when no kernel ran and nothing was released under
@@ -165,6 +215,11 @@ class PrivacyLedger:
                 self._spent[party] = max(
                     0.0, self._spent.get(party, 0.0) - eps)
             self._persist_locked()
+            if self._events is not None:
+                self._events.inc(kind="refund")
+            self._publish_locked()
+            if self.audit is not None:
+                self.audit.record("refund", charges, trace_id=trace_id)
 
     def snapshot(self) -> dict:
         """Point-in-time accounting view (the stats endpoint's shape)."""

@@ -50,6 +50,9 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from dpcorr.obs import trace as obs_trace
+from dpcorr.obs.audit import AuditTrail
+from dpcorr.obs.metrics import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from dpcorr.serve.coalescer import Coalescer, ServerOverloadedError
 from dpcorr.serve.kernels import KernelCache
 from dpcorr.serve.ledger import BudgetExceededError, PrivacyLedger
@@ -98,17 +101,29 @@ class DpcorrServer:
                  seed: int = rng.MASTER_SEED,
                  max_batch: int = 64, max_delay_s: float = 0.005,
                  max_queue: int = 4096, shard: str = "auto",
-                 batch_mode: str = "exact", max_kernels: int = 128):
+                 batch_mode: str = "exact", max_kernels: int = 128,
+                 tracer: obs_trace.Tracer | None = None,
+                 audit: AuditTrail | str | None = None):
         self.seed = seed
+        # obs wiring (ISSUE 2): one tracer spans the request lifecycle
+        # (admit → charge → enqueue → flush → respond; default is the
+        # process tracer, disabled unless configured), one per-server
+        # metrics registry backs BOTH /stats and /metrics, and the
+        # ledger's audit trail stamps budget events with trace IDs
+        self.tracer = tracer if tracer is not None else obs_trace.tracer()
+        self.audit = AuditTrail(audit) if isinstance(audit, str) else audit
         self.stats = ServeStats()
         self.ledger = PrivacyLedger(budget, path=ledger_path,
-                                    per_party=per_party_budget)
+                                    per_party=per_party_budget,
+                                    audit=self.audit,
+                                    registry=self.stats.registry)
         self.cache = KernelCache(stats=self.stats, shard=shard,
                                  mode=batch_mode, max_kernels=max_kernels)
         self.coalescer = Coalescer(self.cache, self.stats,
                                    max_batch=max_batch,
                                    max_delay_s=max_delay_s,
-                                   max_queue=max_queue)
+                                   max_queue=max_queue,
+                                   tracer=self.tracer)
         self._master = None
         self._master_lock = threading.Lock()
         self._req_counter = itertools.count()
@@ -137,21 +152,42 @@ class DpcorrServer:
     def submit(self, req: EstimateRequest) -> Future:
         """Admit one request: charge the ledger (may raise
         BudgetExceededError), then enqueue (may raise
-        ServerOverloadedError). Returns a Future[EstimateResponse]."""
+        ServerOverloadedError). Returns a Future[EstimateResponse].
+
+        The root ``serve.request`` span opens here and closes on the
+        flush thread when the response lands; its trace ID stamps the
+        ledger's audit events, so one ID joins the latency chain and
+        the budget decision (docs/OBSERVABILITY.md)."""
         seed = req.seed if req.seed is not None else next(self._req_counter)
         key = self._request_key(req, seed)
+        root = self.tracer.start_span("serve.request", family=req.family,
+                                      n=req.n, seed=seed)
         try:
-            charges = self.ledger.charge_request(req)
-        except BudgetExceededError:
-            self.stats.refused_budget()
-            raise
-        try:
-            fut = self.coalescer.submit(req, key, seed)
+            with self.tracer.span("serve.admit", parent=root):
+                # inner spans parent implicitly under serve.admit (the
+                # thread's current span) — all on root's trace ID
+                try:
+                    with self.tracer.span("serve.ledger.charge"):
+                        charges = self.ledger.charge_request(
+                            req, trace_id=root.trace_id)
+                except BudgetExceededError:
+                    self.stats.refused_budget()
+                    root.set(refused="budget")
+                    raise
+                try:
+                    with self.tracer.span("serve.enqueue"):
+                        fut = self.coalescer.submit(req, key, seed,
+                                                    span=root)
+                except Exception:
+                    # the enqueue refused (backpressure / closed): no
+                    # kernel ran and nothing was released, so reversing
+                    # the charge is safe — shed load must not consume ε
+                    # (ledger.refund)
+                    self.ledger.refund(charges, trace_id=root.trace_id)
+                    root.set(refused="overload")
+                    raise
         except Exception:
-            # the enqueue refused (backpressure / closed): no kernel ran
-            # and nothing was released, so reversing the charge is safe —
-            # shed load must not consume ε (ledger.refund)
-            self.ledger.refund(charges)
+            root.end()  # refused requests never reach the flush thread
             raise
         self.stats.admitted()
         return fut
@@ -226,9 +262,23 @@ def make_http_server(server: DpcorrServer, host: str = "127.0.0.1",
             self.end_headers()
             self.wfile.write(blob)
 
+        def _send_text(self, code: int, text: str,
+                       content_type: str) -> None:
+            blob = text.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
         def do_GET(self):  # noqa: N802 (stdlib handler casing)
             if self.path == "/stats":
                 self._send(200, server.stats_snapshot())
+            elif self.path == "/metrics":
+                # Prometheus text exposition off the same registry that
+                # backs /stats — single source of truth (obs.metrics)
+                self._send_text(200, server.stats.render_prometheus(),
+                                _PROM_CONTENT_TYPE)
             elif self.path == "/healthz":
                 self._send(200, {"ok": True})
             else:

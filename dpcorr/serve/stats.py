@@ -7,6 +7,20 @@ coalescer, kernel cache and server; ``snapshot()`` is the single JSON
 shape exposed by the ``/stats`` endpoint, ``benchmarks/serve_load.py``
 and the tests.
 
+Since ISSUE 2 the counters live in an :class:`dpcorr.obs.metrics.Registry`
+(one per ServeStats, so concurrent in-process servers never
+cross-contaminate) rather than in ad-hoc attributes: the same metric
+objects back both the legacy ``/stats`` JSON snapshot and the
+Prometheus text exposition at ``GET /metrics`` — single source of
+truth, checked end-to-end by ``benchmarks/serve_load.py``. The old
+attribute reads (``stats.kernel_compiles`` etc.) remain as properties.
+
+Latency is recorded twice, deliberately: a sliding reservoir feeding
+the nearest-rank percentiles ``snapshot()["latency_s"]`` always
+reported (recency-biased, byte-compatible), and a fixed-bucket
+histogram exposing Prometheus ``_bucket``/``_sum``/``_count`` series a
+scraper can aggregate across servers (cumulative since boot).
+
 :func:`percentiles` is the one quantile implementation shared with the
 offline bench (bench.py block-latency reporting) so a reported p99
 always means the same estimator (nearest-rank).
@@ -17,6 +31,8 @@ from __future__ import annotations
 import threading
 from collections import deque
 from typing import Iterable, Sequence
+
+from dpcorr.obs.metrics import LATENCY_BUCKETS, Registry
 
 
 def percentiles(values: Iterable[float],
@@ -34,108 +50,185 @@ def percentiles(values: Iterable[float],
 
 
 class ServeStats:
-    """Thread-safe serving counters.
+    """Thread-safe serving counters, backed by an obs metrics registry.
 
     Counters are monotone totals (Prometheus-counter style) except
-    ``queue_depth`` (a gauge maintained by the coalescer) and the
-    latency reservoir (last ``reservoir`` completions — bounded memory,
-    recency-biased percentiles, same trade-off as production servers'
-    sliding-window summaries).
+    ``queue_depth`` / ``flush_size_max`` / ``kernel_cache_size``
+    (gauges) and the latency reservoir (last ``reservoir`` completions —
+    bounded memory, recency-biased percentiles, same trade-off as
+    production servers' sliding-window summaries).
     """
 
-    def __init__(self, reservoir: int = 8192):
+    def __init__(self, reservoir: int = 8192,
+                 registry: Registry | None = None):
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        self._requests = r.counter(
+            "dpcorr_serve_requests_total",
+            "Requests admitted (charged and enqueued)")
+        self._refused = r.counter(
+            "dpcorr_serve_requests_refused_total",
+            "Requests refused at admission", labelnames=("reason",))
+        self._failed = r.counter(
+            "dpcorr_serve_requests_failed_total",
+            "Requests that failed during execution")
+        self._flushes = r.counter(
+            "dpcorr_serve_batches_flushed_total",
+            "Coalescer flush launches")
+        self._completed = r.counter(
+            "dpcorr_serve_requests_completed_total",
+            "Requests served, by execution mode", labelnames=("mode",))
+        self._flush_max = r.gauge(
+            "dpcorr_serve_flush_size_max",
+            "Largest flush (live requests in one launch) seen so far")
+        self._compiles = r.counter(
+            "dpcorr_serve_kernel_compiles_total",
+            "Batch-kernel cache misses (fresh compilations)")
+        self._hits = r.counter(
+            "dpcorr_serve_kernel_cache_hits_total",
+            "Batch-kernel cache hits")
+        self._cache_size = r.gauge(
+            "dpcorr_serve_kernel_cache_size",
+            "Live compiled kernels held by the LRU-bounded cache")
+        self._depth = r.gauge(
+            "dpcorr_serve_queue_depth", "Requests pending in the coalescer")
+        self._latency = r.histogram(
+            "dpcorr_serve_latency_seconds",
+            "Admission-to-completion request latency",
+            buckets=LATENCY_BUCKETS)
         self._lock = threading.Lock()
-        self.requests_total = 0
-        self.requests_refused_budget = 0
-        self.requests_refused_overload = 0
-        self.requests_failed = 0
-        self.batches_flushed = 0
-        self.batched_requests = 0
-        self.unbatched_requests = 0
-        self.flush_size_max = 0
-        self.kernel_compiles = 0
-        self.kernel_hits = 0
-        self.kernel_cache_size = 0
-        self.queue_depth = 0
         self._latencies: deque[float] = deque(maxlen=reservoir)
+
+    # -- legacy attribute reads (tests, report layer) --------------------
+    @property
+    def requests_total(self) -> int:
+        return int(self._requests.value())
+
+    @property
+    def requests_refused_budget(self) -> int:
+        return int(self._refused.value(reason="budget"))
+
+    @property
+    def requests_refused_overload(self) -> int:
+        return int(self._refused.value(reason="overload"))
+
+    @property
+    def requests_failed(self) -> int:
+        return int(self._failed.value())
+
+    @property
+    def batches_flushed(self) -> int:
+        return int(self._flushes.value())
+
+    @property
+    def batched_requests(self) -> int:
+        return int(self._completed.value(mode="batched"))
+
+    @property
+    def unbatched_requests(self) -> int:
+        return int(self._completed.value(mode="unbatched"))
+
+    @property
+    def flush_size_max(self) -> int:
+        return int(self._flush_max.value())
+
+    @property
+    def kernel_compiles(self) -> int:
+        return int(self._compiles.value())
+
+    @property
+    def kernel_hits(self) -> int:
+        return int(self._hits.value())
+
+    @property
+    def kernel_cache_size(self) -> int:
+        return int(self._cache_size.value())
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self._depth.value())
 
     # -- recording -------------------------------------------------------
     def admitted(self) -> None:
-        with self._lock:
-            self.requests_total += 1
+        self._requests.inc()
 
     def refused_budget(self) -> None:
-        with self._lock:
-            self.requests_refused_budget += 1
+        self._refused.inc(reason="budget")
 
     def refused_overload(self) -> None:
-        with self._lock:
-            self.requests_refused_overload += 1
+        self._refused.inc(reason="overload")
 
     def failed(self, k: int = 1) -> None:
-        with self._lock:
-            self.requests_failed += k
+        self._failed.inc(k)
 
     def flushed(self, size: int, batched: bool) -> None:
+        self._flushes.inc()
+        self._completed.inc(size, mode="batched" if batched
+                            else "unbatched")
+        # max-tracking needs read-modify-write; the stats lock arbitrates
         with self._lock:
-            self.batches_flushed += 1
-            self.flush_size_max = max(self.flush_size_max, size)
-            if batched:
-                self.batched_requests += size
-            else:
-                self.unbatched_requests += size
+            if size > self._flush_max.value():
+                self._flush_max.set(size)
 
     def kernel(self, hit: bool) -> None:
-        with self._lock:
-            if hit:
-                self.kernel_hits += 1
-            else:
-                self.kernel_compiles += 1
+        if hit:
+            self._hits.inc()
+        else:
+            self._compiles.inc()
 
     def set_queue_depth(self, depth: int) -> None:
-        with self._lock:
-            self.queue_depth = depth
+        self._depth.set(depth)
 
     def set_kernel_cache_size(self, n: int) -> None:
         """Gauge: live compiled kernels held by the LRU-bounded cache
         (serve.kernels) — lets an operator see eviction pressure."""
-        with self._lock:
-            self.kernel_cache_size = n
+        self._cache_size.set(n)
 
     def observe_latency(self, seconds: float) -> None:
+        s = float(seconds)
+        self._latency.observe(s)
         with self._lock:
-            self._latencies.append(float(seconds))
+            self._latencies.append(s)
 
     # -- reading ---------------------------------------------------------
     def batch_fill_ratio(self) -> float:
         """Mean live requests per flushed launch — the number the load
         test gates on (> 1 means real coalescing happened)."""
-        with self._lock:
-            if not self.batches_flushed:
-                return 0.0
-            return (self.batched_requests + self.unbatched_requests) \
-                / self.batches_flushed
+        flushes = self.batches_flushed
+        if not flushes:
+            return 0.0
+        return (self.batched_requests + self.unbatched_requests) / flushes
+
+    def render_prometheus(self) -> str:
+        """The ``GET /metrics`` body: every instrument this server
+        publishes (incl. the ledger's, which registers into the same
+        registry via the server wiring)."""
+        return self.registry.render()
 
     def snapshot(self, ledger_snapshot: dict | None = None) -> dict:
+        done = self.batched_requests + self.unbatched_requests
+        flushes = self.batches_flushed
         with self._lock:
-            done = self.batched_requests + self.unbatched_requests
-            snap = {
-                "requests_total": self.requests_total,
-                "requests_refused_budget": self.requests_refused_budget,
-                "requests_refused_overload": self.requests_refused_overload,
-                "requests_failed": self.requests_failed,
-                "batches_flushed": self.batches_flushed,
-                "batched_requests": self.batched_requests,
-                "unbatched_requests": self.unbatched_requests,
-                "batch_fill_ratio": (done / self.batches_flushed
-                                     if self.batches_flushed else 0.0),
-                "flush_size_max": self.flush_size_max,
-                "kernel_compiles": self.kernel_compiles,
-                "kernel_hits": self.kernel_hits,
-                "kernel_cache_size": self.kernel_cache_size,
-                "queue_depth": self.queue_depth,
-                "latency_s": percentiles(self._latencies),
-            }
+            lat = percentiles(self._latencies)
+        snap = {
+            "requests_total": self.requests_total,
+            "requests_refused_budget": self.requests_refused_budget,
+            "requests_refused_overload": self.requests_refused_overload,
+            "requests_failed": self.requests_failed,
+            "batches_flushed": flushes,
+            "batched_requests": self.batched_requests,
+            "unbatched_requests": self.unbatched_requests,
+            "batch_fill_ratio": done / flushes if flushes else 0.0,
+            "flush_size_max": self.flush_size_max,
+            "kernel_compiles": self.kernel_compiles,
+            "kernel_hits": self.kernel_hits,
+            "kernel_cache_size": self.kernel_cache_size,
+            "queue_depth": self.queue_depth,
+            "latency_s": lat,
+            # additive (the pre-ISSUE-2 keys above are a stable shape):
+            # the bucketed view behind the /metrics histogram series
+            "latency_histogram": self._latency.snapshot(),
+        }
         if ledger_snapshot is not None:
             snap["ledger"] = ledger_snapshot
         return snap

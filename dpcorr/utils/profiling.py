@@ -26,10 +26,16 @@ def trace(log_dir: str = "/tmp/dpcorr_trace"):
     View with TensorBoard's profile plugin or Perfetto. Traces include XLA
     op names so fusion decisions and collective overlap are visible.
     """
+    from dpcorr.obs import trace as obs_trace
+
     jax.profiler.start_trace(log_dir)
+    # mirror the capture window into the obs span log so a profiler dump
+    # can be lined up against the span timeline it overlaps
+    sp = obs_trace.tracer().start_span("profiler.trace", log_dir=log_dir)
     try:
         yield log_dir
     finally:
+        sp.end()
         jax.profiler.stop_trace()
 
 

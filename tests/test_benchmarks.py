@@ -229,3 +229,39 @@ def test_trace_summary_missing_dir_raises(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         find_trace_file(str(tmp_path))
+
+
+def test_summarize_spans_roundtrip(tmp_path):
+    """Span JSONL -> per-name aggregates: counts, totals and the
+    serving stack's nearest-rank percentiles (ISSUE 2 satellite)."""
+    from benchmarks.trace_summary import summarize_spans
+    from dpcorr.obs import Tracer
+
+    path = str(tmp_path / "spans.jsonl")
+    tr = Tracer(path)
+    for _ in range(5):
+        with tr.span("serve.flush"):
+            pass
+    with tr.span("serve.kernel"):
+        pass
+    s = summarize_spans(path)
+    assert s["spans"] == 6
+    assert s["names"]["serve.flush"]["count"] == 5
+    assert s["names"]["serve.kernel"]["count"] == 1
+    for row in s["names"].values():
+        assert 0 <= row["p50_s"] <= row["p99_s"]
+        assert row["total_s"] >= row["p99_s"]
+
+    # pre-loaded span lists skip the file read; values reduce exactly
+    spans = [{"name": "a", "dur_s": d} for d in (1.0, 2.0, 3.0, 4.0)]
+    s2 = summarize_spans(spans)
+    assert s2["names"]["a"] == {"count": 4, "total_s": 10.0,
+                                "p50_s": 2.0, "p99_s": 4.0}
+
+    # strict input: a corrupt line fails loudly (the CI artifact gate)
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("{}{\n")
+    import pytest
+
+    with pytest.raises(ValueError):
+        summarize_spans(str(bad))

@@ -1,0 +1,350 @@
+"""Unit tests for the telemetry layer (dpcorr.obs; docs/OBSERVABILITY.md):
+metrics registry + Prometheus exposition, span tracer + Chrome export,
+and the privacy-budget audit trail with its replay arithmetic."""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+
+import pytest
+
+from dpcorr.obs import (
+    AuditTrail,
+    LATENCY_BUCKETS,
+    Registry,
+    Tracer,
+    parse_exposition,
+    read_events,
+    read_spans,
+    replay,
+    timeline,
+    to_chrome_trace,
+)
+from dpcorr.obs import trace as obs_trace
+
+
+# -------------------------------------------------------------- metrics ----
+
+def test_counter_and_gauge_basics():
+    r = Registry()
+    c = r.counter("t_total", "help text")
+    c.inc()
+    c.inc(2.5)
+    assert c.value() == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = r.gauge("t_gauge")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 3.0
+
+
+def test_labelled_counter_children():
+    r = Registry()
+    c = r.counter("t_refused_total", labelnames=("reason",))
+    c.inc(reason="budget")
+    c.inc(3, reason="overload")
+    assert c.value(reason="budget") == 1.0
+    assert c.value(reason="overload") == 3.0
+    assert c.value(reason="never") == 0.0
+    with pytest.raises(ValueError):  # undeclared label set
+        c.inc(party="x")
+
+
+def test_registry_idempotent_reregistration():
+    r = Registry()
+    a = r.counter("t_total")
+    assert r.counter("t_total") is a
+    with pytest.raises(ValueError):  # same name, different kind
+        r.gauge("t_total")
+
+
+def test_metric_name_validation():
+    r = Registry()
+    for bad in ("", "9lead", "has-dash", "has space"):
+        with pytest.raises(ValueError):
+            r.counter(bad)
+
+
+def test_histogram_buckets_cumulative():
+    r = Registry()
+    h = r.histogram("t_lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(5.555)
+    # cumulative: each bound counts everything at or below it
+    assert snap["buckets"] == {"0.01": 1, "0.1": 2, "1.0": 3}
+    samples = dict((f"{n}{lab}", v) for n, lab, v in h.samples())
+    assert samples['t_lat_seconds_bucket{le="+Inf"}'] == 4.0
+    assert samples["t_lat_seconds_count"] == 4.0
+
+
+def test_histogram_rejects_bad_buckets():
+    r = Registry()
+    with pytest.raises(ValueError):
+        r.histogram("t_h", buckets=())
+    with pytest.raises(ValueError):
+        r.histogram("t_h2", buckets=(-1.0, 1.0))
+
+
+def test_render_parse_roundtrip():
+    r = Registry()
+    c = r.counter("t_req_total", "requests", labelnames=("mode",))
+    c.inc(7, mode="batched")
+    g = r.gauge("t_depth", "queue depth")
+    g.set(3)
+    h = r.histogram("t_lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    text = r.render()
+    assert "# TYPE t_req_total counter" in text
+    assert "# HELP t_depth queue depth" in text
+    series = parse_exposition(text)
+    assert series['t_req_total{mode="batched"}'] == 7.0
+    assert series["t_depth"] == 3.0
+    assert series['t_lat_seconds_bucket{le="0.1"}'] == 1.0
+    assert series['t_lat_seconds_bucket{le="+Inf"}'] == 1.0
+    assert series["t_lat_seconds_sum"] == 0.05
+
+
+def test_label_value_escaping():
+    r = Registry()
+    c = r.counter("t_esc_total", labelnames=("p",))
+    c.inc(p='a"b\\c\nd')
+    text = r.render()
+    assert '{p="a\\"b\\\\c\\nd"}' in text
+
+
+def test_registry_thread_safety_concurrent_increments():
+    """The ISSUE 2 smoke: concurrent increments lose no counts — the
+    flush thread, many client threads and a scraper all mutate these."""
+    r = Registry()
+    c = r.counter("t_conc_total", labelnames=("who",))
+    h = r.histogram("t_conc_lat", buckets=LATENCY_BUCKETS)
+    n_threads, per_thread = 8, 2000
+
+    def worker(w):
+        for _ in range(per_thread):
+            c.inc(who=str(w % 2))
+            h.observe(0.01)
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = c.value(who="0") + c.value(who="1")
+    assert total == n_threads * per_thread
+    assert h.snapshot()["count"] == n_threads * per_thread
+
+
+# ---------------------------------------------------------------- spans ----
+
+def test_disabled_tracer_is_free():
+    tr = Tracer(None)
+    sp = tr.start_span("x")
+    assert sp is obs_trace._NULL_SPAN
+    assert sp.context is None and sp.trace_id is None
+    sp.set(a=1)
+    sp.end()  # all no-ops
+    with tr.span("y") as sp2:
+        assert sp2 is obs_trace._NULL_SPAN
+
+
+def test_span_jsonl_roundtrip_and_parenting(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    tr = Tracer(path)
+    with tr.span("outer", n=4000) as outer:
+        with tr.span("inner") as inner:
+            inner.set(device_s=0.5)
+        assert obs_trace.current_span() is outer
+    spans = {s["name"]: s for s in read_spans(path)}
+    assert spans["inner"]["trace_id"] == spans["outer"]["trace_id"]
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["outer"]["parent_id"] is None
+    assert spans["outer"]["attrs"] == {"n": 4000}
+    assert spans["inner"]["attrs"] == {"device_s": 0.5}
+    assert spans["inner"]["dur_s"] <= spans["outer"]["dur_s"]
+
+
+def test_span_error_stamped(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    tr = Tracer(path)
+    with pytest.raises(RuntimeError):
+        with tr.span("boom"):
+            raise RuntimeError("x")
+    (sp,) = read_spans(path)
+    assert sp["attrs"]["error"] == "RuntimeError"
+
+
+def test_explicit_cross_thread_parent(tmp_path):
+    """The coalescer pattern: a root span's context rides a queue and
+    the flush thread parents its span explicitly."""
+    path = str(tmp_path / "spans.jsonl")
+    tr = Tracer(path)
+    root = tr.start_span("request")
+
+    def flush():
+        sp = tr.start_span("flush", parent=root.context)
+        sp.end()
+
+    t = threading.Thread(target=flush)
+    t.start()
+    t.join()
+    root.end()
+    spans = {s["name"]: s for s in read_spans(path)}
+    assert spans["flush"]["trace_id"] == spans["request"]["trace_id"]
+    assert spans["flush"]["parent_id"] == spans["request"]["span_id"]
+    assert spans["flush"]["thread"] != spans["request"]["thread"]
+
+
+def test_read_spans_rejects_bad_line(tmp_path):
+    path = tmp_path / "bad.jsonl"
+    path.write_text('{"name": "a", "dur_s": 0.1}\nnot json\n')
+    with pytest.raises(ValueError, match="bad.jsonl:2"):
+        read_spans(str(path))
+    path.write_text('{"no": "span fields"}\n')
+    with pytest.raises(ValueError, match="not a span"):
+        read_spans(str(path))
+
+
+def test_chrome_trace_export(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    tr = Tracer(path)
+    with tr.span("a", n=1):
+        with tr.span("b"):
+            pass
+    doc = to_chrome_trace(path)
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {e["name"] for e in events} == {"a", "b"}
+    assert all(e["ts"] > 0 and e["dur"] >= 0 for e in events)
+    assert meta and meta[0]["name"] == "thread_name"
+    a = next(e for e in events if e["name"] == "a")
+    assert a["args"]["n"] == 1 and a["args"]["trace_id"]
+
+
+def test_configure_installs_process_tracer(tmp_path):
+    path = str(tmp_path / "global.jsonl")
+    tr = obs_trace.configure(path)
+    try:
+        assert obs_trace.tracer() is tr
+        with obs_trace.tracer().span("g"):
+            pass
+    finally:
+        obs_trace.configure(None)
+    assert not obs_trace.tracer().enabled
+    assert [s["name"] for s in read_spans(path)] == ["g"]
+
+
+# ---------------------------------------------------------------- audit ----
+
+def test_audit_memory_and_kinds():
+    trail = AuditTrail()
+    ev = trail.record("charge", {"a": 1.0}, trace_id="t1", extra=7)
+    assert ev["seq"] == 0 and ev["kind"] == "charge"
+    assert ev["charges"] == {"a": 1.0} and ev["trace_id"] == "t1"
+    assert ev["extra"] == 7
+    trail.record("refund", {"a": 0.5})
+    with pytest.raises(ValueError):
+        trail.record("spend", {"a": 1.0})
+    assert [e["seq"] for e in trail.events()] == [0, 1]
+
+
+def test_audit_file_append_and_seq_resume(tmp_path):
+    path = str(tmp_path / "audit.jsonl")
+    t1 = AuditTrail(path)
+    t1.record("charge", {"a": 1.0})
+    t1.close()
+    t2 = AuditTrail(path)  # restart: seq continues past the tail
+    t2.record("refusal", {"a": 9.0}, party="a", spent=1.0, budget=2.0)
+    t2.close()
+    events = read_events(path)
+    assert [e["seq"] for e in events] == [0, 1]
+    assert events[1]["party"] == "a"
+
+
+def test_read_events_rejects_bad_lines(tmp_path):
+    p = tmp_path / "a.jsonl"
+    p.write_text('{"kind": "charge", "charges": {}}\n{"kind": "nope"}\n')
+    with pytest.raises(ValueError, match="not an audit event"):
+        read_events(str(p))
+
+
+def test_replay_and_timeline_arithmetic():
+    events = [
+        {"seq": 0, "ts": 1.0, "kind": "charge", "charges": {"a": 2.0},
+         "trace_id": "t0"},
+        {"seq": 1, "ts": 2.0, "kind": "refusal",
+         "charges": {"a": 99.0, "b": 1.0}, "trace_id": "t1"},
+        {"seq": 2, "ts": 3.0, "kind": "charge",
+         "charges": {"a": 1.0, "b": 0.5}, "trace_id": "t2"},
+        {"seq": 3, "ts": 4.0, "kind": "refund", "charges": {"b": 2.0},
+         "trace_id": "t3"},
+    ]
+    spent = replay(events)
+    assert spent == {"a": 3.0, "b": 0.0}  # refund clamps at zero
+    rows = timeline(events)
+    assert [r["kind"] for r in rows] == ["charge", "refusal", "charge",
+                                         "refund"]
+    assert rows[1]["spent_after"]["a"] == 2.0  # refusal spends nothing
+    assert rows[3]["spent_after"]["b"] == 0.0
+    only_b = timeline(events, party="b")
+    assert [r["seq"] for r in only_b] == [1, 2, 3]
+
+
+# ------------------------------------------------------------------ CLI ----
+
+def _budget_cli(argv, capsys):
+    from dpcorr.__main__ import main
+
+    main(argv)
+    return capsys.readouterr().out
+
+
+def test_obs_budget_cli_replays_trail(tmp_path, capsys):
+    path = str(tmp_path / "audit.jsonl")
+    trail = AuditTrail(path)
+    trail.record("charge", {"a": 2.0, "b": 1.0}, trace_id="t0")
+    trail.record("refund", {"b": 1.0}, trace_id="t1")
+    trail.record("refusal", {"a": 50.0}, trace_id="t2", party="a",
+                 spent=2.0, budget=3.0)
+    trail.close()
+
+    out = json.loads(_budget_cli(
+        ["obs", "budget", "--audit", path, "--json"], capsys))
+    assert out["events"] == 3
+    assert out["spent"] == {"a": 2.0, "b": 0.0}
+    assert [r["trace_id"] for r in out["timeline"]] == ["t0", "t1", "t2"]
+
+    text = _budget_cli(["obs", "budget", "--audit", path], capsys)
+    assert "refusal" in text and "replayed spend" in text
+
+    only_a = json.loads(_budget_cli(
+        ["obs", "budget", "--audit", path, "--party", "a", "--json"],
+        capsys))
+    assert only_a["spent"] == {"a": 2.0}
+    assert [r["seq"] for r in only_a["timeline"]] == [0, 2]
+
+
+def test_obs_chrome_cli(tmp_path, capsys):
+    from dpcorr.__main__ import main
+
+    spans = str(tmp_path / "spans.jsonl")
+    tr = Tracer(spans)
+    with tr.span("a"):
+        pass
+    out = str(tmp_path / "chrome.json")
+    main(["obs", "chrome", "--trace", spans, "--out", out])
+    doc = json.load(open(out))
+    assert any(e.get("name") == "a" for e in doc["traceEvents"])
+
+
+def test_parse_exposition_special_values():
+    assert parse_exposition('x 1\ny{le="+Inf"} +Inf\n# comment\n') == {
+        "x": 1.0, 'y{le="+Inf"}': math.inf}

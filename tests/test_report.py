@@ -81,3 +81,36 @@ def test_hrs_figure(tmp_path):
     p = tmp_path / "hrs.pdf"
     report.fig_hrs_sweep(summ, rho_np=-0.193, out=p)
     assert p.exists() and p.stat().st_size > 2_000
+
+
+def test_serve_stats_frame_nested_ledger_and_latency():
+    """serve_stats_frame flattens the full nested snapshot — multi-party
+    ledger groups, reservoir percentiles AND the obs latency-histogram
+    buckets — into dotted metric keys (ISSUE 2 satellite)."""
+    from dpcorr.report import serve_stats_frame
+    from dpcorr.serve import ServeStats
+
+    st = ServeStats()
+    st.admitted()
+    st.flushed(3, batched=True)
+    for v in (0.002, 0.02, 0.2):
+        st.observe_latency(v)
+    snap = st.snapshot(ledger_snapshot={
+        "budget_default": 10.0,
+        "parties": {
+            "alice": {"spent": 1.5, "budget": 10.0, "remaining": 8.5},
+            "bob": {"spent": 0.25, "budget": 2.0, "remaining": 1.75},
+        }})
+    df = serve_stats_frame(snap)
+    metrics = dict(zip(df["metric"], df["value"]))
+    assert metrics["ledger.parties.alice.spent"] == 1.5
+    assert metrics["ledger.parties.bob.remaining"] == 1.75
+    assert metrics["ledger.budget_default"] == 10.0
+    assert metrics["latency_s.p50"] == 0.02
+    assert metrics["latency_s.p99"] == 0.2
+    # the additive histogram view flattens too (cumulative buckets)
+    assert metrics["latency_histogram.count"] == 3
+    assert metrics["latency_histogram.buckets.0.005"] == 1
+    assert metrics["latency_histogram.buckets.0.25"] == 3
+    # every leaf is scalar — nothing left as a dict cell
+    assert not any(isinstance(v, dict) for v in df["value"])

@@ -619,3 +619,182 @@ def test_http_endpoints_smoke():
     finally:
         httpd.shutdown()
         srv.close()
+
+
+# ----------------------------------------------------- telemetry (obs) ----
+
+def test_metrics_endpoint_matches_stats():
+    """ISSUE 2 acceptance: GET /metrics serves valid Prometheus text
+    whose counters agree numerically with the GET /stats snapshot —
+    both views read the same obs registry."""
+    from dpcorr.obs import CONTENT_TYPE, parse_exposition
+
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+    httpd = make_http_server(srv, host="127.0.0.1", port=0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        for i in range(3):
+            srv.estimate(_mk_req(seed=i, i=i), timeout=60)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as r:
+            assert r.headers["Content-Type"] == CONTENT_TYPE
+            text = r.read().decode()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats") as r:
+            snap = json.load(r)
+        series = parse_exposition(text)
+        assert "# TYPE dpcorr_serve_requests_total counter" in text
+        assert series["dpcorr_serve_requests_total"] == \
+            snap["requests_total"]
+        assert series["dpcorr_serve_batches_flushed_total"] == \
+            snap["batches_flushed"]
+        assert series["dpcorr_serve_kernel_compiles_total"] == \
+            snap["kernel_compiles"]
+        assert series["dpcorr_serve_latency_seconds_count"] == \
+            snap["batched_requests"] + snap["unbatched_requests"]
+        # the ledger publishes into the same registry (server wiring)
+        assert series['dpcorr_ledger_events_total{kind="charge"}'] == 3.0
+        assert series['dpcorr_ledger_spent_eps{party="party-x"}'] == \
+            snap["ledger"]["parties"]["party-x"]["spent"]
+    finally:
+        httpd.shutdown()
+        srv.close()
+
+
+def test_snapshot_latency_histogram_additive():
+    """snapshot() keeps the pre-obs keys (latency_s percentiles from
+    the reservoir) and adds the bucketed histogram view."""
+    st = ServeStats()
+    st.observe_latency(0.003)
+    st.observe_latency(0.3)
+    snap = st.snapshot()
+    assert snap["latency_s"]["p50"] in (0.003, 0.3)
+    hist = snap["latency_histogram"]
+    assert hist["count"] == 2
+    assert hist["sum"] == pytest.approx(0.303)
+    assert hist["buckets"]["0.005"] == 1  # cumulative: only the 3ms obs
+    assert hist["buckets"]["0.5"] == 2
+
+
+def test_trace_chain_links_request_to_flush(tmp_path):
+    """ISSUE 2 acceptance: a single trace ID links one request's span
+    chain from admission through ledger charge to kernel flush."""
+    from dpcorr.obs import Tracer, read_spans
+
+    path = str(tmp_path / "spans.jsonl")
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off",
+                       tracer=Tracer(path))
+    try:
+        resp = srv.estimate(_mk_req(seed=0), timeout=60)
+    finally:
+        srv.close()
+    spans = read_spans(path)
+    by_name = {s["name"]: s for s in spans}
+    root = by_name["serve.request"]
+    chain = {s["name"] for s in spans if s["trace_id"] == root["trace_id"]}
+    assert {"serve.request", "serve.admit", "serve.ledger.charge",
+            "serve.enqueue", "serve.flush", "serve.kernel"} <= chain
+    # tree shape: admit under root, charge under admit, flush under root
+    assert by_name["serve.admit"]["parent_id"] == root["span_id"]
+    assert by_name["serve.ledger.charge"]["parent_id"] == \
+        by_name["serve.admit"]["span_id"]
+    assert by_name["serve.flush"]["parent_id"] == root["span_id"]
+    assert by_name["serve.kernel"]["parent_id"] == \
+        by_name["serve.flush"]["span_id"]
+    # the root closes at respond with the end-to-end latency
+    assert root["attrs"]["latency_s"] == pytest.approx(resp.latency_s)
+    # client thread vs coalescer flush thread, one trace across both
+    assert by_name["serve.flush"]["thread"] == "dpcorr-serve-flush"
+    assert root["thread"] != by_name["serve.flush"]["thread"]
+
+
+def test_refused_request_span_ends_with_reason(tmp_path):
+    from dpcorr.obs import Tracer, read_spans
+
+    path = str(tmp_path / "spans.jsonl")
+    srv = DpcorrServer(budget=1e6, per_party_budget={"tiny": 0.01},
+                       max_delay_s=0.001, shard="off",
+                       tracer=Tracer(path))
+    try:
+        with pytest.raises(BudgetExceededError):
+            srv.submit(_mk_req(seed=0, party_x="tiny"))
+    finally:
+        srv.close()
+    roots = [s for s in read_spans(path) if s["name"] == "serve.request"]
+    assert roots and roots[0]["attrs"]["refused"] == "budget"
+
+
+def test_audit_trail_replays_to_ledger_state(tmp_path):
+    """ISSUE 2 acceptance: the per-party ε spend is reproducible from
+    the audit trail alone — replay(trail) == ledger snapshot — and
+    every event carries the request's trace ID."""
+    from dpcorr.obs import Tracer, read_events, replay
+
+    audit = str(tmp_path / "audit.jsonl")
+    srv = DpcorrServer(budget=1e6, per_party_budget={"tiny": 0.01},
+                       max_delay_s=0.001, shard="off",
+                       tracer=Tracer(str(tmp_path / "spans.jsonl")),
+                       audit=audit)
+    try:
+        for i in range(3):
+            srv.estimate(_mk_req(seed=i, i=i), timeout=60)
+        with pytest.raises(BudgetExceededError):
+            srv.submit(_mk_req(seed=9, party_x="tiny"))
+        ledger_snap = srv.ledger.snapshot()
+    finally:
+        srv.close()
+    events = read_events(audit)
+    assert [e["kind"] for e in events] == ["charge"] * 3 + ["refusal"]
+    assert all(e["trace_id"] for e in events)
+    spent = replay(events)
+    assert set(spent) == set(ledger_snap["parties"])
+    for p, s in spent.items():
+        assert s == pytest.approx(ledger_snap["parties"][p]["spent"])
+    # the refusal event names the violating party and its standing
+    refusal = events[-1]
+    assert refusal["party"] == "tiny" and refusal["budget"] == 0.01
+
+
+def test_overload_refund_lands_in_audit():
+    """A backpressure-shed request leaves a charge+refund pair sharing
+    one trace ID: net-zero spend, fully auditable."""
+    from dpcorr.obs import AuditTrail, replay
+
+    trail = AuditTrail()
+    # long delay + wide batch: the first request stays queued, so the
+    # second overflows max_queue deterministically
+    srv = DpcorrServer(budget=1e6, max_queue=1, max_batch=1024,
+                       max_delay_s=30.0, shard="off", audit=trail)
+    try:
+        fut = srv.submit(_mk_req(seed=0, i=0))  # fills the queue
+        with pytest.raises(ServerOverloadedError):
+            srv.submit(_mk_req(seed=1, i=1))
+    finally:
+        srv.close()
+    fut.result(timeout=60)
+    events = trail.events()
+    kinds = [e["kind"] for e in events]
+    assert kinds == ["charge", "charge", "refund"]
+    assert events[1]["trace_id"] == events[2]["trace_id"]
+    spent = replay(events)
+    total = request_charges(_mk_req(seed=0))  # one surviving request
+    for p, s in total.items():
+        assert spent[p] == pytest.approx(s)
+
+
+def test_ledger_registry_publishes_spend():
+    from dpcorr.obs import Registry
+
+    r = Registry()
+    led = PrivacyLedger(2.0, registry=r)
+    led.charge({"a": 1.5})
+    led.refund({"a": 0.5})
+    with pytest.raises(BudgetExceededError):
+        led.charge({"a": 1.5})
+    g = r.get("dpcorr_ledger_spent_eps")
+    assert g.value(party="a") == pytest.approx(1.0)
+    c = r.get("dpcorr_ledger_events_total")
+    assert (c.value(kind="charge"), c.value(kind="refund"),
+            c.value(kind="refusal")) == (1.0, 1.0, 1.0)

@@ -15,6 +15,10 @@ Replaces the reference's "source the script" workflow (README.md:28-46):
                   there)
 - ``serve``       online micro-batched DP-correlation service
                   (docs/SERVING.md)
+- ``lint``        AST-based privacy/RNG/concurrency invariant checker
+                  over dpcorr's own source (docs/STATIC_ANALYSIS.md);
+                  jax-free, wired into CI as the gate before the test
+                  matrix
 - ``obs``         telemetry tooling (docs/OBSERVABILITY.md): ``obs
                   budget`` replays a ledger audit trail into the
                   per-party ε-spend timeline; ``obs chrome`` converts a
@@ -274,6 +278,15 @@ def cmd_obs_chrome(args):
     print(f"wrote {args.out} ({n} spans)")
 
 
+def cmd_lint(args):
+    """Static invariant checker over the repo's own source
+    (docs/STATIC_ANALYSIS.md): RNG hygiene, budget discipline, lock
+    discipline, jit purity. jax-free; exit code is the gate."""
+    from dpcorr.analysis import cli as lint_cli
+
+    sys.exit(lint_cli.run(args))
+
+
 def cmd_doctor(args):
     from dpcorr.utils import doctor
 
@@ -315,6 +328,15 @@ def main(argv=None):
     # the flag, not function identity, so future jax-free subcommands
     # just set it too)
     pd_.set_defaults(fn=cmd_doctor, platform=None, jax_free=True)
+
+    pl_ = sub.add_parser("lint", help="AST-based privacy/RNG/concurrency "
+                         "invariant checker over dpcorr's own source "
+                         "(docs/STATIC_ANALYSIS.md); jax-free, exit 1 on "
+                         "new violations")
+    from dpcorr.analysis import cli as lint_cli
+
+    lint_cli.add_arguments(pl_)
+    pl_.set_defaults(fn=cmd_lint, platform=None, jax_free=True)
 
     ps_ = sub.add_parser("serve", help="online micro-batched DP-correlation "
                          "service with a per-party privacy-budget ledger "

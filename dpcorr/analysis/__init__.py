@@ -1,0 +1,24 @@
+"""dpcorr.analysis — AST-based invariant linter (docs/STATIC_ANALYSIS.md).
+
+Run it as ``python -m dpcorr lint``; programmatic entry point is
+:func:`run_lint`. Stdlib-only on purpose: the CI lint gate runs before
+jax is installed and the module must import in well under a second.
+"""
+
+from dpcorr.analysis.core import (  # noqa: F401
+    Checker,
+    Module,
+    Violation,
+    apply_baseline,
+    default_checkers,
+    iter_py_files,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+__all__ = [
+    "Checker", "Module", "Violation", "apply_baseline",
+    "default_checkers", "iter_py_files", "load_baseline", "run_lint",
+    "write_baseline",
+]

@@ -1,0 +1,16 @@
+"""The shipped checker families (docs/STATIC_ANALYSIS.md).
+
+Each module is one plugin: a :class:`dpcorr.analysis.core.Checker`
+subclass declaring its rule ids and the slice of the tree it applies
+to. Adding a family = adding a module here and listing its class in
+``ALL_CHECKERS`` — the runner, CLI, baseline and ``--list-rules`` all
+derive from this list.
+"""
+
+from dpcorr.analysis.rules.budget import BudgetChecker
+from dpcorr.analysis.rules.locks import LockChecker
+from dpcorr.analysis.rules.purity import PurityChecker
+from dpcorr.analysis.rules.rng import RngChecker
+
+#: registration order is report order for equal (path, line).
+ALL_CHECKERS = (RngChecker, BudgetChecker, LockChecker, PurityChecker)

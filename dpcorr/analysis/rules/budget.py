@@ -1,0 +1,133 @@
+"""Budget discipline: charge-before-noise, refund-on-refusal (serve/).
+
+The serving layer's privacy invariant (serve.server module docstring)
+is structural: the ledger must be charged — and durably persisted —
+*before* a request can reach any noise-drawing execution path, and any
+post-charge refusal (queue backpressure, closed coalescer) must reverse
+the charge so shed load cannot drain budgets. Two rules, scoped to
+functions that *hold a ledger* (reference ``ledger``/``self.ledger``)
+— the admission layer — because below the admission boundary
+(the coalescer and kernel cache) requests are charged by contract:
+
+- ``budget-uncharged-noise`` — an admission-layer function launches
+  work (``coalescer.submit`` / ``cache.run_batch``) with no
+  ``ledger.charge``/``charge_request`` earlier in the function: a query
+  could execute without its spend on disk.
+- ``budget-missing-refund`` — the launch is not wrapped in a ``try``
+  whose handler reaches ``ledger.refund``: an enqueue refusal after a
+  successful charge would consume ε for a query that was never
+  answered.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from dpcorr.analysis.core import (
+    Checker,
+    Module,
+    Violation,
+    attr_chain,
+    walk_same_scope,
+)
+
+#: method names that hand an admitted request to the execution layer.
+ENQUEUE_FNS = frozenset({"submit", "run_batch"})
+#: receivers those methods count on (any element of the access chain).
+ENQUEUE_RECEIVERS = frozenset({"coalescer", "cache"})
+
+CHARGE_FNS = frozenset({"charge", "charge_request"})
+REFUND_FNS = frozenset({"refund"})
+LEDGER_NAMES = frozenset({"ledger"})
+
+
+def _is_ledger_call(call: ast.Call, fns: frozenset[str]) -> bool:
+    chain = attr_chain(call.func)
+    return (len(chain) >= 2 and chain[-1] in fns
+            and any(part in LEDGER_NAMES for part in chain[:-1]))
+
+
+def _is_enqueue_call(call: ast.Call) -> bool:
+    chain = attr_chain(call.func)
+    return (len(chain) >= 2 and chain[-1] in ENQUEUE_FNS
+            and any(part in ENQUEUE_RECEIVERS for part in chain[:-1]))
+
+
+class BudgetChecker(Checker):
+    name = "budget"
+    rules = {
+        "budget-uncharged-noise": "execution launched without a "
+                                  "dominating ledger.charge in the "
+                                  "admission layer",
+        "budget-missing-refund": "post-charge enqueue not guarded by a "
+                                 "refund-on-failure handler",
+    }
+
+    def applies_to(self, relpath: str) -> bool:
+        return "serve" in relpath.split("/")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not self._holds_ledger(fn):
+                continue
+            yield from self._check_fn(module, fn)
+
+    @staticmethod
+    def _holds_ledger(fn) -> bool:
+        """Admission-layer test: the function itself references a
+        ledger (``self.ledger`` / a local named ``ledger``)."""
+        for node in walk_same_scope(fn):
+            chain = ()
+            if isinstance(node, ast.Attribute):
+                chain = attr_chain(node)
+            elif isinstance(node, ast.Name):
+                chain = (node.id,)
+            if any(part in LEDGER_NAMES for part in chain):
+                return True
+        return False
+
+    def _check_fn(self, module: Module, fn) -> Iterator[Violation]:
+        charge_lines = []
+        for node in walk_same_scope(fn):
+            if isinstance(node, ast.Call) and _is_ledger_call(node,
+                                                              CHARGE_FNS):
+                charge_lines.append(node.lineno)
+        first_charge = min(charge_lines) if charge_lines else None
+        for node in walk_same_scope(fn):
+            if not (isinstance(node, ast.Call) and _is_enqueue_call(node)):
+                continue
+            if first_charge is None or node.lineno < first_charge:
+                yield Violation(
+                    "budget-uncharged-noise", module.relpath, node.lineno,
+                    f"{'.'.join(attr_chain(node.func))} launches "
+                    f"execution but no ledger.charge dominates it in "
+                    f"this admission-layer function")
+                continue
+            if not self._refund_guarded(fn, node):
+                yield Violation(
+                    "budget-missing-refund", module.relpath, node.lineno,
+                    f"{'.'.join(attr_chain(node.func))} can refuse "
+                    f"after the ledger was charged — wrap it in a try "
+                    f"whose handler calls ledger.refund")
+
+    @staticmethod
+    def _refund_guarded(fn, enqueue: ast.Call) -> bool:
+        """True when some ``try`` lexically containing the enqueue has
+        a handler that reaches ``ledger.refund``."""
+        for node in walk_same_scope(fn):
+            if not isinstance(node, ast.Try):
+                continue
+            in_body = any(sub is enqueue for stmt in node.body
+                          for sub in ast.walk(stmt))
+            if not in_body:
+                continue
+            for handler in node.handlers:
+                for stmt in handler.body:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call) and \
+                                _is_ledger_call(sub, REFUND_FNS):
+                            return True
+        return False

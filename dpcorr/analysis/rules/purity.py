@@ -1,0 +1,247 @@
+"""jit purity: no host side effects inside traced functions.
+
+A function handed to ``jax.jit``/``vmap``/``lax.map``/``pallas_call``
+runs **once** at trace time; everything that is not a jax op is baked
+into the compiled program. Host effects inside therefore do the wrong
+thing silently: ``time.time()`` freezes the trace-time clock into every
+call, ``print`` fires once (or per recompile) instead of per call,
+Python/NumPy ``random`` draws a single constant (breaking *both*
+reproducibility and the DP noise analysis — a "random" draw that is
+the same constant every call has sensitivity 0 budget but leaks like a
+constant shift), and mutating closed-over state from inside a trace is
+a classic source of cache-dependent results. Two rules:
+
+- ``jit-impure-call`` — a call with host side effects (wall clocks,
+  ``print``, stdlib/NumPy RNG, ``os.urandom``/``secrets``, file I/O)
+  lexically inside a traced function.
+- ``jit-closure-mutation`` — ``global``/``nonlocal`` declarations or
+  in-place mutation of a closed-over (free) variable inside a traced
+  function: the mutation happens at trace time, not at call time, and
+  its visibility depends on jit's cache.
+
+Traced contexts are found both ways jax is used in this repo: as
+decorators (``@jax.jit``, ``@partial(jax.jit, ...)``) and as call
+arguments (``jax.jit(f)``, ``lax.map(f, xs)``, ``vmap(f)``,
+``pl.pallas_call(kernel, ...)``, ``shard_map(f, ...)``), following
+through ``partial(...)`` and nested wrappers (``jit(vmap(f))``) and
+resolving bare names to local ``def``s in the same module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from dpcorr.analysis.core import (
+    Checker,
+    Module,
+    Violation,
+    attr_chain,
+    call_chain,
+    imported_names,
+    walk_same_scope,
+)
+
+#: callable tails that trace their function argument(s).
+TRACER_TAILS = frozenset({"jit", "vmap", "pmap", "pallas_call",
+                          "shard_map", "checkify", "grad", "value_and_grad"})
+#: `map` only traces when it is lax's (builtin map is host-side).
+_LAX_MAP_ORIGINS = ("jax.lax.map", "jax.lax.scan", "jax.lax.fori_loop",
+                    "jax.lax.while_loop", "jax.lax.cond", "jax.lax.switch")
+
+#: dotted-origin prefixes whose calls are host side effects.
+IMPURE_PREFIXES = (
+    "time.", "random.", "numpy.random.", "os.urandom", "secrets.",
+    "datetime.datetime.now", "datetime.date.today", "uuid.",
+)
+IMPURE_BUILTINS = frozenset({"print", "input", "open", "exec", "eval"})
+
+#: in-place mutators for the closure-mutation rule.
+MUTATOR_FNS = frozenset({
+    "add", "append", "appendleft", "clear", "discard", "extend",
+    "insert", "pop", "popitem", "remove", "reverse", "setdefault",
+    "sort", "update", "write",
+})
+
+
+class PurityChecker(Checker):
+    name = "purity"
+    rules = {
+        "jit-impure-call": "host side effect (clock/print/stdlib RNG/"
+                           "I/O) inside a traced function",
+        "jit-closure-mutation": "closed-over state mutated inside a "
+                                "traced function",
+    }
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        imports = imported_names(module.tree)
+        defs = self._local_defs(module.tree)
+        traced: list[ast.AST] = []
+        seen: set[int] = set()
+
+        def mark(fn_node) -> None:
+            if fn_node is not None and id(fn_node) not in seen:
+                seen.add(id(fn_node))
+                traced.append(fn_node)
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    if self._is_tracer(deco, imports):
+                        mark(node)
+            if isinstance(node, ast.Call) and \
+                    self._is_tracer(node, imports):
+                for arg in self._fn_args(node):
+                    mark(self._resolve(arg, defs))
+        # expand to nested scopes once, deduped — a lambda inside a jit
+        # that is *also* handed to lax.map must be checked exactly once
+        scopes: dict[int, ast.AST] = {}
+        for fn in traced:
+            scopes.setdefault(id(fn), fn)
+            for node in ast.walk(fn):
+                if node is not fn and isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+                    scopes.setdefault(id(node), node)
+        for scope in scopes.values():
+            yield from self._check_scope(module, scope, imports)
+
+    # --------------------------------------------- traced-context set ----
+    @staticmethod
+    def _local_defs(tree) -> dict[str, ast.AST]:
+        return {n.name: n for n in ast.walk(tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def _is_tracer(self, node, imports) -> bool:
+        """Is this decorator/call expression a tracing transform?"""
+        if isinstance(node, ast.Call):
+            return self._is_tracer(node.func, imports) \
+                or self._is_partial_of_tracer(node, imports)
+        chain = ()
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            chain = attr_chain(node)
+        if not chain:
+            return False
+        origin = self._origin(chain, imports)
+        if chain[-1] in TRACER_TAILS and not origin.startswith("numpy"):
+            return True
+        return origin in _LAX_MAP_ORIGINS or \
+            (chain[-1] == "map" and len(chain) >= 2
+             and chain[-2] == "lax")
+
+    def _is_partial_of_tracer(self, call: ast.Call, imports) -> bool:
+        chain = attr_chain(call.func)
+        if not chain or chain[-1] != "partial":
+            return False
+        return bool(call.args) and self._is_tracer(call.args[0], imports)
+
+    def _fn_args(self, call: ast.Call):
+        """The candidate function-valued arguments of a tracing call.
+        All positional args are yielded (``lax.cond``/``fori_loop``
+        take their functions mid-signature); :meth:`_resolve` discards
+        the non-function ones."""
+        args = list(call.args)
+        chain = attr_chain(call.func)
+        if chain and chain[-1] == "partial":
+            args = args[1:]  # partial(jax.jit, static...) — skip jit
+        yield from args
+
+    def _resolve(self, arg, defs) -> ast.AST | None:
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            return defs.get(arg.id)
+        if isinstance(arg, ast.Call):
+            # nested wrapper: vmap(f) inside jit(vmap(f))
+            for inner in arg.args:
+                r = self._resolve(inner, defs)
+                if r is not None:
+                    return r
+        return None
+
+    # ------------------------------------------------------- checking ----
+    def _check_scope(self, module: Module, scope, imports,
+                     ) -> Iterator[Violation]:
+        """Check one traced scope against its own local-binding set
+        (nested defs/lambdas were expanded into their own scopes)."""
+        local = self._local_bindings(scope)
+        for node in walk_same_scope(scope):
+            if node is scope:
+                continue
+            yield from self._check_node(module, node, imports, local)
+
+    def _check_node(self, module: Module, node, imports, local,
+                    ) -> Iterator[Violation]:
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kw = "global" if isinstance(node, ast.Global) else "nonlocal"
+            yield Violation(
+                "jit-closure-mutation", module.relpath, node.lineno,
+                f"`{kw} {', '.join(node.names)}` inside a traced "
+                f"function — the rebind happens at trace time, not per "
+                f"call")
+            return
+        if isinstance(node, ast.Call):
+            chain = call_chain(node)
+            if chain:
+                origin = self._origin(chain, imports)
+                impure = (
+                    any(origin == p.rstrip(".") or origin.startswith(p)
+                        for p in IMPURE_PREFIXES)
+                    or (len(chain) == 1 and chain[0] in IMPURE_BUILTINS
+                        and chain[0] not in local))
+                if impure:
+                    yield Violation(
+                        "jit-impure-call", module.relpath, node.lineno,
+                        f"{'.'.join(chain)}(...) has host side effects "
+                        f"— it runs once at trace time, not per call")
+                # mutating method on a free variable
+                if len(chain) >= 2 and chain[-1] in MUTATOR_FNS \
+                        and chain[0] not in local and chain[0] != "self":
+                    yield Violation(
+                        "jit-closure-mutation", module.relpath,
+                        node.lineno,
+                        f"{'.'.join(chain)}(...) mutates closed-over "
+                        f"state inside a traced function")
+            return
+        # store to a subscript/attribute rooted at a free variable
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                root = t
+                while isinstance(root, (ast.Subscript, ast.Attribute)):
+                    root = root.value
+                if isinstance(root, ast.Name) and root is not t \
+                        and root.id not in local and root.id != "self":
+                    yield Violation(
+                        "jit-closure-mutation", module.relpath,
+                        node.lineno,
+                        f"store into closed-over {root.id!r} inside a "
+                        f"traced function")
+
+    @staticmethod
+    def _local_bindings(scope) -> set[str]:
+        """Names bound in this function scope: params plus every Store
+        target (conservatively including comprehension vars)."""
+        names: set[str] = set()
+        args = scope.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            names.add(a.arg)
+        for node in walk_same_scope(scope):
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Store):
+                names.add(node.id)
+            elif isinstance(node, ast.ExceptHandler) and node.name:
+                names.add(node.name)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    names.add(alias.asname
+                              or alias.name.split(".")[0])
+        return names
+
+    @staticmethod
+    def _origin(chain: tuple[str, ...], imports: dict[str, str]) -> str:
+        root = imports.get(chain[0], chain[0])
+        return ".".join((root,) + chain[1:])

@@ -1,0 +1,271 @@
+"""RNG hygiene: the named-stream key-tree discipline (utils.rng).
+
+The framework's determinism *and* privacy contract is the key-tree
+``master → design point → replication → named substream``: every noise
+draw has a collision-resistant address and no PRNG key is ever consumed
+twice (Mironov-style attacks start exactly at reused or ad-hoc keys —
+PAPERS.md, ISSUE 3). Three rules:
+
+- ``rng-key-reuse`` — one key variable fed to two draw calls in the
+  same function without an intervening ``split``/reassignment: the two
+  draws are perfectly correlated, which voids the DP noise analysis
+  (and silently biases even non-private statistics).
+- ``rng-literal-seed`` — a literal integer seeding a key constructor in
+  library code: seeds must flow from configuration (``SimConfig.seed``,
+  ``--seed``) so runs are reproducible *and* re-seedable; a buried
+  constant is neither.
+- ``rng-raw-api`` — ``jax.random.key``/``PRNGKey``/raw ``fold_in``
+  outside ``utils/rng.py``: key construction and stream addressing go
+  through the named-stream API (``rng.master_key``/``stream``/
+  ``design_key``/``rep_keys``) so stream addresses stay stable across
+  code movement and auditable in one place.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from dpcorr.analysis.core import (
+    Checker,
+    Module,
+    Violation,
+    call_chain,
+    imported_names,
+    walk_same_scope,
+)
+
+#: jax.random sampling endpoints that *consume* a key (draw from it).
+DRAW_FNS = frozenset({
+    "ball", "bernoulli", "beta", "binomial", "bits", "categorical",
+    "cauchy", "chisquare", "choice", "dirichlet", "double_sided_maxwell",
+    "exponential", "f", "gamma", "geometric", "gumbel", "laplace",
+    "loggamma", "logistic", "lognormal", "maxwell", "multivariate_normal",
+    "normal", "orthogonal", "pareto", "permutation", "poisson", "rademacher",
+    "randint", "rayleigh", "t", "triangular", "truncated_normal", "uniform",
+    "wald", "weibull_min",
+})
+
+#: key-deriving endpoints — using these repeatedly on one key is the
+#: sanctioned pattern, not reuse.
+DERIVE_FNS = frozenset({"split", "fold_in", "clone", "wrap_key_data"})
+
+#: repo-local draw wrappers (dotted origins) that consume their first
+#: argument exactly like a jax.random draw does.
+WRAPPER_DRAW_ORIGINS = frozenset({
+    "dpcorr.ops.noise.laplace",
+})
+
+#: named-stream derivation helpers (dotted origins): feeding one key to
+#: several of these is addressing, not consumption.
+STREAM_API_ORIGINS = frozenset({
+    "dpcorr.utils.rng.stream",
+    "dpcorr.utils.rng.design_key",
+    "dpcorr.utils.rng.chunk_key",
+    "dpcorr.utils.rng.rep_keys",
+    "dpcorr.utils.rng.pallas_seeds",
+})
+
+#: key constructors a literal seed must not reach.
+SEED_CTORS = frozenset({"key", "PRNGKey", "master_key"})
+
+
+def _is_rng_file(relpath: str) -> bool:
+    return relpath.endswith("utils/rng.py")
+
+
+class RngChecker(Checker):
+    name = "rng"
+    rules = {
+        "rng-key-reuse": "a PRNG key fed to two draws without an "
+                         "intervening split/reassignment",
+        "rng-literal-seed": "literal integer seed reaching a key "
+                            "constructor in library code",
+        "rng-raw-api": "jax.random.key/PRNGKey/fold_in outside "
+                       "utils/rng.py (use the named-stream API)",
+    }
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        imports = imported_names(module.tree)
+        yield from self._raw_api(module)
+        yield from self._literal_seeds(module)
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+                yield from self._key_reuse(module, fn, imports)
+
+    # ---------------------------------------------------- rng-raw-api ----
+    def _raw_api(self, module: Module) -> Iterator[Violation]:
+        if _is_rng_file(module.relpath):
+            return
+        imports = imported_names(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = call_chain(node)
+            if not chain:
+                continue
+            origin = self._origin(chain, imports)
+            if origin in ("jax.random.fold_in", "jax.random.key",
+                          "jax.random.PRNGKey"):
+                api = origin.rsplit(".", 1)[1]
+                fix = ("rng.design_key / rng.stream"
+                       if api == "fold_in" else "rng.master_key")
+                yield Violation(
+                    "rng-raw-api", module.relpath, node.lineno,
+                    f"raw jax.random.{api} outside utils/rng.py — "
+                    f"use the named-stream API ({fix})")
+
+    # ----------------------------------------------- rng-literal-seed ----
+    def _literal_seeds(self, module: Module) -> Iterator[Violation]:
+        if _is_rng_file(module.relpath):
+            return
+        imports = imported_names(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = call_chain(node)
+            if not chain or chain[-1] not in SEED_CTORS:
+                continue
+            origin = self._origin(chain, imports)
+            if origin not in ("jax.random.key", "jax.random.PRNGKey",
+                              "dpcorr.utils.rng.master_key"):
+                continue
+            seed = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "seed":
+                    seed = kw.value
+            if isinstance(seed, ast.Constant) and isinstance(seed.value,
+                                                             int):
+                yield Violation(
+                    "rng-literal-seed", module.relpath, node.lineno,
+                    f"literal seed {seed.value} passed to "
+                    f"{chain[-1]} — thread the seed from configuration")
+
+    # -------------------------------------------------- rng-key-reuse ----
+    def _key_reuse(self, module: Module, fn, imports: dict[str, str],
+                   ) -> Iterator[Violation]:
+        """Structured linear scan over one function scope: a bare-name
+        key consumed by a second draw without an intervening rebind is
+        a violation. Branches of an ``if`` are scanned independently
+        (exclusive paths may each draw once) and merged; loop bodies
+        are scanned once (a key reused *across* iterations is invisible
+        statically — the named-stream API is the defense there)."""
+        body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+        violations: list[Violation] = []
+        self._scan(body if isinstance(body, list) else [body],
+                   set(), imports, violations, module)
+        yield from violations
+
+    def _scan(self, stmts, consumed: set[str], imports, out, module):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # nested scopes are scanned on their own
+            if isinstance(stmt, ast.If):
+                self._scan_expr(stmt.test, consumed, imports, out, module)
+                a, b = set(consumed), set(consumed)
+                self._scan(stmt.body, a, imports, out, module)
+                self._scan(stmt.orelse, b, imports, out, module)
+                # a branch that leaves the function contributes nothing
+                # to the fall-through state (early-return guard draws
+                # must not poison the main path)
+                if not self._terminates(stmt.body):
+                    consumed |= a
+                if not self._terminates(stmt.orelse):
+                    consumed |= b
+                continue
+            if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                test = stmt.iter if isinstance(
+                    stmt, (ast.For, ast.AsyncFor)) else stmt.test
+                self._scan_expr(test, consumed, imports, out, module)
+                a = set(consumed)
+                self._scan(stmt.body, a, imports, out, module)
+                self._scan(stmt.orelse, a, imports, out, module)
+                consumed |= a
+                continue
+            if isinstance(stmt, ast.Try):
+                a = set(consumed)
+                self._scan(stmt.body, a, imports, out, module)
+                for h in stmt.handlers:
+                    self._scan(h.body, set(a), imports, out, module)
+                self._scan(stmt.orelse, a, imports, out, module)
+                self._scan(stmt.finalbody, a, imports, out, module)
+                consumed |= a
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._scan(stmt.body, consumed, imports, out, module)
+                continue
+            # expression statements / assignments: find draws in source
+            # order, then apply rebinds
+            self._scan_expr(stmt, consumed, imports, out, module)
+            for target in self._bound_names(stmt):
+                consumed.discard(target)
+
+    def _scan_expr(self, node, consumed: set[str], imports, out, module):
+        """Record draws in one expression/simple statement, without
+        descending into nested function scopes."""
+        if node is None:
+            return
+        for sub in walk_same_scope(node):
+            if isinstance(sub, ast.Call):
+                key = self._consumed_key(sub, imports)
+                if key is not None:
+                    if key in consumed:
+                        out.append(Violation(
+                            "rng-key-reuse", module.relpath, sub.lineno,
+                            f"key {key!r} already consumed by an "
+                            f"earlier draw in this function — split "
+                            f"or derive a named stream first"))
+                    else:
+                        consumed.add(key)
+
+    def _consumed_key(self, call: ast.Call, imports) -> str | None:
+        """The bare variable name this call consumes as a PRNG key, or
+        None when the call is not a draw / takes a derived key."""
+        chain = call_chain(call)
+        if not chain:
+            return None
+        tail = chain[-1]
+        origin = self._origin(chain, imports)
+        if origin in STREAM_API_ORIGINS:
+            return None  # addressing, not consumption — never a draw
+        is_draw = False
+        if origin in WRAPPER_DRAW_ORIGINS:
+            is_draw = True
+        elif tail in DRAW_FNS and tail not in DERIVE_FNS:
+            # qualify by resolved origin: only jax.random consumes keys
+            # — stdlib `random` and `numpy.random` draws take no key
+            # (they are the purity checker's problem), and a bare local
+            # helper named `normal` is not a key consumer
+            if origin.startswith("jax.random."):
+                is_draw = True
+        if not is_draw or not call.args:
+            return None
+        first = call.args[0]
+        if isinstance(first, ast.Name):
+            return first.id
+        return None
+
+    @staticmethod
+    def _terminates(stmts) -> bool:
+        """Does this block unconditionally leave the enclosing scope?"""
+        return bool(stmts) and isinstance(
+            stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+    @staticmethod
+    def _bound_names(stmt: ast.AST):
+        """Names (re)bound by this statement — a rebind resets the
+        consumed state of that name."""
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Store):
+                yield node.id
+
+    @staticmethod
+    def _origin(chain: tuple[str, ...], imports: dict[str, str]) -> str:
+        """Resolve a call chain to its dotted origin through the
+        module's import bindings (``jr.fold_in`` with ``import
+        jax.random as jr`` → ``jax.random.fold_in``)."""
+        root = imports.get(chain[0], chain[0])
+        return ".".join((root,) + chain[1:])

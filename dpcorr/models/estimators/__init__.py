@@ -18,19 +18,19 @@ full Monte-Carlo replication batch as one fused kernel.
 """
 
 from dpcorr.models.estimators.common import (  # noqa: F401
-    batch_geometry,
     CorrResult,
-)
-from dpcorr.models.estimators.ni_sign import (  # noqa: F401
-    correlation_ni_signbatch,
-    ci_ni_signbatch,
+    batch_geometry,
 )
 from dpcorr.models.estimators.int_sign import (  # noqa: F401
-    correlation_int_signflip,
     ci_int_signflip,
+    correlation_int_signflip,
+)
+from dpcorr.models.estimators.int_subg import ci_int_subg  # noqa: F401
+from dpcorr.models.estimators.ni_sign import (  # noqa: F401
+    ci_ni_signbatch,
+    correlation_ni_signbatch,
 )
 from dpcorr.models.estimators.ni_subg import correlation_ni_subg  # noqa: F401
-from dpcorr.models.estimators.int_subg import ci_int_subg  # noqa: F401
 from dpcorr.models.estimators.registry import (  # noqa: F401
     FAMILIES,
     serving_entry,

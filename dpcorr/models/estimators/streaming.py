@@ -51,7 +51,7 @@ from dpcorr.models.estimators.common import CorrResult, batch_geometry
 from dpcorr.ops.lambdas import lambda_int_n, lambda_n
 from dpcorr.ops.noise import clip_sym, laplace
 from dpcorr.ops.standardize import priv_moments_from_sums
-from dpcorr.utils.rng import stream
+from dpcorr.utils.rng import chunk_key, stream
 
 ChunkFn = Callable[[jax.Array], jax.Array]  # c -> (n_chunk, 2)
 
@@ -83,7 +83,7 @@ def dgp_chunk_fn(dgp_fn: Callable, key: jax.Array, n_chunk: int, rho) -> ChunkFn
     deterministic)."""
 
     def chunk_fn(c):
-        return dgp_fn(jax.random.fold_in(key, c), n_chunk, rho)
+        return dgp_fn(chunk_key(key, c), n_chunk, rho)
 
     return chunk_fn
 
@@ -288,7 +288,7 @@ def ci_int_signflip_stream(key: jax.Array, chunk_fn: ChunkFn, n: int,
 
     def chunk_stats(c):
         xy = chunk_fn(c)
-        s = jax.random.bernoulli(jax.random.fold_in(flip_base, c), p_keep,
+        s = jax.random.bernoulli(chunk_key(flip_base, c), p_keep,
                                  (n_chunk,))
         core = ((2.0 * s.astype(jnp.float32) - 1.0)
                 * jnp.sign(sx(xy[:, 0])) * jnp.sign(sy(xy[:, 1])))
@@ -322,7 +322,7 @@ def _int_subg_chunk_stats(xy, c, noise_base, sender_is_x: bool, lam_s,
     past n masked to 0."""
     xs = xy[:, 0] if sender_is_x else xy[:, 1]
     xo = xy[:, 1] if sender_is_x else xy[:, 0]  # v1: other NOT clipped
-    noise = laplace(jax.random.fold_in(noise_base, c), (n_chunk,),
+    noise = laplace(chunk_key(noise_base, c), (n_chunk,),
                     2.0 * lam_s / eps_s)
     uc = clip_sym((clip_sym(xs, lam_s) + noise) * xo, lam_r)
     w = (c * n_chunk + jnp.arange(n_chunk)) < n

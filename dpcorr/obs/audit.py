@@ -43,9 +43,9 @@ class AuditTrail:
     def __init__(self, path: str | None = None):
         self.path = path
         self._lock = threading.Lock()
-        self._seq = 0
-        self._mem: list[dict] = []
-        self._fh = None
+        self._seq = 0  # guarded by: _lock
+        self._mem: list[dict] = []  # guarded by: _lock
+        self._fh = None  # guarded by: _lock
         if path is not None:
             d = os.path.dirname(path)
             if d:

@@ -82,7 +82,7 @@ class _Metric:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
-        self._children: dict[tuple, float] = {}
+        self._children: dict[tuple, float] = {}  # guarded by: _lock
 
     def _key(self, labels: Mapping[str, str] | None) -> tuple:
         labels = labels or {}
@@ -166,9 +166,9 @@ class Histogram:
         # the +Inf bucket is implicit: _count plays its role
         self.buckets = tuple(b for b in bs if not math.isinf(b))
         self._lock = threading.Lock()
-        self._bucket_counts = [0] * len(self.buckets)
-        self._sum = 0.0
-        self._count = 0
+        self._bucket_counts = [0] * len(self.buckets)  # guarded by: _lock
+        self._sum = 0.0  # guarded by: _lock
+        self._count = 0  # guarded by: _lock
 
     def observe(self, value: float) -> None:
         v = float(value)
@@ -215,7 +215,7 @@ class Registry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: dict[str, object] = {}
+        self._metrics: dict[str, object] = {}  # guarded by: _lock
 
     def _register(self, cls, name, help, **kw):
         with self._lock:

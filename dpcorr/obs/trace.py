@@ -122,7 +122,7 @@ class Tracer:
         self.path = path
         self.enabled = path is not None
         self._lock = threading.Lock()
-        self._fh = None
+        self._fh = None  # guarded by: _lock
         if self.enabled:
             d = os.path.dirname(path)
             if d:

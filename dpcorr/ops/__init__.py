@@ -4,20 +4,20 @@ Laplace noise, clipping, clipping-threshold (λ) rules, mixture quantiles and
 DP standardization, each as pure vmap-able JAX functions.
 """
 
-from dpcorr.ops.noise import laplace, clip, clip_sym  # noqa: F401
 from dpcorr.ops.lambdas import (  # noqa: F401
-    lambda_n,
-    lambda_int_n,
     lambda_from_priv,
+    lambda_int_n,
+    lambda_n,
     lambda_receiver_from_noise,
 )
 from dpcorr.ops.mixquant import mixquant, mixquant_mc  # noqa: F401
+from dpcorr.ops.noise import clip, clip_sym, laplace  # noqa: F401
 from dpcorr.ops.standardize import (  # noqa: F401
-    priv_standardize,
+    dp_mean,
+    dp_sd,
+    dp_second_moment,
     priv_center,
     priv_mean_from_sum,
-    dp_mean,
-    dp_second_moment,
-    dp_sd,
+    priv_standardize,
     standardize_dp,
 )

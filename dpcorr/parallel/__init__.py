@@ -13,13 +13,13 @@ invert, TPU-style (SURVEY.md §2.3):
   shape buckets; DCN carries nothing but the final file-system merge).
 """
 
-from dpcorr.parallel.mesh import rep_mesh, local_device_count  # noqa: F401
 from dpcorr.parallel.backend import (  # noqa: F401
     make_serve_batch_sharded,
-    run_detail_sharded,
     run_detail_flat_sharded,
+    run_detail_sharded,
     run_summary_sharded,
 )
+from dpcorr.parallel.mesh import local_device_count, rep_mesh  # noqa: F401
 from dpcorr.parallel.multihost import (  # noqa: F401
     grid_slice,
     run_grid_host,

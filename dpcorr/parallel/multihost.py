@@ -24,8 +24,8 @@ reference's mclapply-over-grid × vectorized-reps split.
 
 from __future__ import annotations
 
-import json
 import dataclasses
+import json
 import os
 import subprocess
 import sys

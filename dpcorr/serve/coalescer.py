@@ -85,9 +85,9 @@ class Coalescer:
         self.max_queue = max_queue
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._buckets: dict[tuple, list[_Pending]] = {}
-        self._depth = 0
-        self._closed = False
+        self._buckets: dict[tuple, list[_Pending]] = {}  # guarded by: _cond
+        self._depth = 0  # guarded by: _cond
+        self._closed = False  # guarded by: _cond
         self._thread = threading.Thread(target=self._flush_loop,
                                         name="dpcorr-serve-flush",
                                         daemon=True)

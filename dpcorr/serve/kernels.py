@@ -78,7 +78,7 @@ class KernelCache:
         self.mode = mode
         self.max_kernels = max_kernels
         self._lock = threading.Lock()
-        self._fns: OrderedDict[tuple, Callable] = OrderedDict()
+        self._fns: OrderedDict[tuple, Callable] = OrderedDict()  # guarded by: _lock
 
     def _n_shards(self, b_pad: int) -> int:
         """How many mesh shards this launch uses (1 = unsharded)."""

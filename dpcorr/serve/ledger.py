@@ -109,7 +109,7 @@ class PrivacyLedger:
         self.path = path
         self.audit = audit
         self._lock = threading.Lock()
-        self._spent: dict[str, float] = {}
+        self._spent: dict[str, float] = {}  # guarded by: _lock
         self._events = self._spent_gauge = None
         if registry is not None:
             self._events = registry.counter(

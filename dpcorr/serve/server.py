@@ -124,7 +124,7 @@ class DpcorrServer:
                                    max_delay_s=max_delay_s,
                                    max_queue=max_queue,
                                    tracer=self.tracer)
-        self._master = None
+        self._master = None  # guarded by: _master_lock
         self._master_lock = threading.Lock()
         self._req_counter = itertools.count()
         # fresh per construction: makes counter-assigned streams unique

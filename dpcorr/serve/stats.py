@@ -97,7 +97,7 @@ class ServeStats:
             "Admission-to-completion request latency",
             buckets=LATENCY_BUCKETS)
         self._lock = threading.Lock()
-        self._latencies: deque[float] = deque(maxlen=reservoir)
+        self._latencies: deque[float] = deque(maxlen=reservoir)  # guarded by: _lock
 
     # -- legacy attribute reads (tests, report layer) --------------------
     @property

@@ -58,6 +58,16 @@ def design_key(key: jax.Array, design_index: int | jax.Array) -> jax.Array:
     return jax.random.fold_in(key, design_index)
 
 
+def chunk_key(key: jax.Array, chunk_index: int | jax.Array) -> jax.Array:
+    """Key for one streaming n-chunk (streaming.py rematerialization):
+    the fold-on-index rung of the tree for data-parallel indices below a
+    named stream. Same derivation as :func:`design_key` — kept as its
+    own entry so call sites say which axis they fold over, and so the
+    key-tree discipline stays checkable (`dpcorr lint` rng-raw-api
+    forbids raw ``fold_in`` outside this module)."""
+    return jax.random.fold_in(key, chunk_index)
+
+
 def rep_keys(key: jax.Array, n_reps: int) -> jax.Array:
     """Vector of per-replication keys, shape ``(n_reps,)``.
 

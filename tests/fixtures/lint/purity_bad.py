@@ -1,0 +1,34 @@
+"""Violating fixture: both purity rules fire in here."""
+
+import time
+
+import jax
+
+_cache = {}
+
+
+@jax.jit
+def stamped(x):
+    return x * time.time()  # jit-impure-call
+
+
+def printy(x):
+    print("tracing", x)  # jit-impure-call (traced via jax.jit below)
+    return x
+
+
+traced = jax.jit(printy)
+
+
+@jax.jit
+def memoized(x):
+    _cache["last"] = x  # jit-closure-mutation
+    return x
+
+
+def scanned(xs):
+    def body(carry, x):
+        _cache.update(last=x)  # jit-closure-mutation (lax.scan traces)
+        return carry + x, x
+
+    return jax.lax.scan(body, 0.0, xs)

@@ -1,0 +1,26 @@
+"""Clean fixture: pure traced functions; host effects outside traces."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure(x):
+    return jnp.sum(x * 2.0)
+
+
+def host_side(x):
+    print("outside any trace", x)
+    return x
+
+
+def scan_body(carry, x):
+    acc = {}
+    acc["x"] = x  # local mutation is fine — acc is bound in-scope
+    return carry + x, acc["x"]
+
+
+def run(xs):
+    out, ys = jax.lax.scan(scan_body, 0.0, xs)
+    print("done", out)  # host side again: outside the traced body
+    return out, ys

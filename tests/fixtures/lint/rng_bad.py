@@ -1,0 +1,17 @@
+"""Violating fixture: every rng rule fires in here."""
+
+import jax
+
+
+def two_draws(key):
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,))  # rng-key-reuse
+    return a + b
+
+
+def literal_seed():
+    return jax.random.PRNGKey(42)  # rng-literal-seed + rng-raw-api
+
+
+def raw_fold(key):
+    return jax.random.fold_in(key, 3)  # rng-raw-api

@@ -1,0 +1,42 @@
+"""Clean fixture: the sanctioned key-handling patterns."""
+
+import jax
+
+from dpcorr.utils import rng
+
+
+def split_draws(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (3,))
+    b = jax.random.uniform(k2, (3,))
+    return a + b
+
+
+def named_streams(key):
+    x = jax.random.normal(rng.stream(key, "x"), (3,))
+    y = jax.random.normal(rng.stream(key, "y"), (3,))
+    return x + y
+
+
+def rebind(key):
+    a = jax.random.normal(key, ())
+    key = rng.stream(key, "second")
+    b = jax.random.normal(key, ())
+    return a + b
+
+
+def exclusive_branches(key, flag):
+    if flag:
+        return jax.random.normal(key, ())
+    else:
+        return jax.random.laplace(key, ())
+
+
+def early_return_guard(key, flag):
+    if flag:
+        return jax.random.normal(key, ())
+    return jax.random.laplace(key, ())
+
+
+def configured_seed(seed):
+    return rng.master_key(seed)

@@ -1,0 +1,16 @@
+"""Clean-by-suppression fixture: both comment placements."""
+
+import jax
+
+
+def inline(key):
+    return jax.random.fold_in(key, 7)  # dpcorr-lint: ignore[rng-raw-api]
+
+
+def standalone(key):
+    # dpcorr-lint: ignore[rng-raw-api]
+    return jax.random.fold_in(key, 8)
+
+
+def bare_ignore(key):
+    return jax.random.fold_in(key, 9)  # dpcorr-lint: ignore

@@ -1,0 +1,12 @@
+"""Violating fixture: both budget rules fire in here (the `serve/`
+path segment puts this file in the checker's scope)."""
+
+
+class Server:
+    def submit_uncharged(self, req):
+        self.coalescer.submit(req)  # budget-uncharged-noise
+        self.ledger.charge(req.party, req.eps)
+
+    def submit_no_refund(self, req):
+        self.ledger.charge(req.party, req.eps)
+        self.coalescer.submit(req)  # budget-missing-refund

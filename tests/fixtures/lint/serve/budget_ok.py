@@ -1,0 +1,19 @@
+"""Clean fixture: write-ahead charge, refund-guarded enqueue; and a
+below-admission function (no ledger in scope) that enqueues freely."""
+
+
+class Server:
+    def submit(self, req):
+        self.ledger.charge(req.party, req.eps)
+        try:
+            self.coalescer.submit(req)
+        except OverflowError:
+            self.ledger.refund(req.party, req.eps)
+            raise
+
+
+class Coalescer:
+    def submit(self, req):
+        # execution layer: requests arriving here are charged by
+        # contract, and no ledger is in scope
+        self.queue.append(req)

@@ -1,0 +1,21 @@
+"""Violating fixture: both lock rules fire in here."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded by: _lock
+
+    def bump(self):
+        self._n += 1  # lock-unguarded-write
+
+    def peek(self):
+        return self._n  # lock-unguarded-read
+
+    def flush_async(self):
+        with self._lock:
+            def worker():
+                self._n = 0  # closure escapes the guard: still a write
+            return worker

@@ -1,0 +1,37 @@
+"""Clean fixture: guarded accesses, the `__init__` and `*_locked`
+exemptions, and a Condition standing in for its wrapped lock."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded by: _lock
+        self._n = self._initial()  # __init__ is pre-concurrency
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        with self._lock:
+            return self._n
+
+    def _drain_locked(self):
+        # caller holds the lock (checked at the call sites)
+        return self._n
+
+    def _initial(self):
+        return 0
+
+
+class Queue:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []  # guarded by: _cond
+
+    def put(self, item):
+        with self._cond:
+            self._items.append(item)
+            self._cond.notify()

@@ -1,0 +1,235 @@
+"""dpcorr lint (docs/STATIC_ANALYSIS.md): fixture-driven rule checks,
+suppression and baseline mechanics, CLI exit codes, jax-freeness, and
+the meta-test that the shipped tree itself is lint-clean.
+
+The fixture pairs under tests/fixtures/lint/ are the per-rule contract:
+every `*_bad.py` line annotated with a rule id must fire exactly that
+rule, every `*_ok.py` must stay silent.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from dpcorr.analysis import (
+    Violation,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from dpcorr.analysis.cli import main as lint_main
+
+REPO = Path(__file__).parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "lint"
+
+
+def lint_fixture(*names, rules=None):
+    return run_lint(list(names), str(FIXTURES), rule_filter=rules)
+
+
+def fired(violations):
+    return sorted((v.rule, v.line) for v in violations)
+
+
+# ------------------------------------------------------------ per rule ----
+def test_rng_bad_fixture_fires_every_rng_rule():
+    vs = lint_fixture("rng_bad.py")
+    assert fired(vs) == [
+        ("rng-key-reuse", 8),
+        ("rng-literal-seed", 13),
+        ("rng-raw-api", 13),  # PRNGKey is both a literal seed and raw API
+        ("rng-raw-api", 17),
+    ]
+
+
+def test_rng_ok_fixture_is_clean():
+    assert lint_fixture("rng_ok.py") == []
+
+
+def test_budget_bad_fixture_fires_both_budget_rules():
+    vs = lint_fixture("serve/budget_bad.py")
+    assert fired(vs) == [
+        ("budget-missing-refund", 12),
+        ("budget-uncharged-noise", 7),
+    ]
+
+
+def test_budget_ok_fixture_is_clean():
+    assert lint_fixture("serve/budget_ok.py") == []
+
+
+def test_locks_bad_fixture_fires_reads_and_writes():
+    vs = lint_fixture("serve/locks_bad.py")
+    assert fired(vs) == [
+        ("lock-unguarded-read", 15),
+        ("lock-unguarded-write", 12),
+        ("lock-unguarded-write", 20),  # closure escaping the guard
+    ]
+
+
+def test_locks_ok_fixture_is_clean():
+    assert lint_fixture("serve/locks_ok.py") == []
+
+
+def test_locks_scope_is_path_based():
+    """The same source outside serve//obs/ is out of the lock checker's
+    scope — the declaration comment alone must not fire elsewhere."""
+    src = (FIXTURES / "serve" / "locks_bad.py").read_text()
+    import dpcorr.analysis.core as core
+
+    module = core.Module("x.py", "models/locks_elsewhere.py", src)
+    from dpcorr.analysis.rules.locks import LockChecker
+
+    checker = LockChecker()
+    assert not checker.applies_to(module.relpath)
+
+
+def test_purity_bad_fixture_fires_both_purity_rules():
+    vs = lint_fixture("purity_bad.py")
+    assert fired(vs) == [
+        ("jit-closure-mutation", 25),
+        ("jit-closure-mutation", 31),
+        ("jit-impure-call", 12),
+        ("jit-impure-call", 16),
+    ]
+
+
+def test_purity_ok_fixture_is_clean():
+    assert lint_fixture("purity_ok.py") == []
+
+
+# ------------------------------------------------- suppression comments ----
+def test_suppression_comment_both_placements():
+    assert lint_fixture("rng_suppressed_ok.py") == []
+
+
+def test_suppression_is_rule_specific():
+    vs = run_lint(["rng_bad.py"], str(FIXTURES))
+    # the bad fixture has no ignore comments at all
+    assert len(vs) == 4
+    # an ignore[] for a *different* rule must not absorb the finding
+    src = (FIXTURES / "rng_bad.py").read_text()
+    patched = src.replace(
+        "# rng-raw-api", "# dpcorr-lint: ignore[rng-key-reuse]")
+    import dpcorr.analysis.core as core
+
+    module = core.Module("rng_bad.py", "rng_bad.py", patched)
+    assert not module.suppressed("rng-raw-api", 17)
+    assert module.suppressed("rng-key-reuse", 17)
+
+
+# ----------------------------------------------------------- rule filter ----
+def test_rule_filter_restricts_families():
+    vs = lint_fixture("rng_bad.py", "purity_bad.py", rules=["rng"])
+    assert {v.rule for v in vs} <= {"rng-key-reuse", "rng-literal-seed",
+                                    "rng-raw-api"}
+    with pytest.raises(ValueError, match="unknown checker"):
+        lint_fixture("rng_bad.py", rules=["nope"])
+
+
+# -------------------------------------------------------------- baseline ----
+def test_baseline_roundtrip_and_line_insensitivity(tmp_path):
+    vs = lint_fixture("rng_bad.py")
+    path = tmp_path / "baseline.json"
+    write_baseline(vs, str(path))
+    entries = load_baseline(str(path))
+    assert len(entries) == len(vs)
+    # exact refind: everything absorbed
+    new, matched, stale = apply_baseline(vs, entries)
+    assert (new, matched, stale) == ([], len(vs), [])
+    # line numbers move (pure edit above): entries still match on code
+    moved = [Violation(v.rule, v.path, v.line + 40, v.message, v.code)
+             for v in vs]
+    new, matched, stale = apply_baseline(moved, entries)
+    assert (new, matched) == ([], len(vs))
+
+
+def test_baseline_multiplicity_and_staleness():
+    v = Violation("r", "p.py", 3, "m", code="x = f(k)")
+    # two identical findings, one entry: the second is NEW
+    new, matched, stale = apply_baseline(
+        [v, v], [{"rule": "r", "path": "p.py", "code": "x = f(k)"}])
+    assert matched == 1 and len(new) == 1 and stale == []
+    # entry with no finding left: reported stale, never failing
+    new, matched, stale = apply_baseline(
+        [], [{"rule": "r", "path": "p.py", "code": "x = f(k)"}])
+    assert new == [] and stale[0]["rule"] == "r"
+
+
+# ------------------------------------------------------------ CLI driver ----
+def test_cli_exit_codes(tmp_path, capsys):
+    root = str(FIXTURES)
+    assert lint_main(["--root", root, "rng_ok.py"]) == 0
+    assert lint_main(["--root", root, "rng_bad.py"]) == 1
+    assert lint_main(["--root", root, "no_such_file.py"]) == 2
+    assert lint_main(["--root", root, "--rules", "nope", "rng_ok.py"]) == 2
+    capsys.readouterr()
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("rng-key-reuse", "budget-uncharged-noise",
+                 "lock-unguarded-write", "jit-impure-call"):
+        assert rule in out
+
+
+def test_cli_json_report(capsys):
+    rc = lint_main(["--root", str(FIXTURES), "--json", "rng_bad.py"])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert {v["rule"] for v in report["new"]} == {
+        "rng-key-reuse", "rng-literal-seed", "rng-raw-api"}
+
+
+def test_cli_write_then_pass_then_strict_stale(tmp_path, capsys):
+    root = str(FIXTURES)
+    bl = tmp_path / "bl.json"
+    assert lint_main(["--root", root, "--baseline", str(bl),
+                      "--write-baseline", "rng_bad.py"]) == 0
+    # grandfathered: gate passes
+    assert lint_main(["--root", root, "--baseline", str(bl),
+                      "rng_bad.py"]) == 0
+    # everything fixed: stale entries warn by default, fail with --strict
+    capsys.readouterr()
+    assert lint_main(["--root", root, "--baseline", str(bl),
+                      "rng_ok.py"]) == 0
+    assert "stale" in capsys.readouterr().out
+    assert lint_main(["--root", root, "--baseline", str(bl),
+                      "--strict", "rng_ok.py"]) == 1
+
+
+# ------------------------------------------------------------- meta-tests ----
+def test_repo_is_lint_clean_modulo_baseline():
+    """The shipped tree has no violations beyond the committed
+    baseline — the same gate CI applies (`python -m dpcorr lint`)."""
+    vs = run_lint(["dpcorr"], str(REPO))
+    baseline = REPO / ".dpcorr-lint-baseline.json"
+    entries = load_baseline(str(baseline)) if baseline.exists() else []
+    new, _, _ = apply_baseline(vs, entries)
+    assert new == [], "\n".join(v.render() for v in new)
+
+
+def test_lint_is_jax_free():
+    """The linter import chain and a full CLI run never touch jax —
+    the CI lint job runs on a jax-less interpreter. -S skips the site
+    hook that preloads jax unconditionally (see test_doctor.py)."""
+    r = subprocess.run(
+        [sys.executable, "-S", "-c",
+         "import sys; sys.path.insert(0, '.'); "
+         "from dpcorr.analysis import cli; "
+         "rc = cli.main(['--root', '.', 'dpcorr/analysis']); "
+         "assert 'jax' not in sys.modules, 'lint pulled jax'; "
+         "sys.exit(rc)"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
+
+
+def test_module_cli_entrypoint():
+    """`python -m dpcorr lint` end-to-end in the repo: exit 0."""
+    r = subprocess.run([sys.executable, "-m", "dpcorr", "lint"],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
+    assert "0 new violations" in r.stdout

@@ -11,8 +11,8 @@ import threading
 import pytest
 
 from dpcorr.obs import (
-    AuditTrail,
     LATENCY_BUCKETS,
+    AuditTrail,
     Registry,
     Tracer,
     parse_exposition,

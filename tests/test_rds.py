@@ -138,8 +138,8 @@ def test_named_list_dataframe_roundtrip():
         ("row.names", lambda: w.intsxp([None, -3])),
         ("class", lambda: w.strsxp(["tbl_df", "tbl", "data.frame"])),
     ])
-    import io as _io
-    import tempfile, os
+    import os
+    import tempfile
     buf = w.bytes()
     with tempfile.NamedTemporaryFile(suffix=".rds", delete=False) as f:
         f.write(gzip.compress(buf))

@@ -12,10 +12,9 @@ import json
 import threading
 import urllib.request
 
+import jax
 import numpy as np
 import pytest
-
-import jax
 
 from dpcorr.models.estimators.registry import FAMILIES, serving_entry
 from dpcorr.serve import (
@@ -25,8 +24,8 @@ from dpcorr.serve import (
     InProcessClient,
     KernelCache,
     PrivacyLedger,
-    ServeStats,
     ServerOverloadedError,
+    ServeStats,
     make_http_server,
     pinned_request_key,
     request_charges,

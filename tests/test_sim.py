@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from dpcorr.sim import DETAIL_FIELDS, SimConfig, SimResult, run_sim_one
+from dpcorr.sim import DETAIL_FIELDS, SimConfig, run_sim_one
 from dpcorr.utils import rng
 
 

@@ -11,10 +11,17 @@ died with rc=1 and no number): the measurement runs in a *worker subprocess*
 under a wall-clock timeout; the orchestrator process never initializes a JAX
 backend itself. Sequence:
 
-1. TPU worker (full budget). On timeout/crash: one retry with a smaller
-   budget (a slow first init sometimes succeeds the second time, cached).
-2. CPU worker fallback, recorded with ``degraded: "tpu-init-failed"``.
-3. If even that fails, a valid JSON line with value 0 and the error trail.
+1. Bounded tunnel-health probe (one matmul in a throwaway process
+   group). If it FAILS, both TPU attempts are skipped outright — the
+   probe is the same program a worker would run first, so attempting
+   anyway only buys two guaranteed timeouts — and the run degrades
+   straight to CPU with ``degraded: "tpu-probe-failed"`` plus a relay
+   snapshot (dead vs up-but-wedged) in the forensics.
+2. TPU worker (full budget, long leash — the healthy probe proved the
+   tunnel alive). On timeout/crash: one retry with a smaller budget (a
+   slow first init sometimes succeeds the second time, cached).
+3. CPU worker fallback, recorded with ``degraded: "tpu-init-failed"``.
+4. If even that fails, a valid JSON line with value 0 and the error trail.
 
 Exit code is 0 in every case — the driver always receives a parseable
 measurement plus the failure forensics in ``detail``.
@@ -505,53 +512,61 @@ def main() -> None:
         loadavg_start = round(os.getloadavg()[0], 2)
     except OSError:
         loadavg_start = None
-    # Attempt 1: TPU, full budget, XLA path only. Init alone can take
-    # minutes through the tunnel; the timeout bounds init + compile + the
-    # measurement and scales with the requested budget so a long --budget
-    # isn't killed mid-measurement. A positive health probe extends the
-    # leash (tunnel alive ⇒ timeouts would only kill slow-but-working
-    # runs); a negative one keeps it short for a fast CPU degrade.
     swept = _sweep_stranded_clients()
     healthy = _health_probe()
     relay_state = None
+    out = err = None
     if not healthy:
         # Snapshot the relay endpoint NOW, not at artifact-write time —
-        # the tpu attempts and cpu fallback below can take 10+ minutes,
-        # and an infra redial in that window would otherwise
-        # misattribute the probe failure (dead endpoint vs
-        # endpoint-up-but-chip-wedged, STATUS_r04.md). The snapshot is
-        # recorded as forensics in the artifact AND selects the leash
-        # ladder's shortest rung below — decision-changing, keep it
-        # exactly here.
+        # the cpu fallback below can take minutes, and an infra redial
+        # in that window would otherwise misattribute the probe failure
+        # (dead endpoint vs endpoint-up-but-chip-wedged, STATUS_r04.md).
         try:
             from dpcorr.utils.doctor import check_relay
 
             relay_state = "up" if check_relay()["alive"] else "dead"
         except Exception:
             pass
-    # Leash ladder, by evidence strength: healthy probe ⇒ patience (900);
-    # failed probe ⇒ short (420); failed probe AND the relay's TCP ports
-    # refusing ⇒ shortest (200) — jax init hangs its full leash even on
-    # connection-refused (measured 495 s + 295 s against a dead endpoint,
-    # STATUS_r04.md rehearsal), and ports-refused is a strictly stronger
-    # death signal than a probe timeout. Both real attempts still run:
-    # a stale port list degrades to a 200 s first try, never to a skip.
-    first_base = 900 if healthy else (200 if relay_state == "dead" else 420)
-    out, err = _run_worker("tpu", timeout_s=first_base + 2.5 * args.budget,
-                           budget_s=args.budget)
+        # A failed probe skips the TPU attempts entirely and degrades
+        # straight to CPU. The probe is the same one-matmul program a
+        # worker would run first — if IT can't finish in 150 s, a real
+        # worker won't either, and the old shortened-leash ladder still
+        # paid 420 s + 270 s (or 200 s + 270 s on connection-refused,
+        # the two leashes measured 495 s + 295 s in the STATUS_r04
+        # dead-endpoint rehearsal) of guaranteed timeout before the
+        # number the round was always going to report. The skip is
+        # recorded in the attempt trail and the relay snapshot keeps
+        # the dead-vs-wedged forensics the ladder used to encode.
+        attempts.append("tpu worker: skipped (health probe failed"
+                        + (f", relay {relay_state}" if relay_state else "")
+                        + ")")
+    else:
+        # Attempt 1: TPU, full budget, XLA path only. Init alone can
+        # take minutes through the tunnel; the timeout bounds init +
+        # compile + the measurement and scales with the requested budget
+        # so a long --budget isn't killed mid-measurement. The healthy
+        # probe bought the long leash: the tunnel is alive, so a timeout
+        # here would only kill a slow-but-working run (the r02 round
+        # lost its headline exactly that way).
+        out, err = _run_worker("tpu", timeout_s=900 + 2.5 * args.budget,
+                               budget_s=args.budget)
+        if out is None:
+            attempts.append(err)
+            # Retry once, smaller budget — a compile cache or
+            # late-arriving backend sometimes makes the second attempt
+            # succeed.
+            retry_budget = min(10.0, args.budget)
+            out, err = _run_worker("tpu",
+                                   timeout_s=270 + 2.5 * retry_budget,
+                                   budget_s=retry_budget)
+        if out is not None:
+            # Pallas probe as a *sibling* worker after the tpu worker
+            # exited (own TPU client; a Mosaic hang loses only this
+            # probe).
+            _merge_pallas(out, args.budget)
+        else:
+            attempts.append(err)
     if out is None:
-        attempts.append(err)
-        # Retry once, smaller budget — a compile cache or late-arriving
-        # backend sometimes makes the second attempt succeed.
-        retry_budget = min(10.0, args.budget)
-        out, err = _run_worker("tpu", timeout_s=270 + 2.5 * retry_budget,
-                               budget_s=retry_budget)
-    if out is not None:
-        # Pallas probe as a *sibling* worker after the tpu worker exited
-        # (own TPU client; a Mosaic hang loses only this probe).
-        _merge_pallas(out, args.budget)
-    if out is None:
-        attempts.append(err)
         # Full budget, not a 10 s stub: the degraded artifact is the
         # round's official number when the tunnel is dead, and r04's
         # 10 s fallback measured only ~3 blocks — too few to amortize
@@ -563,7 +578,11 @@ def main() -> None:
         out, err = _run_worker("cpu", timeout_s=200 + 2.5 * args.budget,
                                budget_s=args.budget)
         if out is not None:
-            out["detail"]["degraded"] = "tpu-init-failed"
+            # two distinct degrade markers: "tpu-probe-failed" (never
+            # attempted — the probe said no) vs "tpu-init-failed" (both
+            # real attempts ran and died)
+            out["detail"]["degraded"] = ("tpu-init-failed" if healthy
+                                         else "tpu-probe-failed")
             here = os.path.dirname(os.path.abspath(__file__))
             for evidence_rel in ("benchmarks/results/r05_tpu_headline.json",
                                  "benchmarks/results/r04_tpu_headline.json",

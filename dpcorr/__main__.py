@@ -127,7 +127,7 @@ def cmd_grid(args):
 
     gcfg = GridConfig(b=args.b or 250, seed=args.seed, backend=args.backend,
                       fused=args.fused, bucket_merge=args.bucket_merge,
-                      out_dir=args.out)
+                      precompile=args.precompile, out_dir=args.out)
     _run_grid(args, gcfg, fig1_n=1500, fig1_eps=(1.5, 0.5))
 
 
@@ -138,7 +138,8 @@ def cmd_grid_subg(args):
         n_grid=(2500, 4000, 6000, 9000, 12000),  # ver-cor-subG.R:245
         b=args.b or 250, dgp="bounded_factor", use_subg=True,
         seed=args.seed, backend=args.backend, fused=args.fused,
-        bucket_merge=args.bucket_merge, out_dir=args.out)
+        bucket_merge=args.bucket_merge, precompile=args.precompile,
+        out_dir=args.out)
     # the reference's subG fig1 slices n=6000 (ver-cor-subG.R:342)
     _run_grid(args, gcfg, fig1_n=6000, fig1_eps=(1.5, 0.5), family="subg")
 
@@ -224,13 +225,25 @@ def cmd_serve(args):
         # the process tracer, so grid/profiling spans from in-server
         # kernels land in the same log as the serve lifecycle spans
         obs_trace.configure(args.trace)
+    # exported-executable persistence rides the same opt-in cache dir as
+    # the XLA persistent cache (DPCORR_COMPILE_CACHE; doctor reports it)
+    # — one knob, one directory tree, both warm layers on or off together
+    export_dir = None
+    if args.aot == "on":
+        from dpcorr.utils.doctor import resolve_cache_dir
+
+        cache_dir = resolve_cache_dir("cli")
+        if cache_dir:
+            export_dir = os.path.join(cache_dir, "exported")
     server = DpcorrServer(
         budget=args.budget, ledger_path=args.ledger,
         seed=args.seed, max_batch=args.max_batch,
         max_delay_s=args.max_delay_ms / 1000.0,
         max_queue=args.max_queue, shard=args.shard,
         batch_mode=args.batch_mode, max_kernels=args.max_kernels,
-        audit=args.audit)
+        audit=args.audit, warmup=args.warmup,
+        warmup_manifest=args.warmup_manifest,
+        aot=args.aot == "on", export_dir=export_dir)
     print(json.dumps({"serving": {"host": args.host, "port": args.port,
                                   "budget": args.budget,
                                   "ledger": args.ledger,
@@ -238,7 +251,11 @@ def cmd_serve(args):
                                   "max_delay_ms": args.max_delay_ms,
                                   "batch_mode": args.batch_mode,
                                   "trace": args.trace,
-                                  "audit": args.audit}}),
+                                  "audit": args.audit,
+                                  "warmup": server.readiness(),
+                                  "warmup_manifest": args.warmup_manifest,
+                                  "aot": args.aot,
+                                  "export_dir": export_dir}}),
           flush=True)
     serve_http(server, host=args.host, port=args.port)
 
@@ -377,6 +394,22 @@ def main(argv=None):
     ps_.add_argument("--audit", default=None,
                      help="privacy-budget audit-trail JSONL path; replay "
                           "it with `dpcorr obs budget --audit PATH`")
+    ps_.add_argument("--warmup", default=None,
+                     help="compile-ahead signature spec, entries "
+                          "family:n:eps1:eps2[:bpads[:alpha[:normalise]]] "
+                          "separated by ';' (bpads: comma list or 'auto' "
+                          "= every pow2 up to --max-batch); compiled in "
+                          "the background behind GET /readyz "
+                          "(docs/SERVING.md)")
+    ps_.add_argument("--warmup-manifest", dest="warmup_manifest",
+                     default=None,
+                     help="kernel-manifest JSON path: replayed as warmup "
+                          "on boot, rewritten with the resident kernel "
+                          "set on shutdown — restarts come up warm")
+    ps_.add_argument("--aot", default="on", choices=["on", "off"],
+                     help="ahead-of-time kernel compilation (utils."
+                          "compile); 'off' reverts to lazy jit on first "
+                          "flush (A/B measurement)")
     ps_.set_defaults(fn=cmd_serve)
 
     po_ = sub.add_parser("obs", help="telemetry tooling: audit-trail "
@@ -451,6 +484,16 @@ def main(argv=None):
                                 "+ in-kernel batch geometry — "
                                 "GridConfig.bucket_merge; subG + "
                                 "--backend bucketed only)")
+            p.add_argument("--precompile", default="auto",
+                           choices=["off", "auto", "on"],
+                           help="AOT-precompile bucket kernels on a "
+                                "thread pool during the phase-0 cache "
+                                "scan, overlapped with dispatch "
+                                "(bit-identical results — "
+                                "GridConfig.precompile; --backend "
+                                "bucketed only, no-op elsewhere). auto "
+                                "enables it on >= 2-core hosts; on "
+                                "forces it")
         p.set_defaults(fn=fn)
     args = ap.parse_args(argv)
     if args.platform:

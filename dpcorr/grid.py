@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
 from pathlib import Path
 from typing import Any, Mapping, Sequence
@@ -30,6 +31,7 @@ import pandas as pd
 from dpcorr import sim as sim_mod
 from dpcorr.obs import trace as obs_trace
 from dpcorr.sim import SimConfig
+from dpcorr.utils import compile as compile_mod
 from dpcorr.utils import rng
 
 log = logging.getLogger("dpcorr.grid")
@@ -91,6 +93,24 @@ class GridConfig:
     #: resume caches are stamped "|geom=dyn" and never mix with "off"
     #: caches — the same contract as the fused stamps.
     bucket_merge: str = "off"
+    #: "off" | "auto" | "on": phase-0 parallel AOT precompilation of
+    #: bucket kernels (utils.compile). When active, phase 0 scans every
+    #: bucket's resume cache first, then submits one
+    #: ``jit(...).lower(shapes).compile()`` per bucket that will
+    #: actually dispatch to a small thread pool — XLA releases the GIL
+    #: while compiling, so bucket kernels compile concurrently with
+    #: each other and with the dispatch loop instead of serially at the
+    #: head of each bucket. The executable is the same HLO the lazy jit
+    #: would build, called at the exact dispatch shapes, so results are
+    #: bit-identical to "off" (any shape drift falls back to the jit
+    #: path). "auto" enables it only on hosts with >= 2 CPUs: with a
+    #: single core the overlap has nowhere to run and the pool
+    #: interleaving makes the grid ~8% SLOWER (measured,
+    #: benchmarks/results/r06_grid_precompile_cpu.json); "on" forces it
+    #: regardless (tests, A/B benchmarks). Single-device ``bucketed``
+    #: backend only; fused-Pallas buckets are skipped (their compile is
+    #: the Mosaic probe itself).
+    precompile: str = "auto"
     out_dir: str | None = None
     resume: bool = True
 
@@ -249,6 +269,51 @@ def _fused_bucket_ok(gcfg: GridConfig, cfg: SimConfig) -> str | None:
     return kind if use_ni_sign_pallas(cfg.n, cfg.eps1, cfg.eps2) else None
 
 
+def validate_precompile(precompile: str) -> None:
+    """Fail-fast for the precompile knob (value check only: unlike
+    fused/bucket_merge the knob is backend-agnostic — non-bucketed
+    backends simply never precompile)."""
+    if precompile not in ("off", "auto", "on"):
+        raise ValueError(
+            f"precompile must be 'off', 'auto' or 'on', got {precompile!r}")
+
+
+def _precompile_bucket(cfg: SimConfig, m: int, merged: bool, k_pad,
+                       observer, parent):
+    """Phase-0 pool worker: AOT-compile one bucket's flat kernel at its
+    exact dispatch shapes (utils.compile — XLA releases the GIL, so
+    workers compile concurrently with each other and with the main
+    thread's dispatch loop). Returns the compiled executable, called
+    with the dynamic args only, or None when AOT fell back — the
+    dispatch then takes the ordinary lazily-jitted path.
+
+    ``parent`` pins the ``kernel.compile`` span under the caller's
+    ``grid.run`` span: the pool thread's implicit span stack is empty.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    keys_aval = rng.key_aval(m)
+    f32 = jax.ShapeDtypeStruct((m,), jnp.float32)
+    if merged:
+        cfg_noeps = dataclasses.replace(cfg, rho=0.0, seed=0,
+                                        eps1=1.0, eps2=1.0)
+        fn, ok = compile_mod.aot_compile(
+            sim_mod._run_detail_flat_eps,
+            (cfg_noeps, keys_aval, f32, f32, f32, k_pad),
+            signature={"kernel": "_run_detail_flat_eps", "n": cfg.n,
+                       "m": m, "k_pad": k_pad},
+            observer=observer, parent=parent)
+    else:
+        cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
+        fn, ok = compile_mod.aot_compile(
+            sim_mod._run_detail_flat, (cfg_norho, keys_aval, f32),
+            signature={"kernel": "_run_detail_flat", "n": cfg.n,
+                       "eps1": cfg.eps1, "eps2": cfg.eps2, "m": m},
+            observer=observer, parent=parent)
+    return fn if ok else None
+
+
 def _raise_if_failed(failures, n_points: int):
     """Aggregate fail-loud raise shared by all backends (SURVEY.md §5)."""
     if failures:
@@ -291,12 +356,15 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
         return k_pad_for(n, [float(r.eps1) * float(r.eps2)
                              for r in bucket_rows])
 
-    def xla_dispatch(cfg, to_run, k_pad=None):
+    def xla_dispatch(cfg, to_run, k_pad=None, compiled=None):
         """The XLA bucket dispatch — single source for phase 1 and the
         fetch-time fused fallback, so both stay bit-identical to
         fused="off" by construction. In ε-merged mode ε rides as a
         per-element traced operand next to ρ (one compiled kernel per
-        n; GridConfig.bucket_merge)."""
+        n; GridConfig.bucket_merge). ``compiled`` is the phase-0 AOT
+        executable for this bucket, if any — same HLO as the jit path,
+        dynamic args only; a shape drift (TypeError) degrades to the
+        lazy jit call it would have made anyway."""
         keys = jnp.concatenate([
             rng.rep_keys(rng.design_key(master, int(r.i)), gcfg.b)
             for r in to_run])
@@ -307,6 +375,12 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                                            jnp.float32), gcfg.b)
             eps2s = jnp.repeat(jnp.asarray([r.eps2 for r in to_run],
                                            jnp.float32), gcfg.b)
+            if compiled is not None:
+                try:
+                    return compiled(keys, rhos, eps1s, eps2s)
+                except Exception as e:
+                    log.warning("precompiled merged kernel (n=%d) rejected"
+                                " args: %s -- jit path", cfg.n, e)
             cfg_noeps = dataclasses.replace(cfg, rho=0.0, seed=0,
                                             eps1=1.0, eps2=1.0)
             return sim_mod._run_detail_flat_eps(cfg_noeps, keys, rhos,
@@ -316,22 +390,36 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             from dpcorr.parallel import run_detail_flat_sharded
 
             return run_detail_flat_sharded(cfg_norho, keys, rhos, mesh=mesh)
+        if compiled is not None:
+            try:
+                return compiled(keys, rhos)
+            except Exception as e:
+                log.warning("precompiled kernel (n=%d eps=(%.2f,%.2f)) "
+                            "rejected args: %s -- jit path",
+                            cfg.n, cfg.eps1, cfg.eps2, e)
         return sim_mod._run_detail_flat(cfg_norho, keys, rhos)
 
-    # Phase 1 — dispatch every bucket without fetching: jit dispatch is
-    # asynchronous, so bucket j executes on-device while bucket j+1 is still
-    # compiling on the host (dispatch-ahead, VERDICT r1 weak #8). Outputs
-    # are a few KB of metrics per point, so keeping all buckets in flight
-    # costs almost no HBM.
-    pending = []
+    # Phase 0 — scan every bucket's resume cache up front and, when
+    # precompiling (GridConfig.precompile), submit each to-run bucket's
+    # AOT compile to a small thread pool. XLA releases the GIL while
+    # compiling, so by the time the dispatch loop reaches bucket j its
+    # kernel has been building since phase 0 — concurrently with the
+    # other buckets' compiles and with earlier buckets' key construction
+    # and launches.
+    # "auto" backs off on single-core hosts: the overlap needs a second
+    # core to run on; without one the pool only adds scheduling overhead
+    # (~8% measured — r06_grid_precompile_cpu.json). "on" forces it.
+    precompiling = (gcfg.backend == "bucketed"
+                    and (gcfg.precompile == "on"
+                         or (gcfg.precompile == "auto"
+                             and (os.cpu_count() or 1) >= 2)))
+    pool, pre_obs = None, None
+    parent_sp = obs_trace.current_span()
+    buckets = []
     bucket_keys = ["n"] if merged else ["n", "eps1", "eps2"]
     for _, grp in design.groupby(bucket_keys, sort=False):
         rows = list(grp.itertuples(index=False))
         t0 = time.perf_counter()
-        # one span per bucket compile+launch (parents under grid.run via
-        # the thread-local stack; a no-op null span when tracing is off)
-        dsp = tr.start_span("grid.dispatch", n=int(rows[0].n),
-                            points=len(rows))
         # Same fail-loud-per-point semantics as the local backend: a broken
         # bucket is recorded and the remaining buckets still run; one
         # aggregated RuntimeError is raised by run_grid at the end.
@@ -344,10 +432,10 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                                 if out_dir else None)
                      for r in rows}
 
-            # cfg/rows bound as defaults: the closure rides the pending
-            # tuple into phase 2, and the loop variables it would
-            # otherwise capture are function-scoped — by fetch time they
-            # hold the LAST bucket's values, not this one's
+            # cfg/rows/paths bound as defaults: the closures ride the
+            # bucket records into phases 1 and 2, and the loop variables
+            # they would otherwise capture are function-scoped — by then
+            # they hold the LAST bucket's values, not this one's
             def mk_stamps(suffix: str, cfg=cfg, rows=rows):
                 # ε replaced per row: in merged mode the bucket cfg
                 # carries only the FIRST row's ε (a no-op otherwise)
@@ -356,7 +444,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                             eps2=float(r.eps2))) + suffix
                         for r in rows}
 
-            def scan_cache(candidates, stamps):
+            def scan_cache(candidates, stamps, paths=paths):
                 to_run = []
                 for r in candidates:
                     i = int(r.i)
@@ -377,51 +465,111 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             stamps = mk_stamps("|fused=pallas" if fused
                                else merge_tag if merged else "")
             to_run = scan_cache(rows, stamps)
-            raw = None
-            if to_run and fused:
-                try:
-                    seeds = jnp.concatenate([
-                        rng.pallas_seeds(rng.design_key(master, int(r.i)),
-                                         gcfg.b)
-                        for r in to_run])
-                    rhos = jnp.repeat(
-                        jnp.asarray([r.rho for r in to_run], jnp.float32),
-                        gcfg.b)
-                    from dpcorr.ops import pallas_ni
-
-                    args = dict(cfg.dgp_args)
-                    raw = pallas_ni.sim_detail_pallas(
-                        seeds, rhos, cfg.n, cfg.eps1, cfg.eps2,
-                        mu=args.get("mu", (0.0, 0.0)),
-                        sigma=args.get("sigma", (1.0, 1.0)),
-                        alpha=cfg.alpha, ci_mode=cfg.ci_mode,
-                        normalise=cfg.normalise, interpret=False)
-                except Exception as e:
-                    # fused is best-effort: a lowering/compile failure on
-                    # this bucket's shape degrades to the XLA kernel (the
-                    # cache is re-scanned under the XLA stamps)
-                    log.warning(
-                        "fused kernel unavailable for bucket (n=%d "
-                        "eps=(%.2f,%.2f)): %s -- falling back to XLA",
-                        cfg.n, cfg.eps1, cfg.eps2, e)
-                    fused, raw = None, None
-                    stamps = mk_stamps("")
-                    to_run = scan_cache(to_run, stamps)
-            if to_run and raw is None:
-                raw = xla_dispatch(cfg, to_run, k_pad=bucket_k_pad)
         except Exception as e:
             log.error("bucket (n=%d eps=(%.2f,%.2f), %d points) failed "
-                      "at dispatch: %s",
+                      "at scan: %s",
                       rows[0].n, rows[0].eps1, rows[0].eps2, len(rows), e)
             failures.extend((int(r.i), e) for r in rows
                             if int(r.i) not in details)
-            dsp.set(error=type(e).__name__)
-            dsp.end()
             continue
-        dsp.set(points_run=len(to_run), fused=bool(fused))
-        dsp.end()
-        pending.append((rows, to_run, raw, stamps, paths, fused, cfg,
-                        mk_stamps, time.perf_counter() - t0))
+        fut = None
+        if precompiling and to_run and not fused:
+            if pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                pool = ThreadPoolExecutor(
+                    max_workers=min(8, max(2, os.cpu_count() or 1)),
+                    thread_name_prefix="dpcorr-grid-compile")
+                pre_obs = compile_mod.CompileObserver(tracer=tr)
+            fut = pool.submit(_precompile_bucket, cfg,
+                              len(to_run) * gcfg.b, merged,
+                              bucket_k_pad, pre_obs, parent_sp)
+        buckets.append((rows, to_run, stamps, paths, fused, cfg,
+                        mk_stamps, scan_cache, bucket_k_pad, fut,
+                        time.perf_counter() - t0))
+
+    # Phase 1 — dispatch every bucket without fetching: jit dispatch is
+    # asynchronous, so bucket j executes on-device while bucket j+1 is
+    # still compiling on the host (dispatch-ahead, VERDICT r1 weak #8);
+    # under precompile the compile itself already moved onto the phase-0
+    # pool and the dispatch just picks up the executable. Outputs are a
+    # few KB of metrics per point, so keeping all buckets in flight
+    # costs almost no HBM.
+    pending = []
+    try:
+        for (rows, to_run, stamps, paths, fused, cfg, mk_stamps,
+             scan_cache, bucket_k_pad, fut, scan_s) in buckets:
+            t0 = time.perf_counter()
+            # one span per bucket compile+launch (parents under grid.run
+            # via the thread-local stack; a no-op when tracing is off)
+            dsp = tr.start_span("grid.dispatch", n=int(rows[0].n),
+                                points=len(rows))
+            try:
+                raw = None
+                if to_run and fused:
+                    try:
+                        seeds = jnp.concatenate([
+                            rng.pallas_seeds(
+                                rng.design_key(master, int(r.i)), gcfg.b)
+                            for r in to_run])
+                        rhos = jnp.repeat(
+                            jnp.asarray([r.rho for r in to_run],
+                                        jnp.float32),
+                            gcfg.b)
+                        from dpcorr.ops import pallas_ni
+
+                        args = dict(cfg.dgp_args)
+                        raw = pallas_ni.sim_detail_pallas(
+                            seeds, rhos, cfg.n, cfg.eps1, cfg.eps2,
+                            mu=args.get("mu", (0.0, 0.0)),
+                            sigma=args.get("sigma", (1.0, 1.0)),
+                            alpha=cfg.alpha, ci_mode=cfg.ci_mode,
+                            normalise=cfg.normalise, interpret=False)
+                    except Exception as e:
+                        # fused is best-effort: a lowering/compile failure
+                        # on this bucket's shape degrades to the XLA
+                        # kernel (the cache is re-scanned under the XLA
+                        # stamps)
+                        log.warning(
+                            "fused kernel unavailable for bucket (n=%d "
+                            "eps=(%.2f,%.2f)): %s -- falling back to XLA",
+                            cfg.n, cfg.eps1, cfg.eps2, e)
+                        fused, raw = None, None
+                        stamps = mk_stamps("")
+                        to_run = scan_cache(to_run, stamps)
+                if to_run and raw is None:
+                    compiled = None
+                    if fut is not None:
+                        try:
+                            compiled = fut.result()
+                        except Exception as e:
+                            # precompile is an optimization, never a gate:
+                            # a worker crash degrades to the inline jit
+                            log.warning("bucket precompile (n=%d) failed:"
+                                        " %s -- inline jit", cfg.n, e)
+                    raw = xla_dispatch(cfg, to_run, k_pad=bucket_k_pad,
+                                       compiled=compiled)
+            except Exception as e:
+                log.error("bucket (n=%d eps=(%.2f,%.2f), %d points) "
+                          "failed at dispatch: %s",
+                          rows[0].n, rows[0].eps1, rows[0].eps2,
+                          len(rows), e)
+                failures.extend((int(r.i), e) for r in rows
+                                if int(r.i) not in details)
+                dsp.set(error=type(e).__name__)
+                dsp.end()
+                continue
+            dsp.set(points_run=len(to_run), fused=bool(fused),
+                    precompiled=fut is not None)
+            dsp.end()
+            pending.append((rows, to_run, raw, stamps, paths, fused, cfg,
+                            mk_stamps, scan_s + time.perf_counter() - t0,
+                            fut is not None))
+    finally:
+        if pool is not None:
+            # every submitted future was consumed above; shutdown only
+            # reaps worker threads (cancel covers an exceptional exit)
+            pool.shutdown(wait=False, cancel_futures=True)
 
     # Phase 2 — fetch in dispatch order; device-side failures surface here.
     # Per-bucket wall times overlap under dispatch-ahead (a later bucket's
@@ -431,7 +579,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
     t_fetch0 = time.perf_counter()
     total_ran = 0
     for (rows, to_run, raw, stamps, paths, fused, cfg, mk_stamps,
-         dispatch_s) in pending:
+         dispatch_s, precompiled) in pending:
         t0 = time.perf_counter()
         fsp = tr.start_span("grid.fetch", n=int(rows[0].n),
                             points=len(rows), points_run=len(to_run))
@@ -501,6 +649,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             "merged_eps_pairs": (len({(r.eps1, r.eps2) for r in rows})
                                  if merged else 1),
             "points": len(rows), "points_run": ran, "fused": fused,
+            "precompiled": precompiled,
             "seconds": dispatch_s + fetch_s,
             "dispatch_s": dispatch_s, "fetch_s": fetch_s,
         })
@@ -536,6 +685,7 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
     validate_fused(gcfg.fused, gcfg.backend)
     validate_bucket_merge(gcfg.bucket_merge, gcfg.backend, gcfg.use_subg,
                           gcfg.eps_pairs)
+    validate_precompile(gcfg.precompile)
     design = gcfg.design_points()
     master = rng.master_key(gcfg.seed)
     out_dir = Path(gcfg.out_dir) if gcfg.out_dir else None

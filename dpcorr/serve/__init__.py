@@ -17,6 +17,9 @@ up — each module's docstring carries its own contract:
   batch-fill ratio, latency percentiles, ε spend.
 - :mod:`coalescer` — the micro-batcher: per-bucket queues, size/age
   flush policy, backpressure, unbatched degradation.
+- :mod:`warmup`    — compile-ahead signature sets (``--warmup`` spec
+  parsing, kernel-cache manifest persistence) behind the ``/readyz``
+  readiness gate.
 - :mod:`server`    — composition root + in-process client + stdlib
   HTTP front end (``python -m dpcorr serve``).
 
@@ -51,3 +54,9 @@ from dpcorr.serve.server import (  # noqa: F401
     serve_http,
 )
 from dpcorr.serve.stats import ServeStats, percentiles  # noqa: F401
+from dpcorr.serve.warmup import (  # noqa: F401
+    load_manifest,
+    parse_warmup_spec,
+    save_manifest,
+    signatures_to_keys,
+)

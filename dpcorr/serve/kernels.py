@@ -10,6 +10,24 @@ replicate lane 0 (cheapest valid input) and are truncated before
 results leave this module, so they cost device FLOPs but never appear
 in responses.
 
+Compilation itself goes through the compile-ahead layer
+(:mod:`dpcorr.utils.compile`):
+
+- misses are **single-flight** — concurrent misses for one signature
+  wait on a single inflight compile (the pre-ISSUE-4 race had both
+  threads compiling and the second overwriting the first); the dedup
+  is observable as ``kernel_compile_dedup`` in stats. Distinct
+  signatures still compile concurrently (XLA releases the GIL).
+- kernels are **AOT-compiled** (``lower(avals).compile()``) at the
+  exact signature shapes, so the cost is paid at ``get`` time — which
+  warmup moves off the request path entirely (serve.server) — and
+  measured into ``dpcorr_compile_seconds`` / ``kernel.compile`` spans.
+- with ``export_dir`` set, unsharded compiled programs are serialized
+  via ``jax.export`` (version-gated, raw-key-data boundary — see
+  utils.compile) and replayed on the next boot, skipping even the
+  persistent-cache retrace. :meth:`manifest` lists the resident
+  signatures so a server can persist its working set on shutdown.
+
 Two batch engines (estimators.registry bit-reproducibility contract):
 
 - ``mode="exact"`` (default): ``jax.lax.map`` over the single-request
@@ -20,6 +38,10 @@ Two batch engines (estimators.registry bit-reproducibility contract):
   CPU; ``rho_hat`` still bit-identical, CI endpoints within 1 ulp of
   the scalar program (lanes bit-identical across widths ≥ 2, so results
   still don't depend on how requests were coalesced).
+
+The AOT artifact is the same engine program lazily-jit would build —
+identical HLO — so responses stay bit-identical to the pre-AOT path
+(pinned by tests/test_compile.py for all four families).
 
 When the process holds more than one device, flushes wide enough to
 split evenly are executed through
@@ -40,11 +62,23 @@ import numpy as np
 from dpcorr.models.estimators.registry import serving_entry
 from dpcorr.serve.request import KernelKey
 from dpcorr.serve.stats import ServeStats
+from dpcorr.utils import compile as compile_mod
+from dpcorr.utils import rng
 
 
 def pad_batch(b: int) -> int:
     """Next power of two ≥ b: the compiled batch-width bucket."""
     return 1 << (b - 1).bit_length() if b > 1 else 1
+
+
+def _pad_rows(a: np.ndarray, b_pad: int) -> np.ndarray:
+    """Pad the leading axis to ``b_pad`` lanes replicating row 0, in
+    ONE preallocated buffer — the previous ``np.concatenate`` +
+    ``jnp.asarray`` pair copied every padded flush twice."""
+    out = np.empty((b_pad,) + a.shape[1:], dtype=a.dtype)
+    out[:a.shape[0]] = a
+    out[a.shape[0]:] = a[0]
+    return out
 
 
 class KernelCache:
@@ -58,15 +92,24 @@ class KernelCache:
     live compilations: signatures include the exact n, so a client
     sweeping sample sizes would otherwise grow the kernel set without
     limit in a long-running server. ``max_kernels`` caps it with LRU
-    eviction (evicting our reference also releases the underlying jit
-    wrapper and its executables); the live count is a stats gauge
+    eviction (evicting our reference also releases the underlying
+    executables); the live count is a stats gauge
     (``kernel_cache_size``). Steady-state traffic — a working set
     smaller than the cap — still never recompiles.
+
+    ``aot=False`` turns the compile-ahead layer off (plain lazy jit —
+    the pre-ISSUE-4 behavior, kept for A/B measurement);
+    ``export_dir`` opts into ``jax.export`` persistence of compiled
+    programs across restarts. ``_compile_hook`` (test seam) is invoked
+    by the *leader* build of each signature, so a thread-race test can
+    count actual compilations.
     """
 
     def __init__(self, stats: ServeStats | None = None,
                  shard: str = "auto", mode: str = "exact",
-                 max_kernels: int = 128):
+                 max_kernels: int = 128, aot: bool = True,
+                 export_dir: str | None = None,
+                 tracer=None):
         if shard not in ("auto", "off"):
             raise ValueError(f"shard must be 'auto' or 'off', got {shard!r}")
         if mode not in ("exact", "vector"):
@@ -77,6 +120,12 @@ class KernelCache:
         self.shard = shard
         self.mode = mode
         self.max_kernels = max_kernels
+        self.aot = aot
+        self.export_dir = export_dir
+        self._cobs = compile_mod.CompileObserver(
+            registry=self.stats.registry, tracer=tracer)
+        self._flight = compile_mod.SingleFlight()
+        self._compile_hook: Callable | None = None  # test seam
         self._lock = threading.Lock()
         self._fns: OrderedDict[tuple, Callable] = OrderedDict()  # guarded by: _lock
 
@@ -93,9 +142,11 @@ class KernelCache:
         return n_dev if n_dev > 1 and b_pad % n_dev == 0 else 1
 
     def get(self, kkey: KernelKey, b_pad: int) -> tuple[Callable, int]:
-        """The compiled kernel for this signature + its shard count."""
-        import jax
+        """The compiled kernel for this signature + its shard count.
 
+        Misses are single-flight: one build per concurrently-missed
+        signature, followers share the leader's result (and count into
+        ``kernel_compile_dedup`` instead of compiles/hits)."""
         shards = self._n_shards(b_pad)
         cache_key = (kkey, b_pad, shards)
         with self._lock:
@@ -104,27 +155,102 @@ class KernelCache:
                 self._fns.move_to_end(cache_key)  # LRU freshness
                 self.stats.kernel(hit=True)
                 return fn, shards
+
+        def build():
+            # leader path: compile, then install under the cache lock
+            # BEFORE the flight completes (SingleFlight publishes value
+            # before clearing the key), so no third thread can miss in
+            # between and rebuild
+            fn = self._build(kkey, b_pad, shards)
+            with self._lock:
+                self._fns[cache_key] = fn
+                self._fns.move_to_end(cache_key)
+                while len(self._fns) > self.max_kernels:
+                    self._fns.popitem(last=False)  # evict LRU
+                self.stats.kernel(hit=False)
+                self.stats.set_kernel_cache_size(len(self._fns))
+            return fn
+
+        fn, leader = self._flight.do(cache_key, build)
+        if not leader:
+            self.stats.kernel_dedup()
+        return fn, shards
+
+    # ------------------------------------------------------- building ----
+    def _signature(self, kkey: KernelKey, b_pad: int, shards: int) -> dict:
+        return {"family": kkey.family, "n": kkey.n,
+                "eps1": kkey.eps1, "eps2": kkey.eps2,
+                "b_pad": b_pad, "shards": shards, "mode": self.mode}
+
+    def _export_file(self, kkey: KernelKey, b_pad: int) -> str:
+        digest = compile_mod.signature_digest(
+            "serve", kkey.family, kkey.n, kkey.eps1, kkey.eps2,
+            kkey.alpha, kkey.normalise, b_pad, self.mode, rng.impl_tag())
+        return compile_mod.export_path(self.export_dir, digest)
+
+    def _build(self, kkey: KernelKey, b_pad: int, shards: int) -> Callable:
+        import jax
+
+        if self._compile_hook is not None:
+            self._compile_hook((kkey, b_pad, shards))
         single = serving_entry(kkey.family, kkey.eps1, kkey.eps2,
                                alpha=kkey.alpha, normalise=kkey.normalise)
         if shards > 1:
             from dpcorr.parallel import make_serve_batch_sharded
 
-            fn = make_serve_batch_sharded(single, engine=self.mode)
+            jfn = make_serve_batch_sharded(single, engine=self.mode)
         elif self.mode == "vector":
-            fn = jax.jit(jax.vmap(single))
+            jfn = jax.jit(jax.vmap(single))
         else:
-            fn = jax.jit(
+            jfn = jax.jit(
                 lambda keys, xs, ys: jax.lax.map(
                     lambda t: single(*t), (keys, xs, ys)))
-        with self._lock:
-            self._fns[cache_key] = fn
-            self._fns.move_to_end(cache_key)
-            while len(self._fns) > self.max_kernels:
-                self._fns.popitem(last=False)  # evict least-recently-used
-            self.stats.kernel(hit=False)
-            self.stats.set_kernel_cache_size(len(self._fns))
-        return fn, shards
+        if not self.aot:
+            return jfn
+        avals = (rng.key_aval(b_pad),
+                 jax.ShapeDtypeStruct((b_pad, kkey.n), np.float32),
+                 jax.ShapeDtypeStruct((b_pad, kkey.n), np.float32))
+        sig = self._signature(kkey, b_pad, shards)
+        # export replay first: a prior boot's serialized program skips
+        # tracing AND the XLA retrace of the persistent compile cache.
+        # Unsharded only — exported programs pin device assignments.
+        path = None
+        if self.export_dir and shards == 1:
+            path = self._export_file(kkey, b_pad)
+            call = compile_mod.load_exported(path)
+            if call is not None:
+                wrapped = jax.jit(
+                    lambda keys, xs, ys: call(rng.key_data(keys), xs, ys))
+                fn, ok = compile_mod.aot_compile(
+                    wrapped, avals, signature={**sig, "source": "export"},
+                    observer=self._cobs)
+                if ok:
+                    return fn
+        fn, ok = compile_mod.aot_compile(jfn, avals, signature=sig,
+                                         observer=self._cobs)
+        if ok and path is not None:
+            # serialize for the NEXT boot, through the raw-key-data
+            # boundary (typed key avals can't cross jax.export); best
+            # effort — failure just means a cold next boot
+            ejit = jax.jit(
+                lambda kd, xs, ys: jfn(rng.keys_from_data(kd), xs, ys))
+            compile_mod.save_exported(
+                path, ejit, (rng.key_data_aval(b_pad), avals[1], avals[2]))
+        return fn
 
+    # ------------------------------------------------------- warm set ----
+    def manifest(self) -> list[dict]:
+        """The resident kernel signatures, JSON-shaped — what the
+        server persists on shutdown and replays as the next boot's
+        warmup set (serve.warmup)."""
+        with self._lock:
+            sigs = list(self._fns.keys())
+        return [{"family": k.family, "n": k.n, "eps1": k.eps1,
+                 "eps2": k.eps2, "alpha": k.alpha,
+                 "normalise": k.normalise, "b_pad": b_pad}
+                for (k, b_pad, _shards) in sigs]
+
+    # ------------------------------------------------------ execution ----
     def run_batch(self, kkey: KernelKey, keys, xs: np.ndarray,
                   ys: np.ndarray) -> tuple[np.ndarray, ...]:
         """Execute one flushed launch: pad the batch axis, run the
@@ -137,9 +263,9 @@ class KernelCache:
         b_pad = pad_batch(b)
         fn, _ = self.get(kkey, b_pad)
         if b_pad != b:
-            pad = b_pad - b
-            keys = jnp.concatenate([keys, jnp.repeat(keys[:1], pad, axis=0)])
-            xs = np.concatenate([xs, np.repeat(xs[:1], pad, axis=0)])
-            ys = np.concatenate([ys, np.repeat(ys[:1], pad, axis=0)])
-        out = fn(keys, jnp.asarray(xs), jnp.asarray(ys))
+            keys = jnp.concatenate([keys, jnp.repeat(keys[:1], b_pad - b,
+                                                     axis=0)])
+            xs = _pad_rows(xs, b_pad)
+            ys = _pad_rows(ys, b_pad)
+        out = fn(keys, xs, ys)
         return tuple(np.asarray(a)[:b] for a in out)

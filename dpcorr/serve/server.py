@@ -8,6 +8,9 @@ stdlib threaded HTTP front end for ``python -m dpcorr serve``:
   estimate, or 403 (budget refused) / 429 (overloaded) / 400 (invalid).
 - ``GET /stats`` — live counters + ledger snapshot (serve.stats shape).
 - ``GET /healthz`` — liveness.
+- ``GET /readyz`` — readiness: 503 until the warmup signature set is
+  compiled and resident (serve.warmup), 200 after — so a balancer
+  never routes traffic onto a cold kernel cache.
 
 Admission order is the privacy invariant: the ledger is charged (and
 durably persisted) BEFORE the request is enqueued, so no query ever
@@ -44,6 +47,7 @@ from __future__ import annotations
 import hashlib
 import itertools
 import json
+import logging
 import secrets
 import threading
 from concurrent.futures import Future
@@ -58,7 +62,10 @@ from dpcorr.serve.kernels import KernelCache
 from dpcorr.serve.ledger import BudgetExceededError, PrivacyLedger
 from dpcorr.serve.request import EstimateRequest, EstimateResponse
 from dpcorr.serve.stats import ServeStats
+from dpcorr.serve import warmup as warmup_mod
 from dpcorr.utils import rng
+
+log = logging.getLogger("dpcorr.serve")
 
 
 def request_digest_words(req: EstimateRequest) -> tuple[int, ...]:
@@ -103,7 +110,11 @@ class DpcorrServer:
                  max_queue: int = 4096, shard: str = "auto",
                  batch_mode: str = "exact", max_kernels: int = 128,
                  tracer: obs_trace.Tracer | None = None,
-                 audit: AuditTrail | str | None = None):
+                 audit: AuditTrail | str | None = None,
+                 warmup: str | list | None = None,
+                 warmup_manifest: str | None = None,
+                 aot: bool = True, export_dir: str | None = None,
+                 warmup_autostart: bool = True):
         self.seed = seed
         # obs wiring (ISSUE 2): one tracer spans the request lifecycle
         # (admit → charge → enqueue → flush → respond; default is the
@@ -118,7 +129,9 @@ class DpcorrServer:
                                     audit=self.audit,
                                     registry=self.stats.registry)
         self.cache = KernelCache(stats=self.stats, shard=shard,
-                                 mode=batch_mode, max_kernels=max_kernels)
+                                 mode=batch_mode, max_kernels=max_kernels,
+                                 aot=aot, export_dir=export_dir,
+                                 tracer=self.tracer)
         self.coalescer = Coalescer(self.cache, self.stats,
                                    max_batch=max_batch,
                                    max_delay_s=max_delay_s,
@@ -132,6 +145,79 @@ class DpcorrServer:
         # (module docstring — the ledger persists, the counter must not
         # need to)
         self._boot_nonce = secrets.randbits(31)
+        # -- warmup / readiness (ISSUE 4; serve.warmup) -------------------
+        # signature sources: explicit spec (CLI --warmup) + the previous
+        # boot's manifest, merged and deduplicated. An empty set means
+        # the server is ready immediately (the pre-warmup behavior).
+        self._warmup_manifest = warmup_manifest
+        sigs: list[dict] = []
+        if warmup:
+            sigs += (warmup_mod.parse_warmup_spec(warmup, max_batch)
+                     if isinstance(warmup, str) else list(warmup))
+        if warmup_manifest:
+            sigs += warmup_mod.load_manifest(warmup_manifest)
+        self._warm_set = warmup_mod.signatures_to_keys(sigs)
+        self._warm_lock = threading.Lock()
+        self._warm_done = 0  # guarded by: _warm_lock
+        self._warm_errors = 0  # guarded by: _warm_lock
+        self._warm_state = "ready" if not self._warm_set else "pending"  # guarded by: _warm_lock
+        self._warm_thread = None  # guarded by: _warm_lock
+        self._ready = threading.Event()
+        if not self._warm_set:
+            self._ready.set()
+        elif warmup_autostart:
+            self.start_warmup()
+
+    # -- warmup / readiness ----------------------------------------------
+    def start_warmup(self) -> None:
+        """Kick the background warmup thread (idempotent). Split from
+        construction (``warmup_autostart=False``) so tests can observe
+        the not-ready → warming → ready lifecycle."""
+        with self._warm_lock:
+            if self._warm_thread is not None or not self._warm_set:
+                return
+            self._warm_state = "warming"
+            t = threading.Thread(target=self._warm_loop,
+                                 name="dpcorr-serve-warmup", daemon=True)
+            self._warm_thread = t
+        t.start()
+
+    def _warm_loop(self) -> None:
+        with self.tracer.span("serve.warmup", signatures=len(self._warm_set)):
+            for kkey, b_pad in self._warm_set:
+                try:
+                    self.cache.get(kkey, b_pad)
+                except Exception as e:
+                    # a single bad signature (typo'd family in a spec,
+                    # stale manifest entry) must not hold readiness
+                    # hostage — log it, count it, keep warming
+                    log.warning("warmup signature %s b_pad=%d failed: %s",
+                                kkey, b_pad, e)
+                    with self._warm_lock:
+                        self._warm_errors += 1
+                else:
+                    # ``warmed`` counts signatures actually resident —
+                    # warmed + warm_errors == total once the loop ends
+                    with self._warm_lock:
+                        self._warm_done += 1
+        with self._warm_lock:
+            self._warm_state = "ready"
+        self._ready.set()
+
+    def readiness(self) -> dict:
+        """The ``GET /readyz`` body: ready only once the warmup set is
+        resident (or there was none)."""
+        with self._warm_lock:
+            return {"ready": self._ready.is_set(),
+                    "state": self._warm_state,
+                    "warmed": self._warm_done,
+                    "warm_errors": self._warm_errors,
+                    "total": len(self._warm_set)}
+
+    def wait_ready(self, timeout: float | None = None) -> bool:
+        """Block until the warmup set is resident (True) or ``timeout``
+        elapses (False) — the load generator's wait-for-ready hook."""
+        return self._ready.wait(timeout)
 
     def _master_locked(self):
         with self._master_lock:
@@ -202,6 +288,16 @@ class DpcorrServer:
 
     def close(self) -> None:
         self.coalescer.close()
+        if self._warmup_manifest:
+            # persist the working set AFTER the drain: every kernel the
+            # final flushes compiled is in the manifest the next boot
+            # replays
+            try:
+                warmup_mod.save_manifest(self._warmup_manifest,
+                                         self.cache.manifest())
+            except OSError as e:
+                log.warning("could not persist warmup manifest %s: %s",
+                            self._warmup_manifest, e)
 
 
 class InProcessClient:
@@ -220,6 +316,14 @@ class InProcessClient:
 
     def stats(self) -> dict:
         return self._server.stats_snapshot()
+
+    def readiness(self) -> dict:
+        return self._server.readiness()
+
+    def wait_ready(self, timeout: float | None = None) -> bool:
+        """Wait-for-ready hook: what ``GET /readyz`` polling would do,
+        minus the wire (benchmarks/serve_load.py warm-boot mode)."""
+        return self._server.wait_ready(timeout)
 
 
 # ---------------------------------------------------------------- HTTP ----
@@ -281,6 +385,12 @@ def make_http_server(server: DpcorrServer, host: str = "127.0.0.1",
                                 _PROM_CONTENT_TYPE)
             elif self.path == "/healthz":
                 self._send(200, {"ok": True})
+            elif self.path == "/readyz":
+                # readiness ≠ liveness: 503 while the warmup set is
+                # still compiling, so a load balancer holds traffic
+                # until steady-state is compile-free
+                r = server.readiness()
+                self._send(200 if r["ready"] else 503, r)
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 

@@ -87,6 +87,11 @@ class ServeStats:
         self._hits = r.counter(
             "dpcorr_serve_kernel_cache_hits_total",
             "Batch-kernel cache hits")
+        self._dedup = r.counter(
+            "dpcorr_serve_kernel_compile_dedup_total",
+            "Concurrent cache misses that waited on another thread's "
+            "inflight compile instead of compiling again (single-flight"
+            " — utils.compile)")
         self._cache_size = r.gauge(
             "dpcorr_serve_kernel_cache_size",
             "Live compiled kernels held by the LRU-bounded cache")
@@ -141,6 +146,10 @@ class ServeStats:
         return int(self._hits.value())
 
     @property
+    def kernel_compile_dedup(self) -> int:
+        return int(self._dedup.value())
+
+    @property
     def kernel_cache_size(self) -> int:
         return int(self._cache_size.value())
 
@@ -175,6 +184,12 @@ class ServeStats:
             self._hits.inc()
         else:
             self._compiles.inc()
+
+    def kernel_dedup(self) -> None:
+        """A miss that piggybacked on an inflight compile (single-flight
+        follower): neither a hit nor a compile — its own counter, so
+        the dedup the race fix buys is observable."""
+        self._dedup.inc()
 
     def set_queue_depth(self, depth: int) -> None:
         self._depth.set(depth)
@@ -222,6 +237,7 @@ class ServeStats:
             "flush_size_max": self.flush_size_max,
             "kernel_compiles": self.kernel_compiles,
             "kernel_hits": self.kernel_hits,
+            "kernel_compile_dedup": self.kernel_compile_dedup,
             "kernel_cache_size": self.kernel_cache_size,
             "queue_depth": self.queue_depth,
             "latency_s": lat,

@@ -1,0 +1,255 @@
+"""Compile-ahead layer: single-flight AOT compilation + export reuse.
+
+XLA compilation is pure latency with zero statistical value — for the
+paper's workloads it lands at the worst moments: the first flush of
+each serve kernel signature and the head of every grid bucket. This
+module is the one place both consumers (serve.kernels, grid phase-0
+precompile) get compilation *off* the request path:
+
+- :class:`SingleFlight` — per-key deduplication of concurrent builds.
+  The first caller for a key becomes the *leader* and runs the build;
+  callers arriving while it is inflight wait on the same result instead
+  of compiling again (the ``KernelCache.get`` race this fixes had the
+  second thread's compile silently overwrite the first's). Distinct
+  keys build concurrently: XLA releases the GIL during compilation, so
+  a thread pool over signatures gets real parallelism.
+- :func:`aot_compile` — explicit ahead-of-time ``jit(...).lower(avals)
+  .compile()``. The returned executable is called with the *dynamic*
+  arguments only (static argnums are baked in at lowering). Every
+  compile is measured into the obs registry (``dpcorr_compile_seconds``
+  histogram, ``dpcorr_compile_inflight`` gauge) and wrapped in a
+  ``kernel.compile`` span carrying the signature, so a slow p99 is
+  attributable to the compile that caused it. Lowering failure degrades
+  to the plain jitted callable (``ok=False``) — AOT is an optimization,
+  never a correctness gate.
+- :func:`save_exported` / :func:`load_exported` — version-gated
+  ``jax.export`` serialization of compiled programs, so a restarted
+  server skips even the persistent-cache retrace. Caveat the serve
+  consumer owns: ``jax.export`` cannot serialize typed PRNG-key avals
+  (``KeyError: key<fry>`` on this jax), so exported programs must take
+  raw key *data* (``rng.key_data_aval``) and wrap it back inside
+  (``rng.keys_from_data``) — verified bit-identical round trip.
+
+The AOT artifact is the same ``exact``/``vector`` kernel the lazy path
+would have jit-compiled — identical HLO, so outputs stay bit-identical
+to the pre-AOT serving/grid paths (pinned by tests/test_compile.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+import time
+
+from dpcorr.obs import trace as obs_trace
+from dpcorr.obs.metrics import Registry, default_registry
+
+log = logging.getLogger("dpcorr.compile")
+
+#: Compile-time buckets (seconds): kernels range from ~50 ms trivial CPU
+#: programs to minutes-long Mosaic/TPU compiles through the tunnel —
+#: wider than the serving-latency buckets on both ends.
+COMPILE_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0, 120.0, 300.0)
+
+
+class _Flight:
+    """One inflight build: the leader publishes ``value``/``error`` then
+    sets ``done``; followers wait on it."""
+
+    __slots__ = ("done", "value", "error")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.value = None
+        self.error = None
+
+
+class SingleFlight:
+    """Per-key build deduplication (Go's ``singleflight`` shape).
+
+    ``do(key, build)`` returns ``(value, leader)``: exactly one caller
+    per concurrently-missed key runs ``build`` (leader=True); the rest
+    block until it finishes and share the result. A build that raises
+    propagates the exception to the leader *and* every waiter, and the
+    key is cleared so the next call retries fresh. The leader publishes
+    its result *before* the flight is removed, so a caller can install
+    the value into its own cache inside ``build`` without a window
+    where a third thread re-builds.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: dict[object, _Flight] = {}  # guarded by: _lock
+
+    def inflight_count(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def do(self, key, build):
+        with self._lock:
+            fl = self._inflight.get(key)
+            leader = fl is None
+            if leader:
+                fl = _Flight()
+                self._inflight[key] = fl
+        if not leader:
+            fl.done.wait()
+            if fl.error is not None:
+                raise fl.error
+            return fl.value, False
+        try:
+            fl.value = build()
+        except BaseException as e:
+            fl.error = e
+            raise
+        finally:
+            # publish-then-clear: value/error are set before the flight
+            # leaves the map and the event releases the waiters
+            with self._lock:
+                self._inflight.pop(key, None)
+            fl.done.set()
+        return fl.value, True
+
+
+class CompileObserver:
+    """The obs wiring one consumer's compiles report through: a
+    histogram of compile seconds, an inflight gauge, a per-result
+    counter, and ``kernel.compile`` spans. Serve passes its per-server
+    registry (so /metrics and /stats see the series); grid uses the
+    process defaults."""
+
+    def __init__(self, registry: Registry | None = None,
+                 tracer: obs_trace.Tracer | None = None):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self._tracer = tracer
+        self.seconds = self.registry.histogram(
+            "dpcorr_compile_seconds",
+            "Wall seconds per kernel compilation (AOT lower+compile)",
+            buckets=COMPILE_BUCKETS)
+        self.inflight = self.registry.gauge(
+            "dpcorr_compile_inflight",
+            "Kernel compilations currently running")
+        self.results = self.registry.counter(
+            "dpcorr_compile_total",
+            "Kernel compilations by outcome",
+            labelnames=("result",))
+
+    def tracer(self) -> obs_trace.Tracer:
+        # resolved per call, not at construction: the process tracer can
+        # be configured after a long-lived observer is built
+        return self._tracer if self._tracer is not None \
+            else obs_trace.tracer()
+
+
+def aot_compile(jitted, lower_args, *, signature=None,
+                observer: CompileObserver | None = None, parent=None):
+    """AOT-compile ``jitted`` at ``lower_args`` (the full argument list
+    as the jitted callable takes it — static args included, as concrete
+    values; dynamic args may be ``jax.ShapeDtypeStruct`` avals).
+
+    Returns ``(fn, aot_ok)``. On success ``fn`` is the compiled
+    executable, called with the *dynamic* args only and strict about
+    shapes (TypeError on mismatch — callers keep the jitted fallback
+    for off-signature shapes). On lowering/compile failure ``fn`` is
+    ``jitted`` itself and ``aot_ok`` is False: the caller keeps working,
+    just lazily compiled.
+
+    ``signature`` (a flat dict) labels the ``kernel.compile`` span;
+    ``parent`` pins the span's parent for pool threads whose implicit
+    (thread-local) span stack is empty.
+    """
+    obs = observer if observer is not None else CompileObserver()
+    attrs = dict(signature or {})
+    obs.inflight.inc()
+    t0 = time.perf_counter()
+    try:
+        with obs.tracer().span("kernel.compile", parent=parent,
+                               **attrs) as sp:
+            try:
+                fn = jitted.lower(*lower_args).compile()
+                ok = True
+            except Exception as e:
+                log.warning("AOT compile failed for %s: %s -- falling "
+                            "back to lazy jit", attrs or "<kernel>", e)
+                fn, ok = jitted, False
+            sp.set(aot=ok)
+    finally:
+        dt = time.perf_counter() - t0
+        obs.inflight.dec()
+    obs.seconds.observe(dt)
+    obs.results.inc(result="aot" if ok else "jit-fallback")
+    return fn, ok
+
+
+# ------------------------------------------------------- jax.export ----
+def export_supported() -> bool:
+    """Version gate for the serialization path: ``jax.export`` only
+    (the older experimental module had an incompatible format)."""
+    try:
+        from jax import export as jax_export
+    except Exception:  # pragma: no cover - depends on jax version
+        return False
+    return (hasattr(jax_export, "export")
+            and hasattr(jax_export, "deserialize"))
+
+
+def signature_digest(*parts) -> str:
+    """Stable filename stem for one exported kernel signature. The jax
+    version is folded in — serialized programs are not portable across
+    jax upgrades, and a stale artifact must miss, not deserialize into
+    wrong semantics."""
+    import jax
+
+    blob = "|".join(str(p) for p in parts) + f"|jax={jax.__version__}"
+    return hashlib.sha256(blob.encode()).hexdigest()[:24]
+
+
+def export_path(root: str, digest: str) -> str:
+    return os.path.join(root, f"{digest}.jaxexp")
+
+
+def save_exported(path: str, jitted, lower_args) -> bool:
+    """Serialize ``jitted`` exported at ``lower_args`` to ``path``
+    (atomic tmp+rename — a crashed writer leaves no torn artifact).
+    Returns False (never raises) when export/serialize is unsupported
+    for this program — e.g. typed PRNG-key avals; see module docstring
+    for the key-data wrapper contract."""
+    if not export_supported():
+        return False
+    try:
+        from jax import export as jax_export
+
+        blob = jax_export.export(jitted)(*lower_args).serialize()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        return True
+    except Exception as e:
+        log.warning("jax.export serialization to %s failed: %s", path, e)
+        return False
+
+
+def load_exported(path: str):
+    """Deserialize an exported kernel; returns its ``.call`` (a
+    traceable callable) or None on any failure — a corrupt or
+    version-mismatched artifact degrades to a fresh compile."""
+    if not export_supported():
+        return None
+    try:
+        from jax import export as jax_export
+
+        with open(path, "rb") as f:
+            blob = f.read()
+        return jax_export.deserialize(blob).call
+    except FileNotFoundError:
+        return None
+    except Exception as e:
+        log.warning("stale/corrupt exported kernel %s ignored: %s",
+                    path, e)
+        return None

@@ -93,6 +93,39 @@ def pallas_seeds(key: jax.Array, n_reps: int) -> jax.Array:
                               jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
 
 
+def key_aval(n: int | None = None) -> jax.ShapeDtypeStruct:
+    """Abstract value of a typed PRNG key vector under the process
+    default impl (``DPCORR_PRNG``) — what AOT compilation lowers
+    against (utils.compile) without materializing concrete keys. ``n``
+    is the leading axis; None means a scalar key."""
+    shape = () if n is None else (int(n),)
+    k = jax.eval_shape(
+        lambda: jax.random.key(0, impl=os.environ.get("DPCORR_PRNG")
+                               or None))
+    return jax.ShapeDtypeStruct(shape, k.dtype)
+
+
+def key_data_aval(n: int | None = None) -> jax.ShapeDtypeStruct:
+    """Abstract value of the raw uint32 key *data* for :func:`key_aval`
+    — the serializable stand-in ``jax.export`` programs take, because
+    typed key avals cannot cross its serialization boundary (see
+    utils.compile module docstring)."""
+    return jax.eval_shape(jax.random.key_data, key_aval(n))
+
+
+def key_data(keys: jax.Array) -> jax.Array:
+    """Typed keys → raw uint32 words (the export-boundary encoding)."""
+    return jax.random.key_data(keys)
+
+
+def keys_from_data(data: jax.Array, impl: str | None = None) -> jax.Array:
+    """Raw uint32 words → typed keys; inverse of :func:`key_data`.
+    ``impl`` defaults to the process impl (:func:`impl_tag`), so a
+    deserialized kernel rebuilds exactly the keys the live path uses —
+    mixing impls would silently change every stream."""
+    return jax.random.wrap_key_data(data, impl=impl or impl_tag())
+
+
 def stream(key: jax.Array, name: str) -> jax.Array:
     """Named substream: stable across code movement, unlike split() order.
 

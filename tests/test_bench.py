@@ -257,22 +257,22 @@ def test_cpu_fallback_degraded(monkeypatch, capsys):
     assert len(out["detail"]["attempts"]) == 2
 
 
-def test_health_probe_sets_first_leash(monkeypatch, capsys):
-    """VERDICT r2 #1: a live tunnel earns the first tpu worker a longer
-    timeout (slow-but-working init must not be killed); a dead probe keeps
-    the short leash so a wedged tunnel degrades fast. The probe verdict is
-    recorded in the artifact either way."""
-    out, _, t_ok = _run_main(monkeypatch, capsys,
-                             [(_good(), None), (_pallas(), None)],
-                             healthy=True)
+def test_health_probe_gates_tpu_attempts(monkeypatch, capsys):
+    """A healthy probe earns the tpu worker its long leash; a FAILED
+    probe now skips both tpu attempts outright and degrades straight to
+    CPU (``degraded: "tpu-probe-failed"``, distinct from the
+    attempted-and-died ``"tpu-init-failed"``) — the probe is the same
+    one-matmul program a worker would run first, so attempting anyway
+    only bought the old ladder's 420/200 s of guaranteed timeout. The
+    probe verdict, the skip note and the relay snapshot (taken at probe
+    time, not artifact time — a mid-run redial must not misattribute)
+    are all recorded in the artifact."""
+    out, calls, t_ok = _run_main(monkeypatch, capsys,
+                                 [(_good(), None), (_pallas(), None)],
+                                 healthy=True)
     assert out["detail"]["tunnel_health_probe"] == "ok"
-    # failed probe adds endpoint forensics, snapshotted at probe time
-    # (not artifact time — a mid-run redial must not misattribute);
-    # deterministic via monkeypatch, no live TCP in a unit test. The
-    # leash ladder follows evidence strength: probe failed but relay up
-    # ⇒ 420-base; relay ports REFUSING (strictly stronger death signal;
-    # jax init hangs even on connection-refused) ⇒ 200-base — both real
-    # attempts still run either way.
+    assert calls[0] == "tpu" and t_ok[0] >= 900
+    assert "degraded" not in out["detail"]
     import dpcorr.utils.doctor as doctor_mod
 
     def relay(alive):
@@ -282,17 +282,20 @@ def test_health_probe_sets_first_leash(monkeypatch, capsys):
                                 "checked": []})
 
     relay(True)
-    out, _, t_up = _run_main(monkeypatch, capsys,
-                             [(_good(), None), (_pallas(), None)],
-                             healthy=False)
+    out, calls, _ = _run_main(monkeypatch, capsys, [(dict(CPU), None)],
+                              healthy=False)
+    assert calls == ["cpu"]  # no tpu attempt at all
     assert out["detail"]["tunnel_health_probe"] == "failed"
     assert out["detail"]["relay_endpoint"] == "up"
+    assert out["detail"]["degraded"] == "tpu-probe-failed"
+    assert out["detail"]["attempts"] == [
+        "tpu worker: skipped (health probe failed, relay up)"]
     relay(False)
-    out, _, t_dead = _run_main(monkeypatch, capsys,
-                               [(_good(), None), (_pallas(), None)],
-                               healthy=False)
+    out, calls, _ = _run_main(monkeypatch, capsys, [(dict(CPU), None)],
+                              healthy=False)
+    assert calls == ["cpu"]
     assert out["detail"]["relay_endpoint"] == "dead"
-    assert t_ok[0] > t_up[0] >= 420 > t_dead[0] >= 200
+    assert out["detail"]["degraded"] == "tpu-probe-failed"
 
 
 def test_total_failure_still_valid_json(monkeypatch, capsys):

@@ -1,0 +1,364 @@
+"""Compile-ahead layer (ISSUE 4): single-flight dedup, AOT bit-identity,
+warmup/readiness lifecycle, manifest replay, export roundtrip, obs wiring.
+
+The contract under test, end to end: compilation happens once per
+signature no matter how many threads race the miss; ahead-of-time
+compilation produces the SAME bits as the lazy jit path for every
+estimator family; a warmed server reports ready only once its signature
+set is resident and then serves steady-state traffic with zero compiles;
+and every compile is observable (``dpcorr_compile_seconds`` metric +
+``kernel.compile`` span).
+"""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from dpcorr.models.estimators.registry import FAMILIES
+from dpcorr.obs import trace as obs_trace
+from dpcorr.obs.metrics import Registry
+from dpcorr.serve import (
+    DpcorrServer,
+    EstimateRequest,
+    KernelCache,
+    load_manifest,
+    make_http_server,
+    parse_warmup_spec,
+    signatures_to_keys,
+)
+from dpcorr.serve.request import KernelKey, kernel_key
+from dpcorr.utils import compile as compile_mod
+from dpcorr.utils import rng
+
+
+def _mk_req(n=96, family="ni_sign", seed=None, i=0, **kw):
+    rs = np.random.RandomState(300 + i)
+    return EstimateRequest(family, rs.randn(n).astype(np.float32),
+                           rs.randn(n).astype(np.float32),
+                           1.0, 0.5, seed=seed, **kw)
+
+
+def _sig_for(req, b_pad=1):
+    kk = kernel_key(req)
+    return {"family": kk.family, "n": kk.n, "eps1": kk.eps1,
+            "eps2": kk.eps2, "alpha": kk.alpha,
+            "normalise": kk.normalise, "b_pad": b_pad}
+
+
+def _http(srv):
+    httpd = make_http_server(srv, host="127.0.0.1", port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _get_readyz(base):
+    try:
+        with urllib.request.urlopen(f"{base}/readyz") as r:
+            import json
+
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        import json
+
+        return e.code, json.load(e)
+
+
+# ----------------------------------------------------- single-flight ----
+
+def test_single_flight_dedup_and_error_retry():
+    """Pure-unit race: 8 threads, one build, exactly one leader; a
+    failed build propagates to all waiters but clears the flight so the
+    key can be rebuilt."""
+    sf = compile_mod.SingleFlight()
+    builds, results = [], []
+    bar = threading.Barrier(8)
+
+    def build():
+        builds.append(1)
+        time.sleep(0.3)  # hold the flight open while followers arrive
+        return "v"
+
+    def worker():
+        bar.wait()
+        results.append(sf.do("k", build))
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(builds) == 1
+    assert [v for v, _ in results] == ["v"] * 8
+    assert sum(1 for _, leader in results if leader) == 1
+    assert sf.inflight_count() == 0
+
+    def boom():
+        raise RuntimeError("compile died")
+
+    with pytest.raises(RuntimeError, match="compile died"):
+        sf.do("k2", boom)
+    assert sf.do("k2", lambda: 7) == (7, True)  # flight cleared, retryable
+
+
+def test_kernel_cache_race_one_compile_per_key():
+    """ISSUE 4 acceptance (satellite a): concurrent misses on one
+    signature produce exactly ONE compilation — followers wait on the
+    leader's inflight build and count into ``kernel_compile_dedup``, and
+    every thread gets the same executable."""
+    cache = KernelCache(shard="off", mode="exact")
+    compiled = []
+    cache._compile_hook = lambda sig: (compiled.append(sig),
+                                       time.sleep(1.0))
+    kk = KernelKey("ni_sign", 64, 1.0, 0.5, 0.05, True)
+    bar = threading.Barrier(8)
+    fns = []
+
+    def worker():
+        bar.wait()
+        fns.append(cache.get(kk, 4)[0])
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(compiled) == 1  # the leader compiled; nobody else did
+    s = cache.stats
+    assert s.kernel_compiles == 1
+    assert s.kernel_compile_dedup + s.kernel_hits == 7
+    assert s.kernel_compile_dedup >= 1  # the 1 s hold guarantees waiters
+    assert all(f is fns[0] for f in fns)
+    # steady state afterwards: pure hits, no dedup, no compiles
+    before = s.kernel_compile_dedup
+    cache.get(kk, 4)
+    assert s.kernel_compiles == 1 and s.kernel_compile_dedup == before
+
+
+# ----------------------------------------------------- AOT bit-identity ----
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_aot_bit_identical_to_lazy_jit(family):
+    """The AOT executable is the same HLO the lazy jit would build:
+    responses must be bit-identical for every estimator family."""
+    n = 64
+    rs = np.random.RandomState(11)
+    xs = rs.randn(3, n).astype(np.float32)
+    ys = rs.randn(3, n).astype(np.float32)
+    keys = jax.random.split(rng.master_key(7), 3)
+    kk = KernelKey(family, n, 1.0, 0.5, 0.05, True)
+    got_aot = KernelCache(shard="off", aot=True).run_batch(
+        kk, keys, xs, ys)
+    got_jit = KernelCache(shard="off", aot=False).run_batch(
+        kk, keys, xs, ys)
+    for a, b in zip(got_aot, got_jit, strict=True):
+        np.testing.assert_array_equal(a, b)
+
+
+# ----------------------------------------------- warmup spec / manifest ----
+
+def test_parse_warmup_spec_and_dedup():
+    sigs = parse_warmup_spec("ni_sign:500:1.0:0.5:1,3 ni_sign:500:1:0.5:4",
+                             max_batch=64)
+    keys = signatures_to_keys(sigs)
+    # b_pads 1, 4 (3 rounds up to 4 and dedups against the explicit 4)
+    assert [b for _, b in keys] == [1, 4]
+    assert keys[0][0].n == 500
+    auto = parse_warmup_spec("int_subg:100:1.0:1.0:auto", max_batch=8)
+    assert [s["b_pad"] for s in auto] == [1, 2, 4, 8]
+    with pytest.raises(ValueError, match="--warmup"):
+        parse_warmup_spec("ni_sign:500", max_batch=8)
+
+
+def test_load_manifest_degrades_to_cold_boot(tmp_path):
+    missing = tmp_path / "none.json"
+    assert load_manifest(str(missing)) == []
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert load_manifest(str(bad)) == []
+    wrong = tmp_path / "wrong.json"
+    wrong.write_text('{"version": 99, "signatures": []}')
+    assert load_manifest(str(wrong)) == []
+
+
+# ------------------------------------------------- readiness lifecycle ----
+
+def test_readyz_lifecycle_and_zero_steady_state_compiles():
+    """/readyz walks not-ready → warming → ready, and once ready the
+    warm signature serves traffic with ZERO further compilations."""
+    req = _mk_req(seed=1)
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off",
+                       warmup=[_sig_for(req)], warmup_autostart=False)
+    httpd, base = _http(srv)
+    try:
+        code, body = _get_readyz(base)
+        assert (code, body["ready"], body["state"]) == \
+            (503, False, "pending")
+        assert srv.stats.kernel_compiles == 0
+        srv.start_warmup()
+        assert srv.wait_ready(timeout=300)
+        assert srv.readiness()["state"] == "ready"
+        code, body = _get_readyz(base)
+        assert code == 200 and body["warmed"] == body["total"] == 1
+        compiles = srv.stats.kernel_compiles
+        assert compiles == 1
+        got = srv.estimate(req, timeout=120)
+        assert np.isfinite(got.rho_hat)
+        assert srv.stats.kernel_compiles == compiles  # warm: no compile
+        assert srv.stats.kernel_hits >= 1
+        # the compile-ahead metrics surface in this server's exposition
+        text = srv.stats.render_prometheus()
+        assert "dpcorr_compile_seconds_bucket" in text
+        assert 'dpcorr_compile_total{result="aot"} 1' in text
+    finally:
+        httpd.shutdown()
+        srv.close()
+
+
+def test_server_with_no_warmup_is_ready_immediately():
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+    try:
+        assert srv.readiness() == {"ready": True, "state": "ready",
+                                   "warmed": 0, "warm_errors": 0,
+                                   "total": 0}
+        assert srv.wait_ready(timeout=0.1)
+    finally:
+        srv.close()
+
+
+def test_bad_warmup_signature_does_not_block_readiness():
+    """A stale manifest entry (unknown family) must not hold readiness
+    hostage: it counts as a warm error and the server still goes ready."""
+    good = _sig_for(_mk_req(seed=2))
+    bad = dict(good, family="nope")
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off",
+                       warmup=[bad, good])
+    try:
+        assert srv.wait_ready(timeout=300)
+        r = srv.readiness()
+        assert r["ready"] and r["warm_errors"] == 1 and r["warmed"] == 1
+    finally:
+        srv.close()
+
+
+def test_warmup_manifest_roundtrip_across_restart(tmp_path):
+    """Shutdown persists the resident signature set; the next boot
+    replays it and then serves the same traffic without compiling —
+    with answers bit-identical across the restart."""
+    manifest = str(tmp_path / "kernels.json")
+    req = _mk_req(seed=5)
+    srv1 = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off",
+                        warmup_manifest=manifest)
+    try:
+        assert srv1.wait_ready(timeout=60)  # empty manifest: first boot
+        r1 = srv1.estimate(req, timeout=120)
+    finally:
+        srv1.close()
+    sigs = load_manifest(manifest)
+    assert len(sigs) == 1 and sigs[0]["family"] == "ni_sign"
+
+    srv2 = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off",
+                        warmup_manifest=manifest)
+    try:
+        assert srv2.wait_ready(timeout=300)
+        assert srv2.readiness()["total"] == 1
+        compiles = srv2.stats.kernel_compiles
+        assert compiles == 1  # the replayed signature, compiled at boot
+        r2 = srv2.estimate(req, timeout=120)
+        assert srv2.stats.kernel_compiles == compiles  # warm boot
+    finally:
+        srv2.close()
+    assert (r1.rho_hat, r1.ci_low, r1.ci_high) == \
+        (r2.rho_hat, r2.ci_low, r2.ci_high)
+
+
+# ------------------------------------------------------- jax.export ----
+
+@pytest.mark.skipif(not compile_mod.export_supported(),
+                    reason="jax.export unavailable on this jax")
+def test_export_roundtrip_bit_identical(tmp_path, monkeypatch):
+    """A compiled program serialized by one cache is replayed by the
+    next (same export_dir) and produces identical bits."""
+    n = 64
+    rs = np.random.RandomState(3)
+    xs = rs.randn(2, n).astype(np.float32)
+    ys = rs.randn(2, n).astype(np.float32)
+    keys = jax.random.split(rng.master_key(9), 2)
+    kk = KernelKey("int_sign", n, 1.0, 1.0, 0.05, True)
+
+    first = KernelCache(shard="off", export_dir=str(tmp_path))
+    got1 = first.run_batch(kk, keys, xs, ys)
+    arts = list(tmp_path.glob("*.jaxexp"))
+    assert len(arts) == 1 and arts[0].stat().st_size > 0
+
+    loads = []
+    orig = compile_mod.load_exported
+
+    def counting_load(path):
+        loads.append(path)
+        return orig(path)
+
+    monkeypatch.setattr(compile_mod, "load_exported", counting_load)
+    second = KernelCache(shard="off", export_dir=str(tmp_path))
+    got2 = second.run_batch(kk, keys, xs, ys)
+    assert loads, "second boot never consulted the export artifact"
+    for a, b in zip(got1, got2, strict=True):
+        np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------------- observability ----
+
+def test_aot_compile_metrics_and_span(tmp_path):
+    """Every compile lands in the ``dpcorr_compile_seconds`` histogram
+    and emits a ``kernel.compile`` span carrying its signature."""
+    import jax.numpy as jnp
+
+    path = str(tmp_path / "spans.jsonl")
+    tr = obs_trace.configure(path)
+    try:
+        obs = compile_mod.CompileObserver(registry=Registry(), tracer=tr)
+        jfn = jax.jit(lambda x: x * 2.0)
+        fn, ok = compile_mod.aot_compile(
+            jfn, (jax.ShapeDtypeStruct((4,), jnp.float32),),
+            signature={"kernel": "toy", "n": 4}, observer=obs)
+        assert ok
+        np.testing.assert_array_equal(
+            np.asarray(fn(np.ones(4, np.float32))),
+            np.full(4, 2.0, np.float32))
+        assert obs.inflight.value() == 0
+    finally:
+        obs_trace.configure(None)
+    text = obs.registry.render()
+    assert "dpcorr_compile_seconds_bucket" in text
+    assert 'dpcorr_compile_total{result="aot"} 1' in text
+    spans = [s for s in obs_trace.read_spans(path)
+             if s["name"] == "kernel.compile"]
+    assert len(spans) == 1
+    assert spans[0]["attrs"]["kernel"] == "toy"
+    assert spans[0]["attrs"]["aot"] is True
+
+
+def test_aot_compile_failure_falls_back_to_jit():
+    """A signature that cannot lower degrades to the lazy jitted
+    callable (ok=False) and counts as a jit-fallback, never raises."""
+    import jax.numpy as jnp
+
+    class Unlowerable:
+        def lower(self, *a):
+            raise RuntimeError("no backend for you")
+
+        def __call__(self, x):
+            return x + 1
+
+    obs = compile_mod.CompileObserver(registry=Registry())
+    fn, ok = compile_mod.aot_compile(
+        Unlowerable(), (jax.ShapeDtypeStruct((2,), jnp.float32),),
+        signature={"kernel": "broken"}, observer=obs)
+    assert not ok
+    assert fn(1) == 2  # the original callable, still usable
+    assert 'dpcorr_compile_total{result="jit-fallback"} 1' \
+        in obs.registry.render()

@@ -68,6 +68,38 @@ def test_bucketed_backend_bit_identical_to_local():
     assert (buck.timings["points"] == 2).all()
 
 
+def test_bucketed_precompile_bit_identical_and_flagged():
+    """ISSUE 4: phase-0 AOT precompilation dispatches the same HLO the
+    lazy jit path would build — per-bucket results are bit-identical
+    with the knob on vs off, and the timings frame records which
+    buckets rode a precompiled executable. "on" (not "auto") so the
+    assertion holds on single-core CI hosts where auto backs off."""
+    off = run_grid(GridConfig(**SMALL, backend="bucketed",
+                              precompile="off"))
+    on = run_grid(GridConfig(**SMALL, backend="bucketed",
+                             precompile="on"))
+    pd.testing.assert_frame_equal(off.detail_all, on.detail_all)
+    assert on.timings["precompiled"].all()
+    assert not off.timings["precompiled"].any()
+
+
+def test_precompile_auto_matches_host_cores(monkeypatch):
+    """"auto" is a host decision: active iff >= 2 CPUs are available
+    (with one core the overlap has nowhere to run — GridConfig doc)."""
+    import dpcorr.grid as grid_mod
+
+    for cores, expect in ((1, False), (8, True)):
+        monkeypatch.setattr(grid_mod.os, "cpu_count", lambda c=cores: c)
+        res = run_grid(GridConfig(**SMALL, backend="bucketed",
+                                  precompile="auto"))
+        assert res.timings["precompiled"].all() == expect
+
+
+def test_precompile_knob_validated():
+    with pytest.raises(ValueError, match="precompile"):
+        run_grid(GridConfig(**SMALL, precompile="bogus"))
+
+
 def test_bucketed_sharded_bit_identical_to_local(devices):
     """Both parallel axes composed — bucket kernels with the flat
     (points × reps) axis split over the 8-device mesh — must still be

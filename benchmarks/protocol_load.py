@@ -1,0 +1,207 @@
+"""Load + chaos benchmark for the two-party protocol (ISSUE 5).
+
+Runs full protocol sessions for every estimator family over three
+arms — in-process queue transport, loopback TCP, and TCP under fault
+injection (default 10% frame drop + 50 ms delay) — and verifies the
+protocol acceptance invariants end to end:
+
+1. **transport equivalence** — for a fixed spec, the (rho, lo, hi)
+   triple is bit-identical across all three arms: retries, duplicate
+   deliveries and reordering must never perturb the estimate (the
+   chaos RNG is stdlib, the estimator key tree is jax — disjoint by
+   construction).
+2. **monolithic equivalence** — the protocol result equals the direct
+   ``jit(serving_entry)`` call on the same master key (replay key
+   layout), i.e. splitting the estimator across a wire cost zero bits.
+3. **chaos actually bites** — the faulted arm must record retransmits
+   (otherwise the "fault" arm proved nothing).
+4. **transcript + ledger audit** — one session per arm writes both
+   parties' transcripts; ``protocol.scan`` must pass the schema and
+   no-raw-columns checks against the true columns, and the ε charged
+   on the wire must balance the durable audit trail exactly.
+
+Prints one JSON document: per-arm session latency stats, message
+throughput, retry counts, and the verdicts. Exit code 1 if any
+invariant fails, so the unattended queue can gate on it.
+
+Usage:
+    python benchmarks/protocol_load.py [--sessions 8] [--n 2000]
+        [--fault-drop 0.10] [--fault-delay-ms 50] [--out-json PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FAMILIES = ("ni_sign", "int_sign", "ni_subg", "int_subg")
+
+
+def _percentiles(xs: list[float]) -> dict:
+    if not xs:
+        return {}
+    s = sorted(xs)
+
+    def q(p):
+        return s[min(len(s) - 1, int(p * len(s)))]
+
+    return {"p50": q(0.50), "p90": q(0.90), "max": s[-1],
+            "mean": sum(s) / len(s)}
+
+
+def _run_arm(arm: str, spec, x, y, fault, sessions: int,
+             timeout_s: float, transcript_dir: str | None) -> dict:
+    from dpcorr.protocol import run_inproc, run_tcp
+
+    run = run_tcp if arm.startswith("tcp") else run_inproc
+    lat, msgs, retries = [], 0, 0
+    bits = None
+    for i in range(sessions):
+        tdir = transcript_dir if i == 0 else None
+        t0 = time.perf_counter()
+        res = run(spec, x, y, fault=fault, transcript_dir=tdir,
+                  timeout_s=timeout_s, max_retries=10)
+        lat.append(time.perf_counter() - t0)
+        triple = (res["x"].rho_hat, res["x"].ci_low, res["x"].ci_high)
+        assert triple == (res["y"].rho_hat, res["y"].ci_low,
+                          res["y"].ci_high), "role results diverged"
+        if bits is None:
+            bits = triple
+        elif triple != bits:
+            raise AssertionError(f"{arm}: session {i} drifted: "
+                                 f"{triple} != {bits}")
+        for r in res.values():
+            msgs += r.stats["sent_msgs"]
+            retries += r.stats["total_retries"]
+    wall = sum(lat)
+    return {"bits": bits, "sessions": sessions,
+            "session_latency_s": _percentiles(lat),
+            "messages": msgs,
+            "msgs_per_sec": round(msgs / wall, 2) if wall else None,
+            "total_retries": retries}
+
+
+def _audit_arm(spec, x, y, transcript_dir: str) -> dict:
+    """Scan both parties' transcripts from the recorded session and
+    balance them against fresh audit trails from a re-run (the timing
+    arms don't carry trails; the balance check needs one)."""
+    from dpcorr.obs.audit import AuditTrail
+    from dpcorr.protocol import run_inproc, scan_transcript
+    from dpcorr.protocol.scan import ledger_balance
+    from dpcorr.serve.ledger import PrivacyLedger
+
+    out = {}
+    for role in ("x", "y"):
+        path = os.path.join(transcript_dir,
+                            f"{spec.session}.{role}.jsonl")
+        rep = scan_transcript(path, raw_x=x, raw_y=y)
+        out[role] = {"scan_ok": rep["ok"],
+                     "violations": rep["violations"],
+                     "releases": rep["releases"],
+                     "gated_eps": rep["gated_eps"]}
+    trails = {r: AuditTrail() for r in ("x", "y")}
+    with tempfile.TemporaryDirectory() as td:
+        run_inproc(spec, x, y,
+                   ledger_x=PrivacyLedger(1e6, audit=trails["x"]),
+                   ledger_y=PrivacyLedger(1e6, audit=trails["y"]),
+                   transcript_dir=td)
+        for role in ("x", "y"):
+            path = os.path.join(td, f"{spec.session}.{role}.jsonl")
+            bal = ledger_balance(path, trails[role].events())
+            out[role]["balance_ok"] = bal["ok"]
+            out[role]["spent"] = bal["spent"]
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sessions", type=int, default=8,
+                    help="timed sessions per clean arm (the fault arm "
+                         "runs half, floor 2)")
+    ap.add_argument("--n", type=int, default=2000)
+    ap.add_argument("--eps1", type=float, default=1.0)
+    ap.add_argument("--eps2", type=float, default=0.5)
+    ap.add_argument("--seed", type=int, default=777)
+    ap.add_argument("--fault-drop", dest="fault_drop", type=float,
+                    default=0.10)
+    ap.add_argument("--fault-delay-ms", dest="fault_delay_ms",
+                    type=float, default=50.0)
+    ap.add_argument("--fault-duplicate", dest="fault_duplicate",
+                    type=float, default=0.05)
+    ap.add_argument("--out-json", dest="out_json", default=None)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import numpy as np
+
+    from dpcorr.models.estimators.registry import serving_entry
+    from dpcorr.protocol import ProtocolSpec
+    from dpcorr.utils import rng
+
+    r = np.random.default_rng(args.seed)
+    xy = r.multivariate_normal([0.0, 0.0], [[1.0, 0.6], [0.6, 1.0]],
+                               size=args.n)
+    x = np.asarray(xy[:, 0], np.float32)
+    y = np.asarray(xy[:, 1], np.float32)
+    fault = {"drop": args.fault_drop,
+             "delay_s": args.fault_delay_ms / 1000.0,
+             "duplicate": args.fault_duplicate}
+    fault_sessions = max(2, args.sessions // 2)
+
+    doc = {"config": {"n": args.n, "eps": [args.eps1, args.eps2],
+                      "seed": args.seed, "sessions": args.sessions,
+                      "fault": fault,
+                      "fault_sessions": fault_sessions},
+           "families": {}, "ok": True}
+    for family in FAMILIES:
+        spec = ProtocolSpec(family=family, n=args.n, eps1=args.eps1,
+                            eps2=args.eps2, seed=args.seed)
+        mono = jax.jit(serving_entry(family, args.eps1, args.eps2,
+                                     0.05, True))(
+            rng.master_key(args.seed), x, y)
+        mono_bits = tuple(float(np.float32(v)) for v in mono)
+        fam = {"monolithic_bits": list(mono_bits), "arms": {}}
+        with tempfile.TemporaryDirectory() as td:
+            arms = [("inproc", None, args.sessions, 10.0, None),
+                    ("tcp", None, args.sessions, 10.0, None),
+                    ("tcp_fault", fault, fault_sessions, 0.5, td)]
+            for arm, f, n_sess, to, tdir in arms:
+                fam["arms"][arm] = _run_arm(arm, spec, x, y, f, n_sess,
+                                            to, tdir)
+            fam["audit"] = _audit_arm(spec, x, y, td)
+        bits = {a: tuple(fam["arms"][a]["bits"]) for a in fam["arms"]}
+        fam["verdicts"] = {
+            "arms_bit_identical": len(set(bits.values())) == 1,
+            "matches_monolithic": bits["inproc"] == mono_bits,
+            "chaos_retried": fam["arms"]["tcp_fault"]
+                                ["total_retries"] > 0,
+            "audit_ok": all(fam["audit"][r]["scan_ok"]
+                            and fam["audit"][r]["balance_ok"]
+                            for r in ("x", "y")),
+        }
+        for a in fam["arms"]:
+            fam["arms"][a]["bits"] = list(fam["arms"][a]["bits"])
+        if not all(fam["verdicts"].values()):
+            doc["ok"] = False
+        doc["families"][family] = fam
+        print(f"{family}: " + " ".join(
+            f"{k}={v}" for k, v in fam["verdicts"].items()),
+            file=sys.stderr)
+
+    print(json.dumps(doc, indent=2))
+    if args.out_json:
+        with open(args.out_json, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,14 @@ Replaces the reference's "source the script" workflow (README.md:28-46):
                   per-party ε-spend timeline; ``obs chrome`` converts a
                   span JSONL log to Chrome trace-event format for
                   Perfetto
+- ``party``       one side of the two-party DP protocol over TCP
+                  (docs/PROTOCOL.md): role y listens, role x connects;
+                  each process holds one raw column and only DP
+                  releases cross the socket
+- ``protocol``    ``protocol run`` drives both roles in one process
+                  (threads, inproc or loopback TCP); ``protocol scan``
+                  is the jax-free transcript auditor (schema,
+                  no-raw-columns, ε balance)
 
 Grids persist per-design-point ``.npz`` + parquet tables into ``--out`` and
 resume from them (the reference only saves one blob at the end).
@@ -295,6 +303,136 @@ def cmd_obs_chrome(args):
     print(f"wrote {args.out} ({n} spans)")
 
 
+def _party_columns(args, n: int):
+    """Synthetic bivariate-normal columns, derived identically in both
+    party processes from the public spec seed (numpy Generator, not the
+    jax key tree — the protocol noise streams stay untouched). Each
+    process keeps only its own column; the other exists transiently
+    here, never in the protocol runtime."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    cov = [[1.0, args.rho], [args.rho, 1.0]]
+    xy = rng.multivariate_normal([0.0, 0.0], cov, size=n)
+    return (np.asarray(xy[:, 0], np.float32),
+            np.asarray(xy[:, 1], np.float32))
+
+
+def _protocol_spec(args):
+    from dpcorr.protocol import ProtocolSpec
+
+    return ProtocolSpec(family=args.family, n=args.n, eps1=args.eps1,
+                        eps2=args.eps2, alpha=args.alpha,
+                        normalise=args.normalise == "on",
+                        seed=args.seed, noise_mode=args.noise_mode,
+                        session=args.session or "")
+
+
+def _result_json(res) -> dict:
+    return {"role": res.role, "session": res.session,
+            "rho_hat": res.rho_hat, "ci_low": res.ci_low,
+            "ci_high": res.ci_high, "trace_id": res.trace_id,
+            "stats": res.stats}
+
+
+def cmd_party(args):
+    """One side of the two-party protocol over TCP (docs/PROTOCOL.md).
+    Role y listens, role x connects; each process sees one column."""
+    import numpy as np
+
+    from dpcorr.obs import trace as obs_trace
+    from dpcorr.obs.audit import AuditTrail
+    from dpcorr.protocol import Party, ReliableChannel, Transcript
+    from dpcorr.protocol.transport import tcp_accept, tcp_connect, tcp_listen
+    from dpcorr.serve.ledger import PrivacyLedger
+
+    if args.trace:
+        obs_trace.configure(args.trace)
+    spec = _protocol_spec(args)
+    if args.data:
+        col = np.asarray(np.load(args.data), np.float32)
+        if col.shape != (spec.n,):
+            raise SystemExit(f"--data has shape {col.shape}, spec says "
+                             f"({spec.n},)")
+    else:
+        cols = _party_columns(args, spec.n)
+        col = cols[0] if args.role == "x" else cols[1]
+    if args.role == "y":
+        srv, bound = tcp_listen(args.host, args.port)
+        print(json.dumps({"party": {"role": "y", "session": spec.session,
+                                    "listening": [args.host, bound]}}),
+              flush=True)
+        link = tcp_accept(srv, timeout_s=args.connect_timeout)
+        srv.close()
+    else:
+        print(json.dumps({"party": {"role": "x", "session": spec.session,
+                                    "connecting": [args.host, args.port]}}),
+              flush=True)
+        link = tcp_connect(args.host, args.port,
+                           timeout_s=args.connect_timeout)
+    audit = AuditTrail(args.audit) if args.audit else None
+    ledger = PrivacyLedger(args.budget, path=args.ledger, audit=audit)
+    channel = ReliableChannel(link, timeout_s=args.timeout,
+                              max_retries=args.max_retries)
+    party = Party(args.role, col, spec, channel, ledger,
+                  transcript=Transcript(args.transcript))
+    try:
+        res = party.run()
+    finally:
+        link.close()
+    print(json.dumps({"result": _result_json(res)}, indent=2))
+
+
+def cmd_protocol_run(args):
+    """Single-command driver: both roles in one process (threads) over
+    the chosen transport — the smoke/repro path for docs/PROTOCOL.md."""
+    from dpcorr.protocol import ProtocolError, run_inproc, run_tcp
+
+    spec = _protocol_spec(args)
+    x, y = _party_columns(args, spec.n)
+    fault = None
+    if args.fault_drop or args.fault_delay_ms or args.fault_duplicate:
+        fault = {"drop": args.fault_drop,
+                 "delay_s": args.fault_delay_ms / 1000.0,
+                 "duplicate": args.fault_duplicate}
+    run = run_tcp if args.transport == "tcp" else run_inproc
+    try:
+        results = run(spec, x, y, fault=fault,
+                      transcript_dir=args.transcript_dir,
+                      timeout_s=args.timeout, max_retries=args.max_retries)
+    except ProtocolError as e:
+        raise SystemExit(f"protocol aborted: {e}") from e
+    out = {"spec": spec.to_public(), "session": spec.session,
+           "results": {r: _result_json(res)
+                       for r, res in sorted(results.items())}}
+    agree = (results["x"].rho_hat == results["y"].rho_hat
+             and results["x"].ci_low == results["y"].ci_low
+             and results["x"].ci_high == results["y"].ci_high)
+    out["roles_agree"] = agree
+    print(json.dumps(out, indent=2))
+    if not agree:
+        raise SystemExit("role results diverged")
+
+
+def cmd_protocol_scan(args):
+    """Offline transcript audit (protocol.scan): message schema +
+    no-raw-columns, and — with --audit — the ε balance proof. jax-free;
+    exit 1 on any violation."""
+    from dpcorr.obs import read_events
+    from dpcorr.protocol.scan import ledger_balance, scan_transcript
+
+    rep = scan_transcript(args.transcript)
+    out = {"scan": rep}
+    ok = rep["ok"]
+    if args.audit:
+        bal = ledger_balance(args.transcript, read_events(args.audit))
+        out["balance"] = bal
+        ok = ok and bal["ok"]
+    print(json.dumps(out, indent=2))
+    if not ok:
+        sys.exit(1)
+
+
 def cmd_lint(args):
     """Static invariant checker over the repo's own source
     (docs/STATIC_ANALYSIS.md): RNG hygiene, budget discipline, lock
@@ -431,6 +569,99 @@ def main(argv=None):
     poc.add_argument("--out", required=True,
                      help="output Chrome trace JSON path")
     poc.set_defaults(fn=cmd_obs_chrome, platform=None, jax_free=True)
+    def _add_spec_flags(p):
+        p.add_argument("--family", default="ni_sign",
+                       choices=["ni_sign", "int_sign", "ni_subg",
+                                "int_subg"])
+        p.add_argument("--n", type=int, default=4000)
+        p.add_argument("--eps1", type=float, default=1.0)
+        p.add_argument("--eps2", type=float, default=0.5)
+        p.add_argument("--alpha", type=float, default=0.05)
+        p.add_argument("--normalise", default="on", choices=["on", "off"])
+        p.add_argument("--seed", type=int, default=2025)
+        p.add_argument("--session", default=None,
+                       help="session id (default: derived from the spec "
+                            "hash, so both parties agree without "
+                            "coordination)")
+        p.add_argument("--noise-mode", dest="noise_mode", default="replay",
+                       choices=["replay", "hardened"],
+                       help="key layout (utils.rng.party_root): 'replay' "
+                            "is bit-identical to the monolithic "
+                            "estimators; 'hardened' gives each party a "
+                            "disjoint key subtree")
+        p.add_argument("--rho", type=float, default=0.6,
+                       help="synthetic-data correlation (ignored with "
+                            "--data)")
+        p.add_argument("--timeout", type=float, default=10.0,
+                       help="per-message ack timeout (seconds)")
+        p.add_argument("--max-retries", dest="max_retries", type=int,
+                       default=10)
+        p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+
+    pp_ = sub.add_parser("party", help="one side of the two-party DP "
+                         "protocol over TCP: role y listens, role x "
+                         "connects; each process holds one column "
+                         "(docs/PROTOCOL.md)")
+    pp_.add_argument("--role", required=True, choices=["x", "y"])
+    pp_.add_argument("--host", default="127.0.0.1")
+    pp_.add_argument("--port", type=int, required=True)
+    pp_.add_argument("--connect-timeout", dest="connect_timeout",
+                     type=float, default=30.0,
+                     help="seconds to keep dialing (x) or await the "
+                          "peer (y)")
+    pp_.add_argument("--data", default=None,
+                     help="this party's column as a .npy file (shape "
+                          "(n,)); default: synthetic from --rho/--seed")
+    pp_.add_argument("--budget", type=float, default=100.0,
+                     help="this party's ε budget (basic composition)")
+    pp_.add_argument("--ledger", default=None,
+                     help="ledger persistence path (JSON), same format "
+                          "as serve --ledger")
+    pp_.add_argument("--transcript", default=None,
+                     help="JSONL wire transcript path (audit it with "
+                          "`dpcorr protocol scan`)")
+    pp_.add_argument("--trace", default=None,
+                     help="span-trace JSONL path; the trace ID crosses "
+                          "the wire, so both parties' logs join")
+    pp_.add_argument("--audit", default=None,
+                     help="budget audit-trail JSONL path (obs.audit)")
+    _add_spec_flags(pp_)
+    pp_.set_defaults(fn=cmd_party)
+
+    pr_ = sub.add_parser("protocol", help="two-party protocol tooling: "
+                         "single-command run (both roles, one process) "
+                         "and the jax-free transcript auditor")
+    pr_sub = pr_.add_subparsers(dest="protocol_cmd", required=True)
+    prr = pr_sub.add_parser("run", help="drive both roles in-process "
+                            "over inproc or loopback-TCP transport")
+    prr.add_argument("--transport", default="inproc",
+                     choices=["inproc", "tcp"])
+    prr.add_argument("--transcript-dir", dest="transcript_dir",
+                     default=None,
+                     help="write each party's wire transcript JSONL "
+                          "into this directory")
+    prr.add_argument("--fault-drop", dest="fault_drop", type=float,
+                     default=0.0, help="fault injection: drop rate")
+    prr.add_argument("--fault-delay-ms", dest="fault_delay_ms",
+                     type=float, default=0.0,
+                     help="fault injection: per-frame delay")
+    prr.add_argument("--fault-duplicate", dest="fault_duplicate",
+                     type=float, default=0.0,
+                     help="fault injection: duplicate rate")
+    _add_spec_flags(prr)
+    prr.set_defaults(fn=cmd_protocol_run)
+    prs = pr_sub.add_parser("scan", help="audit a party transcript: "
+                            "schema + no-raw-columns, and with --audit "
+                            "the transcript↔ledger ε balance; exit 1 on "
+                            "violations")
+    prs.add_argument("--transcript", required=True,
+                     help="party transcript JSONL (party --transcript / "
+                          "protocol run --transcript-dir)")
+    prs.add_argument("--audit", default=None,
+                     help="that party's audit-trail JSONL; enables the "
+                          "ε balance check")
+    prs.set_defaults(fn=cmd_protocol_scan, platform=None, jax_free=True)
+
     backends_by_cmd = {
         "grid": ("local", "sharded", "bucketed", "bucketed-sharded"),
         "grid-subg": ("local", "sharded", "bucketed", "bucketed-sharded"),

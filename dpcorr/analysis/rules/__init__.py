@@ -10,7 +10,9 @@ derive from this list.
 from dpcorr.analysis.rules.budget import BudgetChecker
 from dpcorr.analysis.rules.locks import LockChecker
 from dpcorr.analysis.rules.purity import PurityChecker
+from dpcorr.analysis.rules.rawdata import RawDataChecker
 from dpcorr.analysis.rules.rng import RngChecker
 
 #: registration order is report order for equal (path, line).
-ALL_CHECKERS = (RngChecker, BudgetChecker, LockChecker, PurityChecker)
+ALL_CHECKERS = (RngChecker, BudgetChecker, LockChecker, PurityChecker,
+                RawDataChecker)

@@ -1,22 +1,29 @@
-"""Budget discipline: charge-before-noise, refund-on-refusal (serve/).
+"""Budget discipline: charge-before-noise, refund-on-refusal
+(serve/ and protocol/).
 
 The serving layer's privacy invariant (serve.server module docstring)
 is structural: the ledger must be charged — and durably persisted —
 *before* a request can reach any noise-drawing execution path, and any
 post-charge refusal (queue backpressure, closed coalescer) must reverse
-the charge so shed load cannot drain budgets. Two rules, scoped to
-functions that *hold a ledger* (reference ``ledger``/``self.ledger``)
-— the admission layer — because below the admission boundary
-(the coalescer and kernel cache) requests are charged by contract:
+the charge so shed load cannot drain budgets. The protocol layer has
+the same invariant with the wire in place of the execution engine: a
+release may be handed to the transport (``channel.send``) only after
+``ledger.charge``, and a transport failure must refund — that is
+exactly ``protocol.gate.ReleaseGate``, and these rules keep it the
+*only* shape that lints. Two rules, scoped to functions that *hold a
+ledger* (reference ``ledger``/``self.ledger``) — the admission layer —
+because below the admission boundary (the coalescer, the kernel cache,
+a channel handed in by the gate) requests are charged by contract:
 
 - ``budget-uncharged-noise`` — an admission-layer function launches
-  work (``coalescer.submit`` / ``cache.run_batch``) with no
-  ``ledger.charge``/``charge_request`` earlier in the function: a query
-  could execute without its spend on disk.
+  work (``coalescer.submit`` / ``cache.run_batch`` / ``channel.send``)
+  with no ``ledger.charge``/``charge_request`` earlier in the
+  function: a query could execute — or a release cross the wire —
+  without its spend on disk.
 - ``budget-missing-refund`` — the launch is not wrapped in a ``try``
-  whose handler reaches ``ledger.refund``: an enqueue refusal after a
-  successful charge would consume ε for a query that was never
-  answered.
+  whose handler reaches ``ledger.refund``: an enqueue refusal (or a
+  transport failure) after a successful charge would consume ε for a
+  query that was never answered.
 """
 
 from __future__ import annotations
@@ -32,10 +39,12 @@ from dpcorr.analysis.core import (
     walk_same_scope,
 )
 
-#: method names that hand an admitted request to the execution layer.
-ENQUEUE_FNS = frozenset({"submit", "run_batch"})
+#: method names that hand an admitted request to the execution layer —
+#: or, in protocol/, a release to the transport.
+ENQUEUE_FNS = frozenset({"submit", "run_batch", "send", "send_release"})
 #: receivers those methods count on (any element of the access chain).
-ENQUEUE_RECEIVERS = frozenset({"coalescer", "cache"})
+ENQUEUE_RECEIVERS = frozenset({"coalescer", "cache", "channel",
+                               "transport"})
 
 CHARGE_FNS = frozenset({"charge", "charge_request"})
 REFUND_FNS = frozenset({"refund"})
@@ -65,7 +74,8 @@ class BudgetChecker(Checker):
     }
 
     def applies_to(self, relpath: str) -> bool:
-        return "serve" in relpath.split("/")
+        parts = relpath.split("/")
+        return "serve" in parts or "protocol" in parts
 
     def check(self, module: Module) -> Iterator[Violation]:
         for fn in ast.walk(module.tree):

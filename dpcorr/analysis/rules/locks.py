@@ -57,10 +57,13 @@ class LockChecker(Checker):
     }
 
     def applies_to(self, relpath: str) -> bool:
-        # the threaded layers: serve, obs, and the compile-ahead module
-        # (its SingleFlight inflight map is raced by design — ISSUE 4)
+        # the threaded layers: serve, obs, the protocol runtime (two
+        # party threads share transcript/channel state in-process), and
+        # the compile-ahead module (its SingleFlight inflight map is
+        # raced by design — ISSUE 4)
         parts = relpath.split("/")
         return ("serve" in parts or "obs" in parts
+                or "protocol" in parts
                 or relpath.endswith("utils/compile.py"))
 
     def check(self, module: Module) -> Iterator[Violation]:

@@ -1,0 +1,352 @@
+"""Two-party factoring of the monolithic estimators (reference layer L2½).
+
+The paper's deployment model is vertically partitioned: the X-party and
+the Y-party each hold one column and only DP releases may cross between
+them. The monolithic estimators in this package compute with both
+columns in one trace, so the privacy barrier exists only as prose. This
+module re-factors each family into the three pieces the barrier
+actually separates —
+
+- :func:`party_release` — the DP release ONE party constructs from its
+  own column alone (noisy batch means for the NI families, the
+  randomized-response sign vector / per-sample local-DP values for the
+  INT families);
+- :func:`finish` — the finisher's combination of the peer's released
+  quantities with its *own* column's contribution (its local release
+  for the NI families; the receiver-side product and central draw for
+  the INT families) into (ρ̂, CI);
+- :func:`split_estimate` — the two composed in one process, the
+  single-process reference the wire protocol (``dpcorr.protocol``) is
+  tested bit-identical against.
+
+The factoring is **bit-identical** to the monolithic estimators under
+the shared-seed ``"replay"`` key layout (pinned by
+tests/test_protocol.py): every draw keeps its monolithic named-stream
+address, and every combination keeps the monolithic association order.
+Where the wire forces a re-association (the INT-sign core when the
+sender is the y-side: ``((2s−1)·sign(y))·sign(x)`` instead of
+``((2s−1)·sign(x))·sign(y)``), every factor is exactly representable
+(±1/±0), so the product is exact and the re-association is still
+bit-equal. That is the design invariant: the barrier changes *where*
+computation happens, never *what* is computed.
+
+Key layouts (``utils.rng.party_root``): ``"replay"`` hands both parties
+the same session key — monolithic stream addresses, bit-identity, the
+simulation/testing mode. ``"hardened"`` roots each party in its own
+disjoint ``"protocol/x"`` / ``"protocol/y"`` subtree: the draws are
+statistically interchangeable but no longer bit-comparable, and —
+deployed with genuinely secret per-party seeds — one party can no
+longer reconstruct (and subtract) the other party's noise.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import ndtri
+
+from dpcorr.models.estimators.common import (
+    batch_geometry,
+    batch_means,
+    sample_sd,
+)
+from dpcorr.models.estimators.int_sign import interval_from_rho
+from dpcorr.models.estimators.int_subg import grid_interval
+from dpcorr.models.estimators.registry import FAMILIES
+from dpcorr.ops.lambdas import lambda_int_n, lambda_n
+from dpcorr.ops.noise import clip_sym, laplace
+from dpcorr.ops.standardize import priv_center
+from dpcorr.utils.rng import stream
+
+#: payload-entry kinds a release message may carry, per family — the
+#: closed vocabulary the transcript scanner checks against.
+RELEASE_KINDS = {
+    "ni_sign": {"batch_means": "noisy_sign_batch_means"},
+    "ni_subg": {"batch_means": "noisy_clipped_batch_means"},
+    "int_sign": {"flipped_signs": "rr_flipped_signs"},
+    "int_subg": {"ldp_values": "ldp_clipped_values"},
+}
+
+
+def split_roles(family: str, eps1: float, eps2: float) -> tuple[str, str]:
+    """(releaser, finisher) roles for one design point — static, public.
+
+    NI families: the x-party releases, the y-party finishes (both sides
+    release in principle; the finisher's own release never needs the
+    wire). INT families: the larger-ε side sends, exactly the
+    monolithic sender rule (ver-cor-subG.R:76-81, vert-cor.R:170-172).
+    """
+    if family not in FAMILIES:
+        raise ValueError(f"unknown estimator family {family!r}; "
+                         f"expected one of {FAMILIES}")
+    if family in ("ni_sign", "ni_subg"):
+        return "x", "y"
+    return ("x", "y") if bool(eps1 >= eps2) else ("y", "x")
+
+
+def release_schema(family: str, n: int, eps1: float,
+                   eps2: float) -> dict[str, dict]:
+    """Exact (kind, shape, dtype) of every array the releaser's wire
+    payload may contain — derived from public parameters only, so the
+    receiving party (and the offline transcript scan) can reject any
+    payload shaped like raw data before touching its values."""
+    kinds = RELEASE_KINDS[family]
+    if family in ("ni_sign", "ni_subg"):
+        m, k = batch_geometry(n, eps1, eps2)
+        shape = (k,)
+    else:
+        shape = (n,)
+    name = next(iter(kinds))
+    return {name: {"kind": kinds[name], "shape": shape,
+                   "dtype": "float32"}}
+
+
+def _own_eps(role: str, eps1: float, eps2: float) -> float:
+    return eps1 if role == "x" else eps2
+
+
+def _ni_sign_release(key, role, col, eps1, eps2, normalise):
+    """One side of ``ci_ni_signbatch`` (vert-cor.R:204-233): private
+    centering, sign batch means, the per-batch Laplace draws — the
+    exact monolithic streams ``ni_sign/{std,lap}_{x,y}``."""
+    n = col.shape[0]
+    m, k = batch_geometry(n, eps1, eps2)
+    eps = _own_eps(role, eps1, eps2)
+    if normalise:
+        l_clip = jnp.sqrt(2.0 * jnp.log(float(n)))
+        col = priv_center(stream(key, f"ni_sign/std_{role}"), col, eps,
+                          l_clip)
+    bar = batch_means(jnp.sign(col), k, m)
+    return bar + laplace(stream(key, f"ni_sign/lap_{role}"), (k,),
+                         2.0 / (m * eps))
+
+
+def _ni_subg_release(key, role, col, eps1, eps2):
+    """One side of ``correlation_ni_subg`` (grid variant, static
+    geometry — the serving configuration): clip at λ_n, batch means,
+    per-batch Laplace (streams ``ni_subg/lap_{x,y}``)."""
+    n = col.shape[0]
+    m, k = batch_geometry(n, eps1, eps2)
+    eps = _own_eps(role, eps1, eps2)
+    lam = lambda_n(n, 1.0)
+    bar = batch_means(clip_sym(col, lam), k, m)
+    return bar + laplace(stream(key, f"ni_subg/lap_{role}"), (k,),
+                         2.0 * lam / (m * eps))
+
+
+def _int_sign_release(key, role, col, eps1, eps2, normalise):
+    """The sender half of ``correlation_int_signflip``
+    (vert-cor.R:164-195): center own column, randomized-response flip
+    its signs. ``(2S−1)·sign(col)`` is the per-sample ε_s-local-DP
+    release; values are exactly ±1/±0, so the receiver-side product
+    re-association stays bit-exact (module docstring)."""
+    n = col.shape[0]
+    eps = _own_eps(role, eps1, eps2)
+    if normalise:
+        l_clip = jnp.sqrt(2.0 * jnp.log(float(n)))
+        col = priv_center(stream(key, f"int_sign/std_{role}"), col, eps,
+                          l_clip)
+    est = stream(key, "int_sign/est")
+    e_s = math.exp(max(eps1, eps2))
+    p_keep = e_s / (e_s + 1.0)
+    s = jax.random.bernoulli(stream(est, "int_sign/flips"), p_keep, (n,))
+    return (2.0 * s.astype(jnp.float32) - 1.0) * jnp.sign(col)
+
+
+def _int_subg_release(key, role, col, eps1, eps2):
+    """The sender half of ``ci_int_subg`` (grid variant,
+    ver-cor-subG.R:87-90): clip at λ_s, one Laplace draw *per sample*
+    (stream ``int_subg/lap_sender``) — the local-DP release."""
+    n = col.shape[0]
+    eps_s = max(eps1, eps2)
+    lam_s, _ = lambda_int_n(n, eta_s=1.0, eta_r=1.0, eps_s=eps_s)
+    sc = clip_sym(col, lam_s)
+    return sc + laplace(stream(key, "int_subg/lap_sender"), (n,),
+                        2.0 * lam_s / eps_s)
+
+
+@functools.lru_cache(maxsize=None)
+def _release_jit(family: str, role: str, eps1: float, eps2: float,
+                 normalise: bool):
+    """Compiled release kernel per (family, role, ε, normalise) — the
+    party-side computation must go through ``jit`` like the monolithic
+    serving entry does, or eager-mode op ordering drifts the last ulp
+    away from the jitted reference (bit-identity is the acceptance
+    bar, so the split pieces compile exactly like the whole)."""
+    return jax.jit(functools.partial(_release_impl, family, role,
+                                     eps1=eps1, eps2=eps2,
+                                     normalise=normalise))
+
+
+def _release_impl(family, role, key, col, *, eps1, eps2, normalise):
+    if family == "ni_sign":
+        return {"batch_means": _ni_sign_release(key, role, col, eps1,
+                                                eps2, normalise)}
+    if family == "ni_subg":
+        return {"batch_means": _ni_subg_release(key, role, col, eps1,
+                                                eps2)}
+    if family == "int_sign":
+        return {"flipped_signs": _int_sign_release(key, role, col, eps1,
+                                                   eps2, normalise)}
+    return {"ldp_values": _int_subg_release(key, role, col, eps1, eps2)}
+
+
+def party_release(family: str, key: jax.Array, role: str, col: jax.Array,
+                  eps1: float, eps2: float,
+                  normalise: bool = True) -> dict[str, jax.Array]:
+    """The DP release one party constructs from its own column alone.
+
+    ``key`` is that party's root (``utils.rng.party_root``); ``role``
+    is ``"x"`` or ``"y"``. Returns ``{}`` for the INT finisher role —
+    its ε is spent inside :func:`finish` (the receiver's central draw),
+    not as a wire payload. Everything raw stays inside this function:
+    the returned arrays are the only values allowed to leave the party.
+    """
+    if role not in ("x", "y"):
+        raise ValueError(f"role must be 'x' or 'y', got {role!r}")
+    releaser, _ = split_roles(family, eps1, eps2)
+    if family in ("int_sign", "int_subg") and role != releaser:
+        return {}
+    fn = _release_jit(family, role, float(eps1), float(eps2),
+                      bool(normalise))
+    return dict(fn(key, jnp.asarray(col, jnp.float32)))
+
+
+def _ni_sign_finish(key, role, rel, col, eps1, eps2, alpha, normalise):
+    n = col.shape[0]
+    m, k = batch_geometry(n, eps1, eps2)
+    own = _ni_sign_release(key, role, col, eps1, eps2, normalise)
+    # monolithic order: tj = m·xt·yt (vert-cor.R:233) — the x-side
+    # release is the left factor
+    xt, yt = (own, rel) if role == "x" else (rel, own)
+    tj = m * xt * yt
+    eta_hat = jnp.sum(tj) / k
+    rho_hat = jnp.sin(jnp.pi * eta_hat / 2.0)
+    s_eta = sample_sd(tj)
+    crit = ndtri(1.0 - alpha / 2.0)
+    half = crit * s_eta / jnp.sqrt(float(k))
+    lo = jnp.sin(jnp.pi / 2.0 * jnp.maximum(eta_hat - half, -1.0))
+    hi = jnp.sin(jnp.pi / 2.0 * jnp.minimum(eta_hat + half, 1.0))
+    return rho_hat, lo, hi
+
+
+def _ni_subg_finish(key, role, rel, col, eps1, eps2, alpha):
+    n = col.shape[0]
+    m, k = batch_geometry(n, eps1, eps2)
+    own = _ni_subg_release(key, role, col, eps1, eps2)
+    xt, yt = (own, rel) if role == "x" else (rel, own)
+    rho_hat = (m / k) * jnp.sum(xt * yt)
+    tj = m * xt * yt
+    se = sample_sd(tj) / jnp.sqrt(float(k))
+    crit = ndtri(1.0 - alpha / 2.0)
+    lo = jnp.maximum(rho_hat - crit * se, -1.0)
+    hi = jnp.minimum(rho_hat + crit * se, 1.0)
+    return rho_hat, lo, hi
+
+
+def _int_sign_finish(key, role, rel, col, eps1, eps2, alpha, normalise):
+    n = col.shape[0]
+    eps = _own_eps(role, eps1, eps2)
+    if normalise:
+        l_clip = jnp.sqrt(2.0 * jnp.log(float(n)))
+        col = priv_center(stream(key, f"int_sign/std_{role}"), col, eps,
+                          l_clip)
+    est = stream(key, "int_sign/est")
+    eps_s, eps_r = max(eps1, eps2), min(eps1, eps2)
+    e_s = math.exp(eps_s)
+    # exact ±1/±0 factors: this re-association of the monolithic core
+    # ((2S−1)·sign(x))·sign(y) is bit-equal (module docstring)
+    core = rel * jnp.sign(col)
+    scale_z = 2.0 * (e_s + 1.0) / (n * (e_s - 1.0) * eps_r)
+    z = laplace(stream(est, "int_sign/lap_z"), (), scale_z)
+    eta_hat = (e_s + 1.0) / (n * (e_s - 1.0)) * jnp.sum(core) + z
+    rho_hat = jnp.sin(jnp.pi * eta_hat / 2.0)
+    res = interval_from_rho(key, rho_hat, n, eps_s, eps_r, alpha,
+                            "auto", "det")
+    return res.rho_hat, res.ci_low, res.ci_high
+
+
+def _int_subg_finish(key, role, rel, col, eps1, eps2, alpha):
+    n = col.shape[0]
+    eps_s, eps_r = max(eps1, eps2), min(eps1, eps2)
+    lam_s, lam_r = lambda_int_n(n, eta_s=1.0, eta_r=1.0, eps_s=eps_s)
+    # grid variant: the receiver's own variable is NOT clipped
+    # (ver-cor-subG.R:92); the released factor stays on the left,
+    # matching the monolithic (sc + noise)·other association
+    u = rel * col
+    uc = clip_sym(u, lam_r)
+    central_scale = 2.0 * lam_r / (n * eps_r)
+    rho_hat = jnp.mean(uc) + laplace(stream(key, "int_subg/lap_recv"), (),
+                                     central_scale)
+    sd_uc = sample_sd(uc)
+    res = grid_interval(key, rho_hat, sd_uc, n, eps_r, central_scale,
+                        alpha, "det")
+    return res.rho_hat, res.ci_low, res.ci_high
+
+
+@functools.lru_cache(maxsize=None)
+def _finish_jit(family: str, eps1: float, eps2: float, alpha: float,
+                normalise: bool):
+    """Compiled finisher per design point (same jit rationale as
+    :func:`_release_jit`: the reference is jitted, so both halves of
+    the split must be too for the bit-identity contract)."""
+    return jax.jit(functools.partial(_finish_impl, family, eps1=eps1,
+                                     eps2=eps2, alpha=alpha,
+                                     normalise=normalise))
+
+
+def _finish_impl(family, key, rel, col, *, eps1, eps2, alpha, normalise):
+    _, finisher = split_roles(family, eps1, eps2)
+    if family == "ni_sign":
+        return _ni_sign_finish(key, finisher, rel, col, eps1, eps2,
+                               alpha, normalise)
+    if family == "ni_subg":
+        return _ni_subg_finish(key, finisher, rel, col, eps1, eps2, alpha)
+    if family == "int_sign":
+        return _int_sign_finish(key, finisher, rel, col, eps1, eps2,
+                                alpha, normalise)
+    return _int_subg_finish(key, finisher, rel, col, eps1, eps2, alpha)
+
+
+def finish(family: str, key: jax.Array, peer_release: dict, col: jax.Array,
+           eps1: float, eps2: float, alpha: float = 0.05,
+           normalise: bool = True) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The finisher's combination: peer's released quantities + its own
+    column's contribution → (ρ̂, ci_low, ci_high).
+
+    ``key`` is the *finisher's* root; ``col`` its raw column —
+    consumed only inside the same DP constructions the monolithic
+    estimator applies (its own release for NI; the receiver-side
+    product, clip and central draw for INT). ``peer_release`` is the
+    decoded wire payload, keyed as :func:`release_schema` names it.
+    """
+    name = next(iter(RELEASE_KINDS[family]))
+    if set(peer_release) != {name}:
+        raise ValueError(f"{family}: expected release payload {{{name!r}}}, "
+                         f"got {sorted(peer_release)}")
+    rel = jnp.asarray(peer_release[name], jnp.float32)
+    fn = _finish_jit(family, float(eps1), float(eps2), float(alpha),
+                     bool(normalise))
+    return fn(key, rel, jnp.asarray(col, jnp.float32))
+
+
+def split_estimate(family: str, key_x: jax.Array, key_y: jax.Array,
+                   x: jax.Array, y: jax.Array, eps1: float, eps2: float,
+                   alpha: float = 0.05, normalise: bool = True,
+                   ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The factored estimator composed in one process — the
+    single-process reference the protocol runtime is pinned against.
+    ``key_x``/``key_y`` are the per-party roots; pass the same key
+    twice for the ``"replay"`` layout (bit-identical to the monolithic
+    ``serving_entry`` closure on that key)."""
+    releaser, finisher = split_roles(family, eps1, eps2)
+    rel_key, fin_key = ((key_x, key_y) if releaser == "x"
+                        else (key_y, key_x))
+    rel_col, fin_col = (x, y) if releaser == "x" else (y, x)
+    rel = party_release(family, rel_key, releaser, rel_col, eps1, eps2,
+                        normalise)
+    return finish(family, fin_key, rel, fin_col, eps1, eps2, alpha,
+                  normalise)

@@ -45,8 +45,10 @@ from dpcorr.obs.trace import (  # noqa: F401
     Tracer,
     configure,
     current_span,
+    from_wire_headers,
     read_spans,
     to_chrome_trace,
     tracer,
+    wire_headers,
     write_chrome_trace,
 )

@@ -227,6 +227,31 @@ def tracer() -> Tracer:
     return _global
 
 
+# ---------------------------------------------------- wire propagation ----
+def wire_headers(ctx: SpanContext | Span | None) -> dict[str, str]:
+    """Serialize a span context into message headers, so one trace can
+    cover both protocol processes (dpcorr.protocol): the sender stamps
+    its current span here, the receiver parents its own spans on
+    :func:`from_wire_headers` of what arrived. Returns ``{}`` when
+    tracing is off (null span / ``None``) — absent headers, not empty
+    strings, so the receiving side stays a clean root."""
+    if ctx is None or ctx.trace_id is None:
+        return {}
+    return {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+
+
+def from_wire_headers(headers: dict | None) -> SpanContext | None:
+    """Inverse of :func:`wire_headers`: rebuild the remote parent
+    context from message headers, ``None`` when the peer wasn't
+    tracing."""
+    if not headers:
+        return None
+    tid, sid = headers.get("trace_id"), headers.get("span_id")
+    if not tid or not sid:
+        return None
+    return SpanContext(str(tid), str(sid))
+
+
 # ------------------------------------------------------ readers/export ----
 def read_spans(path: str) -> list[dict]:
     """Load a JSONL span log; raises ValueError naming the first bad

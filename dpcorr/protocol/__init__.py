@@ -1,0 +1,86 @@
+"""Two-party protocol runtime (ISSUE 5): the privacy barrier as a wire.
+
+The paper's deployment model is vertically partitioned — the X-party
+and the Y-party each hold one column, and only DP releases may cross
+between them — but the monolithic estimators compute with both columns
+in one process, so that barrier existed only as prose. This package
+makes it an execution mode: two role runtimes, a reliable message
+channel, and a release gate that charges the privacy ledger before any
+byte leaves the party.
+
+Layering (each module depends only on the ones above it):
+
+- :mod:`messages`  — versioned message schema, canonical deterministic
+  serialization, array wire encoding, JSONL transcript log per party.
+- :mod:`transport` — :class:`InProcTransport` (queue pair, tests) and
+  TCP with length-prefixed framing; :class:`ReliableChannel` adds
+  per-message timeout, bounded exponential-backoff retry, sequence
+  numbers with idempotent redelivery, and pluggable fault injection.
+- :mod:`gate`      — :class:`ReleaseGate`: ledger charge *before* send,
+  refusal on budget exhaustion, refund on transport failure.
+- :mod:`party`     — the X/Y role runtimes executing the NI and INT
+  protocols for all four estimator families as genuine exchanges; each
+  party constructs only its own column's releases
+  (models.estimators.split_reference) and the finisher combines
+  released quantities only.
+- :mod:`runner`    — drive both roles in one process (threads over
+  in-proc or loopback-TCP channels) for tests, benchmarks and
+  ``python -m dpcorr protocol run``.
+- :mod:`scan`      — offline transcript auditor: schema enforcement, the
+  no-raw-columns proof, and the transcript↔audit-trail ε balance.
+
+Protocol-mode estimates are **bit-identical** to the
+``split_reference`` factoring (and, in replay key layout, to the
+monolithic estimators) — pinned by tests/test_protocol.py. See
+docs/PROTOCOL.md for roles, the message table and failure semantics.
+"""
+
+# Exports resolve lazily (PEP 562): the party/runner layer reaches the
+# estimators (and therefore jax) at import time, but the scan layer must
+# stay importable where jax isn't installed — the auditor runs where the
+# estimators can't. An eager star-import here would weld them together.
+_EXPORTS = {
+    "ReleaseGate": "gate",
+    "PROTOCOL_VERSION": "messages",
+    "Message": "messages",
+    "Transcript": "messages",
+    "canonical_encode": "messages",
+    "decode_array": "messages",
+    "encode_array": "messages",
+    "read_transcript": "messages",
+    "Party": "party",
+    "ProtocolError": "party",
+    "ProtocolRefused": "party",
+    "ProtocolResult": "party",
+    "ProtocolSpec": "party",
+    "run_inproc": "runner",
+    "run_tcp": "runner",
+    "ledger_balance": "scan",
+    "scan_transcript": "scan",
+    "FaultInjector": "transport",
+    "InProcTransport": "transport",
+    "ReliableChannel": "transport",
+    "TransportError": "transport",
+    "tcp_connect": "transport",
+    "tcp_listen": "transport",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        submodule = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    value = getattr(
+        importlib.import_module(f"dpcorr.protocol.{submodule}"), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

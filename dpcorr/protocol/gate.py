@@ -1,0 +1,59 @@
+"""ReleaseGate: the ledger stands between computation and the wire.
+
+Everything that carries a DP release out of a party goes through
+:meth:`ReleaseGate.send_release`, and the ordering is the whole point:
+
+1. ``ledger.charge`` first — all-or-nothing across the named parties,
+   durably persisted before it returns (serve.ledger). If the budget is
+   exhausted, :class:`~dpcorr.serve.ledger.BudgetExceededError`
+   propagates and **no message is sent**: the peer learns nothing
+   beyond the abort the party chooses to signal.
+2. only then the channel send. If delivery *fails*
+   (:class:`~dpcorr.protocol.transport.TransportError` after the retry
+   budget), the charge is refunded — the release never reached anyone,
+   so the ε was provably not consumed. Note the asymmetry with
+   success-side accounting: an ack timeout where the peer actually got
+   the frame still counts as failure and refunds, which errs toward
+   *over*-refunding only when the peer is also crashing out of the
+   protocol (it will not use a release from an aborted session); the
+   ledger's own clamp keeps refunds from going negative.
+
+The same charge-before-send / refund-on-refusal discipline the serve
+admission path follows is enforced on this module by the budget lint
+rule (analysis/rules/budget.py, extended to ``protocol/`` in this PR):
+a release send not dominated by a gate charge is a lint error anywhere
+in the package.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from dpcorr.protocol.transport import ReliableChannel, TransportError
+from dpcorr.serve.ledger import PrivacyLedger
+
+
+class ReleaseGate:
+    """Charges ``ledger`` before any gated send; refunds on transport
+    failure. The party runtime holds its ledger only through this gate,
+    so every path from estimator output to the wire passes here."""
+
+    def __init__(self, ledger: PrivacyLedger):
+        self.ledger = ledger
+
+    def send_release(self, channel: ReliableChannel, body: dict,
+                     charges: Mapping[str, float],
+                     trace_id: str | None = None) -> dict:
+        """Charge, then send; returns the channel receipt augmented
+        with the total ε charged (for the transcript's ``eps`` column).
+
+        Raises ``BudgetExceededError`` (nothing sent, nothing spent)
+        or ``TransportError`` (charge refunded)."""
+        self.ledger.charge(charges, trace_id=trace_id)
+        try:
+            receipt = channel.send(body)
+        except TransportError:
+            self.ledger.refund(charges, trace_id=trace_id)
+            raise
+        receipt["eps"] = float(sum(charges.values()))
+        return receipt

@@ -1,0 +1,402 @@
+"""PartyX/PartyY role runtimes: the estimator protocols as messages.
+
+One :class:`Party` instance is one side of one protocol session. It
+holds exactly one raw column, a reliable channel to the peer, and a
+:class:`~dpcorr.protocol.gate.ReleaseGate` wrapping its privacy ledger
+— the ledger is reachable *only* through the gate, so there is no code
+path from this module to the wire that skips the charge.
+
+Session shape (see docs/PROTOCOL.md for the full table):
+
+1. ``hello`` / ``hello_ack`` — X sends the spec hash (and the public
+   spec for operator sanity), Y refuses the session unless the hash
+   matches its own spec byte-for-byte. No ε is spent before this pins
+   that both sides agree on family, n, ε's, seed and key layout.
+2. ``release`` — the releasing role (split_reference.split_roles: the
+   x-side for NI, the larger-ε side for INT) computes its column's DP
+   release and sends it through the gate (charge → send → refund on
+   transport failure).
+3. ``result`` — the finishing role validates the payload against the
+   family's release schema, combines it with its *own* column's
+   contribution (models.estimators.split_reference.finish — spending
+   its own ε, also gated), and returns (ρ̂, CI) to the peer.
+4. ``error`` — either side aborts (budget refusal, validation failure);
+   carries a reason string, never arrays, and is deliberately ungated.
+
+Noise keys come from ``utils.rng.party_root``: ``"replay"`` reproduces
+the monolithic stream addresses (bit-identity acceptance), and
+``"hardened"`` roots each party in its disjoint ``"protocol/x"`` /
+``"protocol/y"`` subtree. Tracing: X opens the session's root span and
+its context rides the ``hello`` headers (obs.wire_headers), so Y's
+spans — in another process — join the same trace ID.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from dpcorr.obs import from_wire_headers, tracer, wire_headers
+from dpcorr.protocol.gate import ReleaseGate
+from dpcorr.protocol.messages import (
+    Message,
+    Transcript,
+    canonical_encode,
+    decode_array,
+    encode_array,
+)
+from dpcorr.protocol.transport import ReliableChannel, TransportError
+from dpcorr.serve.ledger import (
+    BudgetExceededError,
+    PrivacyLedger,
+    release_factor,
+)
+
+
+class ProtocolError(Exception):
+    """Protocol violation: bad spec hash, malformed payload, unexpected
+    message type. Not a budget event."""
+
+
+class ProtocolRefused(Exception):
+    """The session aborted on a budget refusal — locally (our ledger
+    refused a gated send; nothing was sent) or remotely (the peer sent
+    ``error`` with kind ``budget``)."""
+
+
+@dataclass(frozen=True)
+class ProtocolSpec:
+    """The public design point both parties must agree on before any ε
+    is spent. Everything here is public parameters — the spec hash in
+    ``hello`` commits to it without revealing anything private."""
+
+    family: str
+    n: int
+    eps1: float
+    eps2: float
+    alpha: float = 0.05
+    normalise: bool = True
+    seed: int = 2025
+    noise_mode: str = "replay"
+    party_x: str = "party-x"
+    party_y: str = "party-y"
+    session: str = ""
+
+    def __post_init__(self):
+        if self.session == "":
+            object.__setattr__(self, "session",
+                               f"sess-{self.spec_hash()[:12]}")
+
+    def to_public(self) -> dict:
+        return {"family": self.family, "n": int(self.n),
+                "eps1": float(self.eps1), "eps2": float(self.eps2),
+                "alpha": float(self.alpha),
+                "normalise": bool(self.normalise),
+                "seed": int(self.seed), "noise_mode": self.noise_mode,
+                "party_x": self.party_x, "party_y": self.party_y}
+
+    def spec_hash(self) -> str:
+        return hashlib.sha256(canonical_encode(self.to_public())).hexdigest()
+
+    def party_name(self, role: str) -> str:
+        return self.party_x if role == "x" else self.party_y
+
+    def own_eps(self, role: str) -> float:
+        return self.eps1 if role == "x" else self.eps2
+
+    def charges_for(self, role: str) -> dict[str, float]:
+        """This role's ε spend for its side of the protocol —
+        its own ε times the family's release factor (the private
+        centering double-spend for sign families, serve.ledger). The
+        two roles' charges sum to exactly ``request_charges`` of the
+        equivalent serve request, so serving-mode and protocol-mode
+        accounting can never drift."""
+        f = release_factor(self.family, self.normalise)
+        return {self.party_name(role): float(self.own_eps(role)) * f}
+
+
+@dataclass
+class ProtocolResult:
+    """One party's view of a completed session."""
+
+    role: str
+    session: str
+    rho_hat: float
+    ci_low: float
+    ci_high: float
+    trace_id: str | None = None
+    stats: dict = field(default_factory=dict)
+
+
+def _result_floats(rho, lo, hi) -> dict:
+    """(ρ̂, CI) as wire floats. float32 → Python float (binary64) is
+    exact, and repr round-trips binary64 exactly, so casting back to
+    float32 on the far side restores the identical bits — the result
+    message never perturbs the estimate."""
+    return {"rho_hat": float(rho), "ci_low": float(lo),
+            "ci_high": float(hi)}
+
+
+class Party:
+    """One role ("x" or "y") of one protocol session.
+
+    ``column`` is this party's raw column — it never leaves this object
+    except through ``split_reference.party_release``/``finish`` (DP
+    releases) and is never serialized. ``ledger`` is wrapped in the
+    release gate immediately; the party itself keeps no direct
+    reference.
+    """
+
+    def __init__(self, role: str, column, spec: ProtocolSpec,
+                 channel: ReliableChannel, ledger: PrivacyLedger,
+                 transcript: Transcript | None = None,
+                 recv_timeout_s: float = 30.0):
+        if role not in ("x", "y"):
+            raise ValueError(f"role must be 'x' or 'y', got {role!r}")
+        col = np.asarray(column, dtype=np.float32)
+        if col.ndim != 1 or col.shape[0] != spec.n:
+            raise ValueError(
+                f"column must be shape ({spec.n},), got {col.shape}")
+        self.role = role
+        self._column = col
+        self.spec = spec
+        self.channel = channel
+        self._gate = ReleaseGate(ledger)
+        self.transcript = transcript or Transcript(None)
+        self.recv_timeout_s = recv_timeout_s
+        self._span = None
+
+    # ------------------------------------------------------- plumbing ----
+    def _headers(self) -> dict:
+        return wire_headers(self._span.context
+                            if self._span is not None else None)
+
+    def _trace_id(self) -> str | None:
+        return self._span.trace_id if self._span is not None else None
+
+    def _record(self, direction: str, msg: Message, receipt: dict,
+                eps: float = 0.0) -> None:
+        self.transcript.record(
+            direction, msg, seq=receipt.get("seq", -1),
+            n_bytes=receipt.get("bytes", len(msg.encode())),
+            retries=receipt.get("retries", 0),
+            latency_s=receipt.get("latency_s", 0.0), eps=eps)
+
+    def _send_plain(self, msg: Message) -> None:
+        """Ungated send — only for messages that carry no DP release
+        (hello/hello_ack/error; the lint rule keys on this split)."""
+        receipt = self.channel.send(msg.to_wire())
+        self._record("send", msg, receipt)
+
+    def _linger(self) -> None:
+        """Drain the channel after receiving the session's final
+        message — but only when loss is actually possible (fault
+        injection active, or retransmissions already happened): a clean
+        queue/TCP link never drops an ack, and the idle window would
+        otherwise tax every clean session's latency for nothing."""
+        if self.channel.fault is not None or self.channel.total_retries:
+            self.channel.drain()
+
+    def _send_best_effort(self, msg: Message) -> None:
+        """Abort notification: the peer may already be gone (its own
+        abort crossed ours, or chaos ate the session) — a delivery
+        failure here must not mask the refusal we are about to raise."""
+        try:
+            self._send_plain(msg)
+        except TransportError:
+            pass
+
+    def _send_gated(self, msg: Message) -> None:
+        """Charge this role's ε, then send; refund handled inside the
+        gate. On refusal, signal the peer with an ungated ``error`` so
+        it stops waiting, then raise :class:`ProtocolRefused`."""
+        charges = self.spec.charges_for(self.role)
+        try:
+            receipt = self._gate.send_release(
+                self.channel, msg.to_wire(), charges,
+                trace_id=self._trace_id())
+        except BudgetExceededError as e:
+            abort = self._msg("error", {
+                "kind": "budget", "reason": str(e), "party": e.party})
+            self._send_best_effort(abort)
+            raise ProtocolRefused(str(e)) from e
+        self._record("send", msg, receipt, eps=receipt["eps"])
+
+    def _recv(self, *expect: str) -> Message:
+        got = self.channel.recv(timeout_s=self.recv_timeout_s)
+        msg = Message.from_wire(got["body"])
+        self._record("recv", msg, {"seq": got["seq"]})
+        if msg.session != self.spec.session:
+            raise ProtocolError(
+                f"session mismatch: peer says {msg.session!r}, "
+                f"ours is {self.spec.session!r}")
+        if msg.msg_type == "error":
+            # terminal inbound: linger so the peer's abort send doesn't
+            # fail on a chaos-dropped ack after we raise (transport.drain)
+            self._linger()
+            kind = msg.payload.get("kind", "protocol")
+            reason = msg.payload.get("reason", "peer aborted")
+            if kind == "budget":
+                raise ProtocolRefused(f"peer refused: {reason}")
+            raise ProtocolError(f"peer error: {reason}")
+        if msg.msg_type not in expect:
+            raise ProtocolError(
+                f"expected {expect}, got {msg.msg_type!r}")
+        return msg
+
+    def _msg(self, msg_type: str, payload: dict) -> Message:
+        return Message(msg_type=msg_type, sender=self.role,
+                       session=self.spec.session, payload=payload,
+                       headers=self._headers())
+
+    # ------------------------------------------------------ handshake ----
+    def _handshake(self) -> None:
+        """X proposes (opening the trace root), Y verifies the spec
+        hash and parents its root span on the proposal's context —
+        from here both processes share one trace ID."""
+        if self.role == "x":
+            self._span = tracer().start_span(
+                "protocol.session", role=self.role,
+                family=self.spec.family, session=self.spec.session)
+            hello = self._msg("hello", {
+                "spec": self.spec.to_public(),
+                "spec_hash": self.spec.spec_hash()})
+            self._send_plain(hello)
+            self._recv("hello_ack")
+        else:
+            first = self._recv("hello")
+            self._span = tracer().start_span(
+                "protocol.session", parent=from_wire_headers(first.headers),
+                role=self.role, family=self.spec.family,
+                session=self.spec.session)
+            theirs = first.payload.get("spec_hash")
+            if theirs != self.spec.spec_hash():
+                refusal = self._msg("error", {
+                    "kind": "protocol",
+                    "reason": f"spec hash mismatch: {theirs!r}"})
+                self._send_best_effort(refusal)
+                raise ProtocolError(
+                    f"peer spec hash {theirs!r} != ours "
+                    f"{self.spec.spec_hash()!r}")
+            ack = self._msg("hello_ack",
+                            {"spec_hash": self.spec.spec_hash()})
+            self._send_plain(ack)
+
+    # ----------------------------------------------------- estimation ----
+    def _root_key(self):
+        from dpcorr.utils import rng
+
+        return rng.party_root(rng.master_key(self.spec.seed), self.role,
+                              self.spec.noise_mode)
+
+    def _run_releaser(self) -> ProtocolResult:
+        from dpcorr.models.estimators import split_reference as sr
+
+        s = self.spec
+        with tracer().span("protocol.release", parent=self._span,
+                           role=self.role):
+            rel = sr.party_release(s.family, self._root_key(), self.role,
+                                   self._column, s.eps1, s.eps2,
+                                   s.normalise)
+            kinds = sr.RELEASE_KINDS[s.family]
+            payload = {name: encode_array(np.asarray(arr),
+                                          kind=kinds[name])
+                       for name, arr in rel.items()}
+        outbound = self._msg("release", payload)
+        self._send_gated(outbound)
+        final = self._recv("result")
+        # result is the session's last message and we are its receiver:
+        # linger so our ack loss doesn't strand the finisher mid-send
+        self._linger()
+        p = final.payload
+        return ProtocolResult(
+            role=self.role, session=s.session,
+            rho_hat=p["rho_hat"], ci_low=p["ci_low"],
+            ci_high=p["ci_high"], trace_id=self._trace_id(),
+            stats=self._stats())
+
+    def _validate_release(self, msg: Message) -> dict:
+        """Enforce the family's release schema on the inbound payload
+        *before* touching values: unexpected keys, missing envelopes,
+        wrong kind/shape/dtype are protocol errors. This is the
+        receiving half of the no-raw-columns barrier — a payload shaped
+        like a raw column cannot reach the finisher."""
+        from dpcorr.models.estimators import split_reference as sr
+
+        s = self.spec
+        schema = sr.release_schema(s.family, s.n, s.eps1, s.eps2)
+        payload = msg.payload
+        if set(payload) != set(schema):
+            raise ProtocolError(
+                f"release payload keys {sorted(payload)} != schema "
+                f"{sorted(schema)}")
+        out = {}
+        for name, want in schema.items():
+            env = payload[name]
+            if not (isinstance(env, dict) and env.get("__array__") == 1):
+                raise ProtocolError(f"release[{name!r}] is not an "
+                                    "array envelope")
+            if env.get("kind") != want["kind"]:
+                raise ProtocolError(
+                    f"release[{name!r}] kind {env.get('kind')!r} != "
+                    f"{want['kind']!r}")
+            arr = decode_array(env)
+            if tuple(arr.shape) != tuple(want["shape"]) \
+                    or str(arr.dtype) != want["dtype"]:
+                raise ProtocolError(
+                    f"release[{name!r}] is {arr.dtype}{arr.shape}, "
+                    f"schema says {want['dtype']}{tuple(want['shape'])}")
+            out[name] = arr
+        return out
+
+    def _run_finisher(self) -> ProtocolResult:
+        from dpcorr.models.estimators import split_reference as sr
+
+        s = self.spec
+        inbound = self._recv("release")
+        peer_release = self._validate_release(inbound)
+        with tracer().span("protocol.finish", parent=self._span,
+                           role=self.role):
+            rho, lo, hi = sr.finish(s.family, self._root_key(),
+                                    peer_release, self._column, s.eps1,
+                                    s.eps2, s.alpha, s.normalise)
+        outbound = self._msg("result", _result_floats(rho, lo, hi))
+        self._send_gated(outbound)
+        # our result being acked does NOT mean our ack of the peer's
+        # release got through: the releaser absorbs the result (and acks
+        # it) from inside its own blocked send, so it can still be
+        # retransmitting the release after this send returns. Linger to
+        # keep re-acking, or chaos strands the releaser mid-send.
+        self._linger()
+        return ProtocolResult(
+            role=self.role, session=s.session,
+            rho_hat=float(rho), ci_low=float(lo), ci_high=float(hi),
+            trace_id=self._trace_id(), stats=self._stats())
+
+    def _stats(self) -> dict:
+        ch = self.channel
+        out = {"sent_msgs": ch.sent_msgs,
+               "total_retries": ch.total_retries}
+        if ch.fault is not None:
+            out["fault"] = ch.fault.stats()
+        return out
+
+    def run(self) -> ProtocolResult:
+        """Execute this role's side of the session to completion."""
+        from dpcorr.models.estimators import split_reference as sr
+
+        s = self.spec
+        self._handshake()
+        releaser, _ = sr.split_roles(s.family, s.eps1, s.eps2)
+        try:
+            if self.role == releaser:
+                result = self._run_releaser()
+            else:
+                result = self._run_finisher()
+        finally:
+            if self._span is not None:
+                self._span.end()
+            self.transcript.close()
+        return result

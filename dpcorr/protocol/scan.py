@@ -1,0 +1,238 @@
+"""Offline transcript auditor: schema, no-raw-columns, ε balance.
+
+A party's transcript (protocol.messages.Transcript) records the full
+wire dict of every frame it sent or received, so the privacy claims of
+a finished session are *checkable from the log alone*:
+
+- :func:`scan_transcript` — the structural audit. Every wire object
+  must parse as a versioned message from the closed vocabulary; array
+  envelopes may appear **only** inside ``release`` payloads and must
+  match the family's wire schema (kind, shape, dtype) derived from the
+  session's own ``hello`` spec; value-level checks (sign releases take
+  values only in {−1, 0, +1}) plus — when the caller supplies the raw
+  columns — the no-raw-columns proof: no released array may reproduce a
+  raw column (or its sign/clip image) beyond the exact-match rate DP
+  noise permits.
+- :func:`ledger_balance` — the accounting audit. Every gated send in
+  the transcript (``eps > 0``) must match exactly one durable ``charge``
+  event in the party's audit trail (same trace, same total ε) and vice
+  versa, and replaying the trail must land on the same per-party totals
+  — a release that crossed the wire without a durable charge, or a
+  charge with no corresponding message, both surface as violations.
+
+Deliberately jax-free (stdlib + numpy): the auditor must run where the
+estimators can't, and must not share code paths with the thing it
+audits. The wire schema is therefore *re-derived* here from the public
+batch-geometry rule — test_protocol.py pins it equal to
+``split_reference.release_schema`` so the two can never drift silently.
+"""
+
+from __future__ import annotations
+
+import math
+
+from dpcorr.obs.audit import replay
+from dpcorr.protocol.messages import (
+    MSG_TYPES,
+    PROTOCOL_VERSION,
+    decode_array,
+    iter_arrays,
+    read_transcript,
+)
+
+#: exact-match fraction a continuous-noise release may share with a raw
+#: column: Laplace noise makes exact float equality measure-zero, so
+#: anything above ~1% of entries means the "release" is raw data.
+RAW_MATCH_MAX = 0.01
+
+_SIGN_VALUES = (-1.0, -0.0, 0.0, 1.0)
+
+
+def wire_schema(family: str, n: int, eps1: float, eps2: float) -> dict:
+    """Pure-Python mirror of ``split_reference.release_schema`` (the
+    batch-geometry rule ⌈8/(ε₁ε₂)⌉ capped at n; see module docstring
+    for why this is re-derived rather than imported)."""
+    kinds = {
+        "ni_sign": ("batch_means", "noisy_sign_batch_means"),
+        "ni_subg": ("batch_means", "noisy_clipped_batch_means"),
+        "int_sign": ("flipped_signs", "rr_flipped_signs"),
+        "int_subg": ("ldp_values", "ldp_clipped_values"),
+    }
+    if family not in kinds:
+        raise ValueError(f"unknown family {family!r}")
+    name, kind = kinds[family]
+    if family in ("ni_sign", "ni_subg"):
+        m = min(math.ceil(8.0 / (eps1 * eps2)), n)
+        shape = (n // m,)
+    else:
+        shape = (n,)
+    return {name: {"kind": kind, "shape": shape, "dtype": "float32"}}
+
+
+def _violation(out: list, entry_idx: int, rule: str, detail: str) -> None:
+    out.append({"entry": entry_idx, "rule": rule, "detail": detail})
+
+
+def _spec_from_hello(entries: list[dict]) -> dict | None:
+    for e in entries:
+        w = e.get("wire", {})
+        if w.get("msg_type") == "hello":
+            return w.get("payload", {}).get("spec")
+    return None
+
+
+def _check_raw(viol: list, idx: int, rel, raws: dict) -> None:
+    """The no-raw-columns proof against supplied raw columns. Shapes
+    that cannot hold a column pass trivially; same-shape arrays must
+    differ from the raw column (and its sign image) in all but a
+    noise-consistent fraction of entries."""
+    import numpy as np
+
+    for col_name, raw in raws.items():
+        raw = np.asarray(raw, dtype=np.float32)
+        if rel.shape != raw.shape:
+            continue
+        frac = float(np.mean(rel == raw))
+        if frac > RAW_MATCH_MAX:
+            _violation(viol, idx, "raw-column-on-wire",
+                       f"release matches raw {col_name} on "
+                       f"{frac:.1%} of entries")
+        # a sign image is raw data too: randomized response must have
+        # flipped SOMETHING, and batch noise never reproduces it exactly
+        if bool(np.array_equal(rel, np.sign(raw))):
+            _violation(viol, idx, "raw-column-on-wire",
+                       f"release equals sign({col_name}) exactly — "
+                       "no randomization applied")
+
+
+def scan_transcript(transcript, spec: dict | None = None,
+                    raw_x=None, raw_y=None) -> dict:
+    """Audit one party's transcript. ``transcript`` is a path or the
+    entry list from :func:`~dpcorr.protocol.messages.read_transcript`;
+    ``spec`` overrides the hello-embedded public spec (they are
+    cross-checked when both exist). Returns ``{"ok", "violations",
+    "messages", "releases", "gated_eps"}`` — never raises on content
+    violations, only on an unreadable transcript."""
+    import numpy as np
+
+    entries = (read_transcript(transcript) if isinstance(transcript, str)
+               else list(transcript))
+    viol: list[dict] = []
+    hello_spec = _spec_from_hello(entries)
+    if spec is not None and hello_spec is not None and spec != hello_spec:
+        _violation(viol, -1, "spec-mismatch",
+                   "supplied spec differs from the transcript's hello")
+    eff = spec or hello_spec
+    schema = (wire_schema(eff["family"], int(eff["n"]),
+                          float(eff["eps1"]), float(eff["eps2"]))
+              if eff else None)
+    raws = {}
+    if raw_x is not None:
+        raws["x"] = raw_x
+    if raw_y is not None:
+        raws["y"] = raw_y
+
+    releases = 0
+    gated_eps = 0.0
+    for idx, entry in enumerate(entries):
+        w = entry["wire"]
+        if w.get("version") != PROTOCOL_VERSION:
+            _violation(viol, idx, "bad-version",
+                       f"version {w.get('version')!r}")
+            continue
+        mtype = w.get("msg_type")
+        if mtype not in MSG_TYPES:
+            _violation(viol, idx, "unknown-type", f"msg_type {mtype!r}")
+            continue
+        payload = w.get("payload", {})
+        arrays = list(iter_arrays(payload))
+        if mtype != "release":
+            if arrays:
+                _violation(viol, idx, "array-outside-release",
+                           f"{len(arrays)} array(s) in a {mtype} message")
+            continue
+        releases += 1
+        if entry.get("dir") == "send":
+            gated_eps += float(entry.get("eps", 0.0))
+        if schema is None:
+            _violation(viol, idx, "no-spec",
+                       "release before any hello spec; cannot validate")
+            continue
+        if set(payload) != set(schema):
+            _violation(viol, idx, "schema-keys",
+                       f"payload keys {sorted(payload)} != "
+                       f"{sorted(schema)}")
+            continue
+        for name, want in schema.items():
+            env = payload[name]
+            if not (isinstance(env, dict) and env.get("__array__") == 1):
+                _violation(viol, idx, "schema-envelope",
+                           f"{name!r} is not an array envelope")
+                continue
+            if env.get("kind") != want["kind"]:
+                _violation(viol, idx, "schema-kind",
+                           f"{name!r} kind {env.get('kind')!r} != "
+                           f"{want['kind']!r}")
+            rel = decode_array(env)
+            if tuple(rel.shape) != want["shape"] \
+                    or str(rel.dtype) != want["dtype"]:
+                _violation(viol, idx, "schema-shape",
+                           f"{name!r} is {rel.dtype}{rel.shape}, schema "
+                           f"says {want['dtype']}{want['shape']}")
+                continue
+            if name == "flipped_signs":
+                bad = ~np.isin(rel, np.asarray(_SIGN_VALUES, np.float32))
+                if bool(bad.any()):
+                    _violation(viol, idx, "sign-values",
+                               f"{int(bad.sum())} values outside "
+                               "{-1, 0, +1}")
+            _check_raw(viol, idx, rel, raws)
+
+    return {"ok": not viol, "violations": viol,
+            "messages": len(entries), "releases": releases,
+            "gated_eps": gated_eps}
+
+
+def ledger_balance(transcript, audit_events: list[dict]) -> dict:
+    """Match every gated send in the transcript to exactly one durable
+    ``charge`` event and vice versa (same trace ID, same total ε), and
+    compare per-party replay totals. Refunded charges (a refund event
+    with the same trace) are excluded from the expected set — their
+    release never counted. Returns ``{"ok", "unmatched_sends",
+    "unmatched_charges", "spent"}``."""
+    entries = (read_transcript(transcript) if isinstance(transcript, str)
+               else list(transcript))
+    sends = [e for e in entries
+             if e.get("dir") == "send" and float(e.get("eps", 0.0)) > 0.0]
+    refunded = {ev.get("trace_id") for ev in audit_events
+                if ev["kind"] == "refund"}
+    charges = [ev for ev in audit_events
+               if ev["kind"] == "charge"
+               and ev.get("trace_id") not in refunded]
+
+    unmatched_sends = []
+    pool = list(charges)
+    for e in sends:
+        eps = float(e.get("eps", 0.0))
+        tid = e.get("trace_id")
+        hit = None
+        for ev in pool:
+            if ev.get("trace_id") == tid \
+                    and abs(sum(ev["charges"].values()) - eps) < 1e-9:
+                hit = ev
+                break
+        if hit is None:
+            unmatched_sends.append({"seq": e.get("seq"), "eps": eps,
+                                    "trace_id": tid})
+        else:
+            pool.remove(hit)
+    unmatched_charges = [{"seq": ev.get("seq"),
+                          "eps": sum(ev["charges"].values()),
+                          "trace_id": ev.get("trace_id")}
+                         for ev in pool]
+    return {
+        "ok": not unmatched_sends and not unmatched_charges,
+        "unmatched_sends": unmatched_sends,
+        "unmatched_charges": unmatched_charges,
+        "spent": replay(audit_events),
+    }

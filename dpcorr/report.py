@@ -289,6 +289,28 @@ def serve_stats_frame(snapshot: dict) -> pd.DataFrame:
     return pd.DataFrame(rows, columns=["metric", "value"])
 
 
+def protocol_transcript_frame(transcript) -> pd.DataFrame:
+    """One party's wire transcript (protocol.messages.Transcript JSONL,
+    or the entry list from ``read_transcript``) as a tidy per-message
+    frame — the protocol-mode sibling of :func:`serve_stats_frame`.
+    One row per frame: direction, sequence number, message type, wire
+    bytes, retry count, send latency, the ε charged through the release
+    gate (0 for ungated traffic) and the trace ID, ordered as logged."""
+    from dpcorr.protocol.messages import read_transcript
+
+    entries = (read_transcript(transcript) if isinstance(transcript, str)
+               else list(transcript))
+    rows = [{"seq": e.get("seq"), "dir": e.get("dir"),
+             "type": e.get("wire", {}).get("msg_type"),
+             "bytes": e.get("bytes"), "retries": e.get("retries"),
+             "latency_s": e.get("latency_s"), "eps": e.get("eps"),
+             "trace_id": e.get("trace_id"), "ts": e.get("ts")}
+            for e in entries]
+    return pd.DataFrame(rows, columns=["seq", "dir", "type", "bytes",
+                                       "retries", "latency_s", "eps",
+                                       "trace_id", "ts"])
+
+
 def render_all(grid_detail: pd.DataFrame | None = None,
                grid_summ: pd.DataFrame | None = None,
                hrs_summ: pd.DataFrame | None = None,

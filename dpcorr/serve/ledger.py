@@ -72,6 +72,20 @@ class BudgetExceededError(Exception):
             f"> budget {budget:.6g}")
 
 
+def release_factor(family: str, normalise: bool) -> float:
+    """Spend multiplier for one side's release under basic composition.
+
+    Sign families with ``normalise`` privately center the variable
+    first, spending that side's ε a second time before the sign-batch /
+    flip release (vert-cor.R:211-215); the subG families clip with
+    data-independent λ_n bounds instead, so they spend once. Shared by
+    the serving admission path (:func:`request_charges`) and the
+    two-party protocol's per-role charge (protocol.party) so the two
+    deployment modes can never drift on what a release costs.
+    """
+    return 2.0 if (family in ("ni_sign", "int_sign") and normalise) else 1.0
+
+
 def request_charges(req: EstimateRequest) -> dict[str, float]:
     """Per-party ε spend of one request under basic composition.
 
@@ -80,8 +94,7 @@ def request_charges(req: EstimateRequest) -> dict[str, float]:
     a request whose two sides name the same party accumulates both
     charges against it.
     """
-    factor = 2.0 if (req.family in ("ni_sign", "int_sign")
-                     and req.normalise) else 1.0
+    factor = release_factor(req.family, req.normalise)
     charges: dict[str, float] = {}
     for party, eps in ((req.party_x, req.eps1 * factor),
                        (req.party_y, req.eps2 * factor)):

@@ -126,6 +126,31 @@ def keys_from_data(data: jax.Array, impl: str | None = None) -> jax.Array:
     return jax.random.wrap_key_data(data, impl=impl or impl_tag())
 
 
+def party_root(key: jax.Array, role: str, mode: str = "replay") -> jax.Array:
+    """Root key for one protocol party (``dpcorr.protocol``).
+
+    ``"replay"`` (default) hands the party the session key unchanged, so
+    every named stream it draws keeps its monolithic address — the
+    two-party run is bit-identical to the single-process estimator under
+    the same master seed (the protocol acceptance contract, ISSUE 5).
+
+    ``"hardened"`` roots the party in its own disjoint named subtree
+    (``"protocol/x"`` / ``"protocol/y"``): statistically equivalent
+    draws that are no longer bit-comparable to the monolithic path, and
+    — when each party derives ``key`` from a genuinely secret seed — not
+    reconstructable (hence not subtractable) by the peer. This is the
+    deployment layout; replay is the simulation/testing layout.
+    """
+    if role not in ("x", "y"):
+        raise ValueError(f"role must be 'x' or 'y', got {role!r}")
+    if mode == "replay":
+        return key
+    if mode == "hardened":
+        return stream(key, f"protocol/{role}")
+    raise ValueError(f"unknown noise mode {mode!r}; "
+                     "expected 'replay' or 'hardened'")
+
+
 def stream(key: jax.Array, name: str) -> jax.Array:
     """Named substream: stable across code movement, unlike split() order.
 

@@ -1,0 +1,13 @@
+"""Violating fixture: the budget rules in protocol scope — a release
+handed to the transport without a write-ahead charge, and one whose
+transport failure could not refund."""
+
+
+class Gate:
+    def send_uncharged(self, channel, body):
+        channel.send(body)  # budget-uncharged-noise
+        self.ledger.charge(self.charges)
+
+    def send_no_refund(self, channel, body):
+        self.ledger.charge(self.charges)
+        channel.send(body)  # budget-missing-refund

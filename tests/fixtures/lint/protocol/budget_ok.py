@@ -1,0 +1,20 @@
+"""Clean fixture: the ReleaseGate shape — write-ahead charge, send
+under a refund-on-transport-failure guard; and a transport-layer
+helper with no ledger in scope that sends freely."""
+
+
+class Gate:
+    def send_release(self, channel, body, charges):
+        self.ledger.charge(charges)
+        try:
+            return channel.send(body)
+        except IOError:
+            self.ledger.refund(charges)
+            raise
+
+
+class Channel:
+    def send(self, body):
+        # transport layer: bodies arriving here are charged by
+        # contract, and no ledger is in scope
+        self.link.send_bytes(body)

@@ -1,0 +1,17 @@
+"""Violating fixture: raw columns reaching wire serializers through
+plain aliasing — directly, through passthrough casts/clips, and as a
+sign image (still the column's data, no randomization applied)."""
+
+
+def leak_direct(x, encode_array):
+    return encode_array(x, "raw")  # raw-column-serialize
+
+
+def leak_alias(column, np, encode_array):
+    values = np.asarray(column)
+    clipped = values.clip(-1.0, 1.0)
+    return encode_array(clipped, "clipped")  # raw-column-serialize
+
+
+def leak_sign(y, np, canonical_encode):
+    return canonical_encode(np.sign(y))  # raw-column-serialize

@@ -1,0 +1,20 @@
+"""Clean fixture: noise between the sample and the socket. Arithmetic
+(BinOp) and reductions break taint — adding calibrated noise or
+aggregating to batch means is exactly what turns a column into a
+release — and rebinding a tainted alias to a noised value clears it."""
+
+
+def release_noised(x, noise, encode_array):
+    release = x + noise
+    return encode_array(release, "noisy")
+
+
+def release_rebound(col, np, lap, encode_array):
+    values = np.asarray(col)
+    values = values + lap
+    return encode_array(values, "noisy")
+
+
+def release_batched(xs, np, encode_array):
+    means = np.mean(xs.reshape(-1, 8), axis=1)
+    return encode_array(means, "batch_means")

@@ -88,6 +88,42 @@ def test_locks_scope_is_path_based():
     assert not checker.applies_to(module.relpath)
 
 
+def test_protocol_budget_bad_fixture_fires_both_budget_rules():
+    """The budget rules extend to protocol/: a channel send is an
+    enqueue, so it needs a dominating charge and a refund guard."""
+    vs = lint_fixture("protocol/budget_bad.py")
+    assert fired(vs) == [
+        ("budget-missing-refund", 13),
+        ("budget-uncharged-noise", 8),
+    ]
+
+
+def test_protocol_budget_ok_fixture_is_clean():
+    assert lint_fixture("protocol/budget_ok.py") == []
+
+
+def test_rawdata_bad_fixture_fires_on_aliased_columns():
+    vs = lint_fixture("protocol/rawdata_bad.py")
+    assert fired(vs) == [
+        ("raw-column-serialize", 7),   # direct
+        ("raw-column-serialize", 13),  # asarray + clip alias chain
+        ("raw-column-serialize", 17),  # sign image
+    ]
+
+
+def test_rawdata_ok_fixture_is_clean():
+    assert lint_fixture("protocol/rawdata_ok.py") == []
+
+
+def test_rawdata_scope_is_path_based():
+    """The same source outside protocol/ is out of the rawdata
+    checker's scope (the estimators legitimately hold both columns)."""
+    src = (FIXTURES / "protocol" / "rawdata_bad.py").read_text()
+    from dpcorr.analysis.rules.rawdata import RawDataChecker
+
+    assert not RawDataChecker().applies_to("models/rawdata_elsewhere.py")
+
+
 def test_purity_bad_fixture_fires_both_purity_rules():
     vs = lint_fixture("purity_bad.py")
     assert fired(vs) == [

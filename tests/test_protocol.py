@@ -1,0 +1,456 @@
+"""Two-party protocol tests (ISSUE 5): bit-identity to the monolithic
+estimators, reliable-transport semantics under injected chaos, the
+release gate's charge/refund discipline, transcript determinism and
+auditing, and cross-party trace propagation.
+
+The bit-identity reference is always ``jit(serving_entry(...))`` on the
+same master key — the protocol (replay key layout) must reproduce it
+exactly: splitting an estimator across a wire costs zero bits.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from dpcorr.models.estimators.registry import serving_entry
+from dpcorr.models.estimators.split_reference import (
+    party_release,
+    release_schema,
+    split_estimate,
+    split_roles,
+)
+from dpcorr.obs import trace as obs_trace
+from dpcorr.obs.audit import AuditTrail
+from dpcorr.protocol import (
+    FaultInjector,
+    InProcTransport,
+    Message,
+    ProtocolRefused,
+    ProtocolSpec,
+    ReleaseGate,
+    ReliableChannel,
+    TransportError,
+    ledger_balance,
+    read_transcript,
+    run_inproc,
+    run_tcp,
+    scan_transcript,
+)
+from dpcorr.protocol.scan import wire_schema
+from dpcorr.serve.ledger import PrivacyLedger
+from dpcorr.utils import rng
+
+FAMILIES = ("ni_sign", "int_sign", "ni_subg", "int_subg")
+
+
+def _columns(n=1500, rho=0.6, seed=99):
+    r = np.random.default_rng(seed)
+    xy = r.multivariate_normal([0.0, 0.0], [[1.0, rho], [rho, 1.0]],
+                               size=n)
+    return (np.asarray(xy[:, 0], np.float32),
+            np.asarray(xy[:, 1], np.float32))
+
+
+def _monolithic(family, x, y, eps1=1.0, eps2=0.5, seed=2025,
+                alpha=0.05, normalise=True):
+    fn = jax.jit(serving_entry(family, eps1, eps2, alpha, normalise))
+    rho, lo, hi = fn(rng.master_key(seed), x, y)
+    return (float(np.float32(rho)), float(np.float32(lo)),
+            float(np.float32(hi)))
+
+
+def _bits(res):
+    return (res.rho_hat, res.ci_low, res.ci_high)
+
+
+# ----------------------------------------------------- split reference ----
+def test_wire_schema_pins_release_schema():
+    """scan.wire_schema is a deliberately jax-free re-derivation; this
+    is the pin that stops the two from drifting silently."""
+    for family in FAMILIES:
+        for n in (64, 1500, 4096):
+            for eps in ((1.0, 0.5), (0.25, 0.25), (5.0, 1.0)):
+                assert wire_schema(family, n, *eps) == \
+                    release_schema(family, n, *eps)
+
+
+def test_split_roles_int_larger_eps_sends():
+    assert split_roles("ni_sign", 0.1, 5.0) == ("x", "y")
+    assert split_roles("int_sign", 2.0, 0.5) == ("x", "y")
+    assert split_roles("int_sign", 0.5, 2.0) == ("y", "x")
+    assert split_roles("int_subg", 1.0, 1.0) == ("x", "y")
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+@pytest.mark.parametrize("eps", [(1.0, 0.5), (0.5, 2.0)])
+def test_split_estimate_matches_monolithic(family, eps):
+    """The factored estimator (release + finish, both jitted) is
+    bit-identical to the monolithic closure — in both ε orderings, so
+    the INT sender-swap re-association is covered."""
+    x, y = _columns()
+    key = rng.master_key(2025)
+    got = split_estimate(family, key, key, x, y, *eps)
+    want = _monolithic(family, x, y, *eps)
+    assert tuple(float(np.float32(v)) for v in got) == want
+
+
+def test_party_release_matches_schema():
+    x, _ = _columns(n=900)
+    key = rng.master_key(3)
+    for family in FAMILIES:
+        releaser, _f = split_roles(family, 1.0, 0.5)
+        rel = party_release(family, key, releaser,
+                            x, 1.0, 0.5, True)
+        schema = release_schema(family, 900, 1.0, 0.5)
+        assert set(rel) == set(schema)
+        for name, want in schema.items():
+            assert tuple(rel[name].shape) == want["shape"]
+            assert str(rel[name].dtype) == want["dtype"]
+
+
+# ------------------------------------------------------- protocol runs ----
+@pytest.mark.parametrize("family", FAMILIES)
+def test_protocol_inproc_bit_identical(family):
+    x, y = _columns()
+    spec = ProtocolSpec(family=family, n=len(x), eps1=1.0, eps2=0.5)
+    res = run_inproc(spec, x, y)
+    want = _monolithic(family, x, y)
+    assert _bits(res["x"]) == want
+    assert _bits(res["y"]) == want
+
+
+def test_protocol_tcp_bit_identical():
+    x, y = _columns()
+    spec = ProtocolSpec(family="int_sign", n=len(x), eps1=1.0, eps2=0.5)
+    res = run_tcp(spec, x, y)
+    assert _bits(res["x"]) == _monolithic("int_sign", x, y)
+    assert _bits(res["x"]) == _bits(res["y"])
+
+
+def test_protocol_eps_order_swaps_int_sender():
+    """eps2 > eps1 makes y the INT releaser; bits still match the
+    monolithic estimator under the same master seed."""
+    x, y = _columns()
+    spec = ProtocolSpec(family="int_subg", n=len(x), eps1=0.5, eps2=2.0)
+    res = run_inproc(spec, x, y)
+    assert _bits(res["x"]) == _monolithic("int_subg", x, y,
+                                          eps1=0.5, eps2=2.0)
+
+
+def test_protocol_fault_injection_same_bits_with_retries():
+    """Chaos (drops, delays, duplicates) exercises retransmission and
+    dedupe but must never perturb the estimate: the fault RNG is
+    stdlib, the estimator key tree is jax — disjoint by construction."""
+    x, y = _columns(n=1000)
+    spec = ProtocolSpec(family="ni_sign", n=len(x), eps1=1.0, eps2=0.5)
+    clean = run_inproc(spec, x, y)
+    fault = {"drop": 0.25, "delay_s": 0.002, "duplicate": 0.2}
+    chaotic = run_inproc(spec, x, y, fault=fault, timeout_s=0.25)
+    assert _bits(chaotic["x"]) == _bits(clean["x"])
+    assert _bits(chaotic["y"]) == _bits(clean["y"])
+    retries = sum(r.stats["total_retries"] for r in chaotic.values())
+    assert retries > 0, "fault arm never retried — chaos proved nothing"
+
+
+def test_protocol_hardened_mode_agrees_but_differs_from_replay():
+    """The hardened key layout draws from disjoint per-party subtrees:
+    both roles still agree on the result, but the bits deliberately
+    differ from the replay/monolithic stream addresses."""
+    x, y = _columns()
+    spec = ProtocolSpec(family="ni_sign", n=len(x), eps1=1.0, eps2=0.5,
+                        noise_mode="hardened")
+    res = run_inproc(spec, x, y)
+    assert _bits(res["x"]) == _bits(res["y"])
+    assert _bits(res["x"]) != _monolithic("ni_sign", x, y)
+
+
+def test_ledger_refusal_mid_protocol_no_partial_release(tmp_path):
+    """The finisher's budget cannot cover its charge: the session must
+    abort with a refusal, the refusing party must spend nothing, and
+    no result message may exist anywhere — but the releaser's already
+    -delivered release stays spent (it crossed the wire)."""
+    x, y = _columns()
+    spec = ProtocolSpec(family="ni_subg", n=len(x), eps1=1.0, eps2=0.5)
+    lx = PrivacyLedger(100.0)
+    ly = PrivacyLedger(0.2)  # y's charge is 0.5 > 0.2
+    with pytest.raises(ProtocolRefused):
+        run_inproc(spec, x, y, ledger_x=lx, ledger_y=ly,
+                   transcript_dir=str(tmp_path))
+    assert ly.snapshot()["parties"] == {}
+    assert lx.snapshot()["parties"]["party-x"]["spent"] == 1.0
+    for role in ("x", "y"):
+        entries = read_transcript(
+            str(tmp_path / f"{spec.session}.{role}.jsonl"))
+        types = [e["wire"]["msg_type"] for e in entries]
+        assert "result" not in types
+        assert "error" in types
+
+
+def test_duplicate_delivery_is_idempotent():
+    """duplicate=1.0 doubles every frame; the receiver must process
+    each sequence number once and re-ack the copies."""
+    x, y = _columns(n=800)
+    spec = ProtocolSpec(family="int_sign", n=len(x), eps1=1.0, eps2=0.5)
+    clean = run_inproc(spec, x, y)
+    doubled = run_inproc(spec, x, y, fault={"duplicate": 1.0})
+    assert _bits(doubled["x"]) == _bits(clean["x"])
+
+
+def test_transcript_replay_determinism(tmp_path):
+    """Two runs of the same spec produce byte-identical wire payloads
+    (canonical serialization + deterministic key tree) — transcripts
+    differ only in timing fields."""
+    x, y = _columns()
+    spec = ProtocolSpec(family="ni_sign", n=len(x), eps1=1.0, eps2=0.5)
+    dirs = [tmp_path / "a", tmp_path / "b"]
+    for d in dirs:
+        run_inproc(spec, x, y, transcript_dir=str(d))
+    for role in ("x", "y"):
+        wires = []
+        for d in dirs:
+            entries = read_transcript(
+                str(d / f"{spec.session}.{role}.jsonl"))
+            wires.append([json.dumps(e["wire"], sort_keys=True)
+                          for e in entries])
+        assert wires[0] == wires[1]
+
+
+def test_trace_id_propagates_across_parties(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    obs_trace.configure(path)
+    try:
+        x, y = _columns()
+        spec = ProtocolSpec(family="ni_sign", n=len(x),
+                            eps1=1.0, eps2=0.5)
+        res = run_inproc(spec, x, y)
+    finally:
+        obs_trace.configure(None)
+    assert res["x"].trace_id is not None
+    assert res["x"].trace_id == res["y"].trace_id
+    spans = [json.loads(line) for line in open(path)]
+    assert {s["trace_id"] for s in spans} == {res["x"].trace_id}
+    names = {s["name"] for s in spans}
+    assert "protocol.release" in names and "protocol.finish" in names
+
+
+# -------------------------------------------------- transcript auditing ----
+def test_scan_clean_transcript_and_ledger_balance(tmp_path):
+    x, y = _columns()
+    spec = ProtocolSpec(family="int_subg", n=len(x), eps1=1.0, eps2=0.5)
+    trails = {"x": AuditTrail(), "y": AuditTrail()}
+    run_inproc(spec, x, y,
+               ledger_x=PrivacyLedger(100.0, audit=trails["x"]),
+               ledger_y=PrivacyLedger(100.0, audit=trails["y"]),
+               transcript_dir=str(tmp_path))
+    for role in ("x", "y"):
+        path = str(tmp_path / f"{spec.session}.{role}.jsonl")
+        rep = scan_transcript(path, raw_x=x, raw_y=y)
+        assert rep["ok"], rep["violations"]
+        bal = ledger_balance(path, trails[role].events())
+        assert bal["ok"], bal
+    # both roles' charges sum to the serve-mode request charge
+    spent = {**ledger_balance(
+        str(tmp_path / f"{spec.session}.x.jsonl"),
+        trails["x"].events())["spent"],
+        **ledger_balance(
+        str(tmp_path / f"{spec.session}.y.jsonl"),
+        trails["y"].events())["spent"]}
+    assert spent == {"party-x": 1.0, "party-y": 0.5}
+
+
+def test_scan_flags_raw_column_on_wire(tmp_path):
+    """Tamper a recorded release into the raw column: the scanner must
+    flag it (the runtime no-raw-columns proof)."""
+    from dpcorr.protocol.messages import encode_array
+
+    x, y = _columns()
+    spec = ProtocolSpec(family="int_sign", n=len(x), eps1=1.0, eps2=0.5)
+    run_inproc(spec, x, y, transcript_dir=str(tmp_path))
+    path = str(tmp_path / f"{spec.session}.x.jsonl")
+    entries = read_transcript(path)
+    tampered = 0
+    for e in entries:
+        if e["wire"]["msg_type"] == "release":
+            e["wire"]["payload"]["flipped_signs"] = \
+                encode_array(x, "rr_flipped_signs")
+            tampered += 1
+    assert tampered == 1
+    rep = scan_transcript(entries, raw_x=x, raw_y=y)
+    assert not rep["ok"]
+    assert any(v["rule"] == "raw-column-on-wire"
+               for v in rep["violations"])
+
+
+def test_scan_flags_array_outside_release():
+    from dpcorr.protocol.messages import encode_array
+
+    x, _ = _columns(n=64)
+    msg = Message("hello", "x", "s",
+                  payload={"spec": {"family": "ni_sign", "n": 64,
+                                    "eps1": 1.0, "eps2": 0.5},
+                           "oops": encode_array(x, "raw")})
+    entries = [{"dir": "send", "seq": 1, "eps": 0.0,
+                "wire": msg.to_wire()}]
+    rep = scan_transcript(entries)
+    assert any(v["rule"] == "array-outside-release"
+               for v in rep["violations"])
+
+
+def test_protocol_transcript_frame(tmp_path):
+    from dpcorr.report import protocol_transcript_frame
+
+    x, y = _columns()
+    spec = ProtocolSpec(family="ni_sign", n=len(x), eps1=1.0, eps2=0.5)
+    run_inproc(spec, x, y, transcript_dir=str(tmp_path))
+    df = protocol_transcript_frame(
+        str(tmp_path / f"{spec.session}.x.jsonl"))
+    assert list(df.columns) == ["seq", "dir", "type", "bytes",
+                                "retries", "latency_s", "eps",
+                                "trace_id", "ts"]
+    assert list(df["type"]) == ["hello", "hello_ack", "release",
+                                "result"]
+    gated = df[df.eps > 0]
+    assert len(gated) == 1 and gated.iloc[0]["type"] == "release"
+    assert float(gated.iloc[0]["eps"]) == 2.0  # 1.0 × centering factor
+
+
+# ------------------------------------------------------ gate + channel ----
+class _FailingChannel:
+    fault = None
+    total_retries = 0
+
+    def send(self, body):
+        raise TransportError("wire down")
+
+
+def test_gate_refunds_on_transport_failure():
+    ledger = PrivacyLedger(10.0)
+    gate = ReleaseGate(ledger)
+    with pytest.raises(TransportError):
+        gate.send_release(_FailingChannel(), {"k": 1},
+                          {"party-x": 2.0})
+    assert ledger.snapshot()["parties"]["party-x"]["spent"] == 0.0
+
+
+def test_gate_charges_before_send():
+    ledger = PrivacyLedger(10.0)
+    gate = ReleaseGate(ledger)
+    seen = {}
+
+    class Channel:
+        fault = None
+        total_retries = 0
+
+        def send(self, body):
+            seen["spent_at_send"] = \
+                ledger.snapshot()["parties"]["party-x"]["spent"]
+            return {"seq": 1, "retries": 0, "latency_s": 0.0,
+                    "bytes": 10}
+
+    receipt = gate.send_release(Channel(), {"k": 1}, {"party-x": 2.0})
+    assert seen["spent_at_send"] == 2.0  # charged *before* the wire
+    assert receipt["eps"] == 2.0
+
+
+def test_reliable_channel_dedupes_duplicates():
+    pair = InProcTransport()
+    a = ReliableChannel(pair.a, timeout_s=1.0,
+                        fault=FaultInjector(duplicate=1.0, seed=5))
+    b = ReliableChannel(pair.b, timeout_s=1.0)
+    got = []
+    for i in range(4):
+        # send blocks on the ack, which b only produces on recv — so a
+        # reader thread drives b while a's send waits
+        import threading
+
+        t = threading.Thread(
+            target=lambda: got.append(b.recv(timeout_s=2.0)["body"]["i"]))
+        t.start()
+        a.send({"i": i})
+        t.join()
+    assert got == [0, 1, 2, 3]
+    assert len(b._delivered) == 4  # each seq processed exactly once
+
+
+def test_reliable_channel_times_out_without_peer():
+    pair = InProcTransport()
+    a = ReliableChannel(pair.a, timeout_s=0.02, max_retries=2,
+                        backoff_base_s=0.01)
+    with pytest.raises(TransportError):
+        a.send({"dead": True})
+
+
+def test_fault_injector_is_deterministic():
+    plans = [FaultInjector(drop=0.3, duplicate=0.3, delay_s=0.01,
+                           seed=42).plan() for _ in range(2)]
+    assert plans[0] == plans[1]
+
+
+# ----------------------------------------------------------- messages ----
+def test_message_version_mismatch_rejected():
+    wire = Message("hello", "x", "s").to_wire()
+    wire["version"] = 99
+    with pytest.raises(ValueError):
+        Message.from_wire(wire)
+
+
+def test_spec_hash_ignores_session_but_pins_params():
+    a = ProtocolSpec(family="ni_sign", n=100, eps1=1.0, eps2=0.5)
+    b = ProtocolSpec(family="ni_sign", n=100, eps1=1.0, eps2=0.5,
+                     session="other")
+    c = ProtocolSpec(family="ni_sign", n=100, eps1=1.0, eps2=0.6)
+    assert a.spec_hash() == b.spec_hash()
+    assert a.spec_hash() != c.spec_hash()
+    assert a.session == f"sess-{a.spec_hash()[:12]}"
+
+
+def test_hello_spec_mismatch_refused():
+    """Different public specs on the two sides must abort in the
+    handshake — before any ε is spent."""
+    from dpcorr.protocol.messages import Transcript
+    from dpcorr.protocol.party import Party, ProtocolError
+
+    x, y = _columns()
+    spec_x = ProtocolSpec(family="ni_sign", n=len(x), eps1=1.0,
+                          eps2=0.5, session="s1")
+    spec_y = ProtocolSpec(family="ni_sign", n=len(y), eps1=1.0,
+                          eps2=0.6, session="s1")
+    pair = InProcTransport()
+    lx, ly = PrivacyLedger(100.0), PrivacyLedger(100.0)
+    px = Party("x", x, spec_x, ReliableChannel(pair.a, timeout_s=2.0),
+               lx, transcript=Transcript(None))
+    py = Party("y", y, spec_y, ReliableChannel(pair.b, timeout_s=2.0),
+               ly, transcript=Transcript(None))
+    import threading
+
+    errs = {}
+
+    def run(p):
+        try:
+            p.run()
+        except ProtocolError as e:
+            errs[p.role] = e
+
+    ts = [threading.Thread(target=run, args=(p,)) for p in (px, py)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert errs, "spec mismatch went unnoticed"
+    assert lx.snapshot()["parties"] == {}
+    assert ly.snapshot()["parties"] == {}
+
+
+def test_run_tcp_writes_transcripts(tmp_path):
+    x, y = _columns(n=600)
+    spec = ProtocolSpec(family="ni_subg", n=len(x), eps1=1.0, eps2=0.5)
+    run_tcp(spec, x, y, transcript_dir=str(tmp_path))
+    files = sorted(os.listdir(tmp_path))
+    assert files == [f"{spec.session}.x.jsonl",
+                     f"{spec.session}.y.jsonl"]
+    for f in files:
+        rep = scan_transcript(str(tmp_path / f), raw_x=x, raw_y=y)
+        assert rep["ok"], rep["violations"]

@@ -23,8 +23,9 @@ backend itself. Sequence:
 3. CPU worker fallback, recorded with ``degraded: "tpu-init-failed"``.
 4. If even that fails, a valid JSON line with value 0 and the error trail.
 
-Exit code is 0 in every case — the driver always receives a parseable
-measurement plus the failure forensics in ``detail``.
+Exit code is 0 in every case (except ``--gate`` mode, below) — the driver
+always receives a parseable measurement plus the failure forensics in
+``detail``.
 
 Inside a worker, two implementations are raced on TPU:
 
@@ -44,6 +45,25 @@ Inside a worker, two implementations are raced on TPU:
 Each path compiles one fixed-size block, calibrates its wall-clock, then
 dispatches its share of the time budget asynchronously with a single fetch
 barrier. The headline value is the faster path's steady-state reps/sec.
+
+Since r08 the xla paths run through ``dpcorr.sim.RepBlockPipeline`` — the
+donated, pre-sharded, chained-key block executor (bit-identical per-rep
+math to the old ``make_xla_block`` loop, pinned by tests/test_pipeline.py
+and the interleaved A/B in ``benchmarks/rep_pipeline_ab.py``) — with the
+(chunk_size × block_reps) shape resolved by the per-host geometry
+autotuner (``dpcorr.utils.geometry``; cached per device/family/n/dtype,
+``DPCORR_BENCH_AUTOTUNE=0`` restores the measured constants). On CPU a
+second sampler path ``xla_bm`` (Box–Muller, ``dpcorr.ops.fastnorm``)
+races the threefry+erf⁻¹ path under the same ``_sane`` statistical gate
+the rbg/pallas paths use. The worker stamps geometry, device_kind,
+loadavg and the transfer-counter deltas into ``detail``.
+
+``--gate`` turns the run into a CI regression gate: the measured value is
+compared against ``benchmarks/results/last_known_good.json`` (same
+device_kind only) and the process exits **1** below the floor
+(``DPCORR_BENCH_GATE_FLOOR``, default 0.85) — the one deliberate
+exception to the always-rc=0 contract above. ``--gate-measured FILE``
+gates an existing artifact without measuring.
 """
 
 from __future__ import annotations
@@ -94,6 +114,12 @@ def _worker_shape(mode: str) -> tuple[int, int]:
 
 METRIC = "mc_reps_per_sec_chip_ni_sign_n10k"
 
+#: committed regression baseline for --gate (same device_kind only)
+LKG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "results", "last_known_good.json")
+#: a measurement below floor × last-known-good fails the gate
+GATE_FLOOR_DEFAULT = 0.85
+
 
 def make_metrics_fn():
     """Per-rep metrics (se², cover, ci_len) at the bench design point."""
@@ -138,6 +164,143 @@ def make_xla_block(chunk: int):
         return jnp.mean(se2), jnp.mean(cover), jnp.mean(ci_len)
 
     return _xla_block
+
+
+def make_rep_fn(sampler: str = "icdf"):
+    """Per-replication body of the headline workload: generate an n=10k
+    correlated pair, NI sign-batch estimate + CI, emit (se², cover,
+    ci_len). ``sampler`` picks the Gaussian generator: ``"icdf"`` is the
+    framework's ``gen_gaussian`` (threefry + inverse CDF — the
+    bit-reproducibility contract), ``"bm"`` the Box–Muller fast path
+    (``dpcorr.ops.fastnorm`` — statistically exact, different stream;
+    gated by ``_sane`` like rbg/pallas)."""
+    import jax.numpy as jnp
+
+    from dpcorr.models.estimators import ci_ni_signbatch
+    from dpcorr.utils import rng
+
+    if sampler == "bm":
+        from dpcorr.ops.fastnorm import gen_gaussian_bm as gen
+    elif sampler == "icdf":
+        from dpcorr.models.dgp import gen_gaussian as gen
+    else:
+        raise ValueError(f"unknown sampler {sampler!r}")
+    _metrics = make_metrics_fn()
+
+    def _one_rep(key):
+        xy = gen(rng.stream(key, "dgp"), N, jnp.float32(RHO))
+        return _metrics(ci_ni_signbatch(rng.stream(key, "ni"),
+                                        xy[:, 0], xy[:, 1],
+                                        EPS1, EPS2, alpha=ALPHA))
+
+    return _one_rep
+
+
+def make_pipeline(chunk: int, block_reps: int, *, sampler: str = "icdf",
+                  key=None, impl: str | None = None, counters=None,
+                  aot: bool = True):
+    """The donated rep-block executor over :func:`make_rep_fn` — what the
+    worker measures since r08. ``impl``: PRNG impl for the key tree
+    (``"rbg"`` for the TPU hardware generator path); the root ``key``
+    must be built with the same impl."""
+    from dpcorr.sim import RepBlockPipeline
+    from dpcorr.utils import rng
+
+    if key is None:
+        key = rng.master_key(impl=impl)
+    return RepBlockPipeline(make_rep_fn(sampler), 3, key=key,
+                            block_reps=block_reps, chunk_size=chunk,
+                            family=f"bench-{sampler}", impl=impl,
+                            counters=counters, aot=aot)
+
+
+def measure_pipeline(pipe, budget_s: float):
+    """The steady-state protocol of :func:`measure_steady_state` on a
+    :class:`~dpcorr.sim.RepBlockPipeline`: warm (compile excluded),
+    calibrate one block's wall-clock, then run ~budget worth of chained
+    blocks with the pipeline's single reduction-boundary fetch. Returns
+    ``(reps_per_sec, mean metrics)``."""
+    pipe.run(1, start_block=0)  # compile + warm
+    t0 = time.perf_counter()
+    pipe.run(1, start_block=1)
+    dt1 = time.perf_counter() - t0
+    n_blocks = max(1, min(MAX_BLOCKS, int(budget_s / dt1)))
+
+    t0 = time.perf_counter()
+    sums, n_reps = pipe.run(n_blocks, start_block=2)
+    elapsed = time.perf_counter() - t0
+    means = tuple(s / n_reps for s in sums)
+    return n_reps / elapsed, means
+
+
+def _resolve_geometry(mode: str, budget_s: float, key,
+                      sampler: str = "icdf"):
+    """Pick the (chunk_size × block_reps) shape for one worker path.
+
+    CPU: the per-host autotuner (``dpcorr.utils.geometry``) when the
+    budget affords a probe (≥ 10 s; the persistent cache makes this a
+    one-time cost per host), else the cached winner, else the measured
+    ``WORKER_SHAPE`` constant. The env pins are *ignored* here — they
+    tune the TPU paths only (see ``_worker_shape``), and an inherited
+    TPU-sized pin would blow the fallback's kill timeout.
+
+    TPU: env pin or the measured constant; probing through the remote
+    tunnel is opt-in (``DPCORR_BENCH_AUTOTUNE=1``) because a probe
+    ladder costs minutes of tunnel exposure per entry.
+
+    Each ``sampler`` tunes under its own cache family (``bench-icdf``,
+    ``bench-bm``): the Box–Muller rep spends its cycles differently
+    (no erf⁻¹), so the two paths need not share an optimum.
+    """
+    import itertools
+
+    from dpcorr.utils import geometry
+
+    family = f"bench-{sampler}"
+    device_kind = "cpu" if mode == "cpu" else "tpu"
+    opt = os.environ.get("DPCORR_BENCH_AUTOTUNE", "").strip().lower()
+    forced = opt in ("1", "true", "on")
+    disabled = opt in ("0", "off", "false")
+    want_tune = forced or (device_kind == "cpu" and not disabled
+                           and budget_s >= 10.0)
+    if not want_tune:
+        if device_kind == "cpu":
+            if not disabled:
+                geo = geometry.lookup(family, N, device_kind="cpu",
+                                      eps_pairs=[(EPS1, EPS2)],
+                                      env_pin=False)
+                if geo is not None:
+                    return geo
+            block_reps, chunk = WORKER_SHAPE["cpu"]
+            return geometry.Geometry(chunk_size=chunk,
+                                     block_reps=block_reps,
+                                     source="default")
+        block_reps, chunk = _worker_shape(mode)
+        pinned = (os.environ.get("DPCORR_BENCH_CHUNK") is not None
+                  or os.environ.get("DPCORR_BENCH_BLOCK_REPS") is not None)
+        return geometry.Geometry(chunk_size=chunk, block_reps=block_reps,
+                                 source="pinned" if pinned else "default")
+
+    def make_runner(c, b):
+        pipe = make_pipeline(c, b, sampler=sampler, key=key, aot=False)
+        idx = itertools.count()
+        return lambda: pipe.run(1, start_block=next(idx))
+
+    return geometry.autotune(family, N, make_runner,
+                             device_kind=device_kind,
+                             eps_pairs=[(EPS1, EPS2)],
+                             env_pin=(device_kind == "tpu"))
+
+
+def _path_entry(rps: float, means, pipe, geo=None) -> dict:
+    entry = {"reps_per_sec": round(rps, 1), "mse": round(means[0], 6),
+             "coverage": round(means[1], 4),
+             "ci_length": round(means[2], 4),
+             "donation_engaged": pipe.donation_engaged,
+             "aot": pipe.aot_ok}
+    if geo is not None:
+        entry["geometry"] = geo.as_detail()
+    return entry
 
 
 def measure_steady_state(run_block, args_for, block_reps: int,
@@ -221,7 +384,6 @@ def worker_main(mode: str, budget_s: float) -> None:
 
     block_reps, chunk = _worker_shape(mode)
     _metrics = make_metrics_fn()
-    _xla_block = make_xla_block(chunk)
 
     @partial(jax.jit, static_argnums=(1,))
     def _pallas_block(block_idx, n_reps: int):
@@ -260,13 +422,20 @@ def worker_main(mode: str, budget_s: float) -> None:
         }), flush=True)
         return
 
-    xla_rps, xla_means, xla_lat = _measure(_xla_block,
-                                           lambda i: rng.design_key(key, i))
-    paths = {"xla": {"reps_per_sec": round(xla_rps, 1),
-                     "mse": round(xla_means[0], 6),
-                     "coverage": round(xla_means[1], 4),
-                     "ci_length": round(xla_means[2], 4),
-                     "block_drain_s": xla_lat}}
+    # ---- xla paths: the donated rep-block pipeline (r08 tentpole) ----
+    from dpcorr.obs import transfer as transfer_mod
+
+    counters = transfer_mod.default_counters()
+    geo = _resolve_geometry(mode, budget_s, key)
+    bm_geo = (_resolve_geometry(mode, budget_s, key, sampler="bm")
+              if mode == "cpu" else None)
+    before = counters.snapshot()  # after the probes: the measurement's own
+
+    pipe = make_pipeline(geo.chunk_size, geo.block_reps, key=key,
+                         counters=counters)
+    xla_rps, xla_means = measure_pipeline(pipe, budget_s)
+    paths = {"xla": _path_entry(xla_rps, xla_means, pipe, geo)}
+    geos = {"xla": geo}
 
     if mode == "tpu":
         # Same kernel on the rbg key impl (the TPU hardware generator):
@@ -274,33 +443,63 @@ def worker_main(mode: str, budget_s: float) -> None:
         # this is the cheap-PRNG variant. Gated on the same statistical
         # sanity as pallas — different streams, same distributions.
         try:
-            key_rbg = rng.master_key(impl="rbg")
-            rbg_rps, rbg_means, rbg_lat = _measure(
-                _xla_block, lambda i: rng.design_key(key_rbg, i))
+            rbg_pipe = make_pipeline(geo.chunk_size, geo.block_reps,
+                                     impl="rbg", counters=counters)
+            rbg_rps, rbg_means = measure_pipeline(rbg_pipe, budget_s)
             if _sane(rbg_means, xla_means):
-                paths["xla_rbg"] = {"reps_per_sec": round(rbg_rps, 1),
-                                    "mse": round(rbg_means[0], 6),
-                                    "coverage": round(rbg_means[1], 4),
-                                    "ci_length": round(rbg_means[2], 4),
-                                    "block_drain_s": rbg_lat}
+                paths["xla_rbg"] = _path_entry(rbg_rps, rbg_means,
+                                               rbg_pipe, geo)
+                geos["xla_rbg"] = geo
             else:
                 paths["xla_rbg_skipped"] = f"sanity: {rbg_means}"
         except Exception as e:
             paths["xla_rbg_skipped"] = f"{type(e).__name__}: {e}"[:200]
+    else:
+        # CPU fast path: Box–Muller sampler (no erf⁻¹ — XLA CPU
+        # scalarizes the inverse-CDF's log1p into per-element libm
+        # calls; dpcorr.ops.fastnorm). Different stream, same law:
+        # gated statistically, stamped as its own path, tuned under its
+        # own geometry family (the rep spends its cycles differently).
+        try:
+            bm_pipe = make_pipeline(bm_geo.chunk_size, bm_geo.block_reps,
+                                    sampler="bm", key=key,
+                                    counters=counters)
+            bm_rps, bm_means = measure_pipeline(bm_pipe, budget_s)
+            if _sane(bm_means, xla_means):
+                paths["xla_bm"] = _path_entry(bm_rps, bm_means, bm_pipe,
+                                              bm_geo)
+                geos["xla_bm"] = bm_geo
+            else:
+                paths["xla_bm_skipped"] = f"sanity: {bm_means}"
+        except Exception as e:
+            paths["xla_bm_skipped"] = f"{type(e).__name__}: {e}"[:200]
 
     best = max((p for p in paths if not p.endswith("_skipped")),
                key=lambda p: paths[p]["reps_per_sec"])
+    best_geo = geos[best]
+    try:
+        loadavg_1m = round(os.getloadavg()[0], 2)
+    except OSError:
+        loadavg_1m = None
+    platform = jax.devices()[0].platform
+    detail = {
+        "n": N, "block_reps": best_geo.block_reps,
+        "chunk_size": best_geo.chunk_size,
+        "path": best, "paths": paths,
+        "device": str(jax.devices()[0]),
+        "device_kind": "tpu" if platform in ("tpu", "axon") else platform,
+        "geometry": best_geo.as_detail(),
+        "transfer": transfer_mod.diff(counters.snapshot(), before),
+    }
+    if loadavg_1m is not None:
+        detail["loadavg_1m"] = loadavg_1m
     print(json.dumps({
         "metric": METRIC,
         "value": paths[best]["reps_per_sec"],
         "unit": "reps/sec/chip",
         "vs_baseline": round(paths[best]["reps_per_sec"]
                              / BASELINE_REPS_PER_SEC_CHIP, 3),
-        "detail": {
-            "n": N, "block_reps": block_reps, "path": best,
-            "paths": paths,
-            "device": str(jax.devices()[0]),
-        },
+        "detail": detail,
     }), flush=True)
 
 
@@ -342,6 +541,65 @@ def _merge_pallas(out: dict, budget_s: float) -> None:
         out["vs_baseline"] = round(p["reps_per_sec"]
                                    / BASELINE_REPS_PER_SEC_CHIP, 3)
         out["detail"]["path"] = "pallas"
+
+
+# --------------------------------------------------------------------------
+# Regression gate (--gate): measured value vs the committed last-known-good.
+# --------------------------------------------------------------------------
+
+def _gate_floor() -> float:
+    raw = os.environ.get("DPCORR_BENCH_GATE_FLOOR", "")
+    try:
+        return float(raw)
+    except ValueError:
+        return GATE_FLOOR_DEFAULT
+
+
+def _load_lkg(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            lkg = json.load(f)
+        return lkg if isinstance(lkg, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def gate_check(measured: dict, lkg: dict | None, floor: float
+               ) -> tuple[bool, str]:
+    """Pure regression verdict: ``(ok, reason)``.
+
+    Fails (ok=False) only when the measured value is below
+    ``floor × lkg.value`` *on the same device_kind* — a CPU-degraded run
+    must not be judged against a TPU baseline (or vice versa): the gate
+    passes with a note instead, so a dead tunnel degrades the
+    measurement without turning CI red for an unrelated reason. A
+    missing/unreadable baseline also passes (first run bootstraps the
+    file). A measurement whose own device_kind is missing is still
+    compared — the all-paths-failed zero artifact must fail, not slip
+    through on a missing stamp.
+    """
+    value = float(measured.get("value") or 0.0)
+    if lkg is None:
+        return True, "no last-known-good baseline; gate passes (bootstrap)"
+    if lkg.get("metric") not in (None, METRIC):
+        return True, (f"baseline tracks {lkg.get('metric')!r}, not "
+                      f"{METRIC!r}; gate passes with note")
+    lkg_value = float(lkg.get("value") or 0.0)
+    if lkg_value <= 0:
+        return True, "baseline value is unusable (<= 0); gate passes"
+    m_kind = (measured.get("detail") or {}).get("device_kind")
+    l_kind = lkg.get("device_kind")
+    if m_kind and l_kind and m_kind != l_kind:
+        return True, (f"device_kind mismatch (measured {m_kind}, baseline "
+                      f"{l_kind}); cross-device ratios are meaningless — "
+                      "gate passes with note")
+    ratio = value / lkg_value
+    verdict = (f"{value:.1f} vs last-known-good {lkg_value:.1f} "
+               f"({ratio:.3f}x, floor {floor:.2f}x"
+               + (f", device_kind {l_kind}" if l_kind else "") + ")")
+    if ratio >= floor:
+        return True, verdict
+    return False, f"REGRESSION: {verdict}"
 
 
 # --------------------------------------------------------------------------
@@ -482,6 +740,15 @@ def main() -> None:
                     default=None)
     ap.add_argument("--budget", type=float, default=30.0,
                     help="per-path measurement budget (seconds)")
+    ap.add_argument("--gate", action="store_true",
+                    help="compare the measurement against the committed "
+                         "last-known-good baseline and exit 1 on "
+                         "regression (the one non-rc=0 mode)")
+    ap.add_argument("--gate-measured", type=str, default=None,
+                    help="gate an existing bench JSON artifact instead "
+                         "of measuring (implies --gate)")
+    ap.add_argument("--lkg", type=str, default=LKG_PATH,
+                    help="last-known-good baseline path")
     args = ap.parse_args()
 
     if args.worker:
@@ -501,6 +768,32 @@ def main() -> None:
 
     signal.signal(signal.SIGTERM, _sigterm_to_exit)
 
+    if args.gate or args.gate_measured:
+        if args.gate_measured:
+            with open(args.gate_measured, encoding="utf-8") as f:
+                measured = json.load(f)
+        else:
+            measured = _orchestrate(args)
+        floor = _gate_floor()
+        lkg = _load_lkg(args.lkg)
+        ok, reason = gate_check(measured, lkg, floor)
+        measured.setdefault("detail", {})["gate"] = {
+            "ok": ok, "reason": reason, "floor": floor,
+            "lkg_value": (lkg or {}).get("value"),
+            "lkg_path": args.lkg,
+        }
+        print(json.dumps(measured), flush=True)
+        sys.exit(0 if ok else 1)
+
+    out = _orchestrate(args)
+    print(json.dumps(out), flush=True)
+    sys.exit(0)
+
+
+def _orchestrate(args) -> dict:
+    """The resilience ladder (probe → tpu → retry → cpu → zero-value):
+    always returns a parseable measurement dict; never raises for a
+    worker failure."""
     attempts = []
     try:
         # CPU contention forensics, sampled BEFORE the bench's own
@@ -621,8 +914,7 @@ def main() -> None:
             out["detail"]["git_rev"] = rev
     except Exception:
         pass
-    print(json.dumps(out), flush=True)
-    sys.exit(0)
+    return out
 
 
 if __name__ == "__main__":

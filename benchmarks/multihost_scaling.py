@@ -102,9 +102,12 @@ def main() -> None:
                                            "rho_true", "repl"])
     b = res_multi.detail_all.sort_values(["n", "eps1", "eps2",
                                           "rho_true", "repl"])
+    # the A/B equality check IS the fetch boundary here
+    # dpcorr-lint: ignore[sync-in-loop]
     for col in ("ni_hat", "int_hat", "ni_cover", "int_cover"):
-        np.testing.assert_array_equal(np.asarray(a[col]),
-                                      np.asarray(b[col]), col)
+        np.testing.assert_array_equal(  # dpcorr-lint: ignore[sync-in-loop]
+            np.asarray(a[col]),  # dpcorr-lint: ignore[sync-in-loop]
+            np.asarray(b[col]), col)  # dpcorr-lint: ignore[sync-in-loop]
     out["merged_detail_bit_identical"] = True
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)

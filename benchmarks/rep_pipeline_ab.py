@@ -1,0 +1,132 @@
+"""Interleaved A/B: legacy block loop vs the donated rep-block pipeline.
+
+The r08 tentpole replaced the bench's hot path (``bench.make_xla_block``
+measured by ``bench.measure_steady_state``) with the donated,
+pre-sharded, chained-key executor (``dpcorr.sim.RepBlockPipeline``
+measured by ``bench.measure_pipeline``). This script is the committed
+evidence that the swap is (a) a speedup and (b) not a semantic change:
+
+- **interleaved** rounds — A, B, A, B, … on the same process and box,
+  so slow drift (thermal, competing load on the 1-core box) hits both
+  arms equally instead of biasing whichever ran second;
+- **bit-identity** — before timing anything, one block of per-rep
+  (se², cover, ci_len) triples is computed by both arms from the same
+  key addresses and compared with ``np.testing.assert_array_equal``
+  (exact, not approximate). A pipeline that drifted by one ulp fails
+  here and writes no artifact.
+
+Both arms run the same threefry+erf⁻¹ rep at the same (chunk × block)
+geometry — this isolates the pipeline machinery (donation, explicit
+shardings, on-device keygen, single fetch). The Box–Muller sampler win
+is a separate, statistically-gated path (``xla_bm``) and is deliberately
+NOT part of this comparison.
+
+Usage::
+
+    python -m benchmarks.rep_pipeline_ab [--rounds 5] [--budget 6]
+        [--block 4096] [--chunk 4]
+        [--out benchmarks/results/r08_rep_pipeline_ab_cpu.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+from pathlib import Path
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--budget", type=float, default=6.0,
+                    help="per-arm, per-round measurement budget (seconds)")
+    ap.add_argument("--block", type=int, default=4096)
+    ap.add_argument("--chunk", type=int, default=4)
+    ap.add_argument("--out", type=str,
+                    default="benchmarks/results/r08_rep_pipeline_ab_cpu.json")
+    ap.add_argument("--platform", type=str, default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    import bench
+    from dpcorr.obs import transfer as transfer_mod
+    from dpcorr.utils import rng
+
+    counters = transfer_mod.default_counters()
+    key = rng.master_key()
+    legacy_block = bench.make_xla_block(args.chunk)
+    pipe = bench.make_pipeline(args.chunk, args.block, key=key,
+                               counters=counters)
+
+    # ---- bit-identity first: same key addresses, exact equality -------
+    rep_fn = bench.make_rep_fn()
+    from dpcorr.sim import chunked_vmap
+
+    block_idx = 0
+    keys = rng.rep_keys(rng.design_key(key, block_idx), args.block)
+    plain = jax.jit(lambda k: chunked_vmap(rep_fn, k, args.chunk))(keys)
+    piped = pipe.block_detail(block_idx)
+    for name, a, b in zip(("se2", "cover", "ci_len"), plain, piped,
+                          strict=True):
+        np.testing.assert_array_equal(np.asarray(a),  # dpcorr-lint: ignore[sync-in-loop]
+                                      np.asarray(b),  # dpcorr-lint: ignore[sync-in-loop]
+                                      err_msg=f"pipeline diverged on {name}")
+    # and the legacy arm's own reduction agrees with the per-rep means
+    legacy_means = tuple(float(x) for x in legacy_block(
+        rng.design_key(key, block_idx), args.block))
+    np.testing.assert_allclose(
+        legacy_means,
+        [float(np.mean(np.asarray(a)))  # dpcorr-lint: ignore[sync-in-loop]
+         for a in plain],
+        rtol=1e-6, err_msg="legacy block disagrees with its own rep table")
+
+    # ---- interleaved steady-state rounds ------------------------------
+    legacy_rps, pipeline_rps = [], []
+    for r in range(args.rounds):
+        rps_a, _, _ = bench.measure_steady_state(
+            legacy_block, lambda i: rng.design_key(key, i),
+            args.block, args.budget)
+        legacy_rps.append(rps_a)
+        rps_b, _ = bench.measure_pipeline(pipe, args.budget)
+        pipeline_rps.append(rps_b)
+        print(f"round {r}: legacy {rps_a:.1f} vs pipeline {rps_b:.1f} "
+              f"({rps_b / rps_a:.3f}x)", flush=True)
+
+    med_a = statistics.median(legacy_rps)
+    med_b = statistics.median(pipeline_rps)
+    out = {
+        "metric": "rep_pipeline_ab_ni_sign_n10k",
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+        "block_reps": args.block,
+        "chunk_size": args.chunk,
+        "rounds": args.rounds,
+        "budget_s_per_arm_per_round": args.budget,
+        "bit_identical": True,  # assert_array_equal above, or no artifact
+        "legacy_reps_per_sec": [round(v, 1) for v in legacy_rps],
+        "pipeline_reps_per_sec": [round(v, 1) for v in pipeline_rps],
+        "legacy_median": round(med_a, 1),
+        "pipeline_median": round(med_b, 1),
+        "speedup": round(med_b / med_a, 3),
+        "donation_engaged": pipe.donation_engaged,
+        "aot": pipe.aot_ok,
+        "transfer": counters.snapshot(),
+        "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    Path(args.out).parent.mkdir(parents=True, exist_ok=True)
+    Path(args.out).write_text(json.dumps(out, indent=1))
+    print(json.dumps({"legacy_median": out["legacy_median"],
+                      "pipeline_median": out["pipeline_median"],
+                      "speedup": out["speedup"], "out": args.out}))
+
+
+if __name__ == "__main__":
+    main()

@@ -7,8 +7,11 @@ with three independent lenses and writes one JSON artifact:
    the compiler counts them (post-fusion);
 2. the analytic hand model (``dpcorr.utils.roofline.analytic_rep_model``)
    as a sanity bound;
-3. a short steady-state throughput measurement → achieved FLOP/s and B/s
-   as %-of-peak for the platform's chip (``ChipPeaks``).
+3. a short steady-state throughput measurement through the donated
+   rep-block pipeline (``bench.make_pipeline``/``measure_pipeline`` —
+   the r08 hot path, transfer counters stamped into the artifact) →
+   achieved FLOP/s and B/s as %-of-peak for the platform's chip
+   (``ChipPeaks``).
 
 Optionally captures a ``jax.profiler`` trace of a few blocks
 (``--trace DIR``) — the checked-in trace PERFORMANCE.md cites.
@@ -54,15 +57,23 @@ def main() -> None:
         jax.config.update("jax_platforms", args.platform)
 
     import bench
-    from dpcorr.utils import rng
+    from dpcorr.obs import transfer as transfer_mod
+    from dpcorr.utils import geometry, rng
     from dpcorr.utils.roofline import (analytic_rep_model, peaks_for,
                                        summarize, xla_cost)
 
     platform = jax.devices()[0].platform
     is_tpu = platform in ("tpu", "axon")
-    # the bench worker's shape resolution, env overrides included — the
-    # artifact must describe the same compiled program as the headline
+    # the bench worker's shape resolution — the artifact must describe
+    # the same compiled program as the headline: the autotuned geometry
+    # when this host has one cached, else the measured constants
     block, chunk = bench._worker_shape("tpu" if is_tpu else "cpu")
+    geo = geometry.lookup("bench-icdf", bench.N,
+                          device_kind="tpu" if is_tpu else platform,
+                          eps_pairs=[(bench.EPS1, bench.EPS2)],
+                          env_pin=is_tpu)
+    if geo is not None:
+        block, chunk = geo.block_reps, geo.chunk_size
     block = args.block or block
     chunk = args.chunk or chunk
 
@@ -76,9 +87,13 @@ def main() -> None:
     # --- lens 2: analytic hand model ------------------------------------
     model = analytic_rep_model(bench.N, bench.EPS1, bench.EPS2)
 
-    # --- lens 3: steady-state throughput (the bench's own protocol) -----
-    rps, _, _ = bench.measure_steady_state(
-        fn, lambda i: rng.design_key(key, i), block, args.budget)
+    # --- lens 3: steady-state throughput (the bench's own protocol: the
+    # donated rep-block pipeline, with its transfer counters recorded) ---
+    counters = transfer_mod.default_counters()
+    before = counters.snapshot()
+    pipe = bench.make_pipeline(chunk, block, key=key, counters=counters)
+    rps, _ = bench.measure_pipeline(pipe, args.budget)
+    transfer = transfer_mod.diff(counters.snapshot(), before)
 
     peaks = peaks_for(platform)
     # the compiler count is the headline work model; fall back to the
@@ -99,6 +114,9 @@ def main() -> None:
             round(per_rep["flops"] / model["flops_per_rep"], 2)
             if per_rep["flops"] else None),
         "summary": summary,
+        "geometry_source": geo.source if geo is not None else "default",
+        "transfer": transfer,
+        "donation_engaged": pipe.donation_engaged,
         "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
 

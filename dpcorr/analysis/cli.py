@@ -18,8 +18,11 @@ from dpcorr.analysis import core
 
 #: the committed grandfather file at the repo root.
 DEFAULT_BASELINE = ".dpcorr-lint-baseline.json"
-#: what `python -m dpcorr lint` sweeps when no paths are given.
-DEFAULT_PATHS = ("dpcorr",)
+#: what `python -m dpcorr lint` sweeps when no paths are given. bench.py
+#: and benchmarks/ ride along for the hot-path sync rule (rules.sync) —
+#: an accidental per-block sync in the measurement harness corrupts the
+#: numbers it reports, which is how the r03→r04 halving hid.
+DEFAULT_PATHS = ("dpcorr", "bench.py", "benchmarks")
 
 
 def add_arguments(ap: argparse.ArgumentParser) -> None:

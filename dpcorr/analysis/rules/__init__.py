@@ -12,7 +12,8 @@ from dpcorr.analysis.rules.locks import LockChecker
 from dpcorr.analysis.rules.purity import PurityChecker
 from dpcorr.analysis.rules.rawdata import RawDataChecker
 from dpcorr.analysis.rules.rng import RngChecker
+from dpcorr.analysis.rules.sync import SyncChecker
 
 #: registration order is report order for equal (path, line).
 ALL_CHECKERS = (RngChecker, BudgetChecker, LockChecker, PurityChecker,
-                RawDataChecker)
+                RawDataChecker, SyncChecker)

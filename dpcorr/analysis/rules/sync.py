@@ -1,0 +1,116 @@
+"""Hot-path sync discipline: no per-iteration host syncs in rep loops.
+
+The replication hot path (sim → grid dispatch → parallel backend →
+bench) is fast *because* dispatch is asynchronous: blocks queue on the
+device while the host prepares the next one, and the host blocks once,
+at the reduction boundary (``sim.RepBlockPipeline.run``,
+``dpcorr_transfer_fetches_total``). A ``block_until_ready``,
+``np.asarray`` or ``jax.device_get`` inside a loop body silently turns
+that pipeline back into lock-step round-trips — the accidental-sync
+class the donated pipeline removed (r08), and exactly the regression
+shape that produced the r03→r04 headline halving without any code
+*looking* wrong. One rule:
+
+- ``sync-in-loop`` — a host-synchronizing call (``block_until_ready``,
+  ``numpy.asarray``/``numpy.array``, ``jax.device_get``) lexically
+  inside a ``for``/``while`` body or a comprehension, in a hot-path
+  module (sim, grid, parallel/, bench.py, benchmarks/).
+
+Intentional boundaries — a completion barrier at the end of a fetch
+phase, a drain loop that *measures* sync latency — carry an explicit
+``# dpcorr-lint: ignore[sync-in-loop]`` so every deliberate sync site
+is greppable and reviewed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from dpcorr.analysis.core import (
+    Checker,
+    Module,
+    Violation,
+    call_chain,
+    imported_names,
+)
+
+#: call-chain tails that force a host sync regardless of origin
+#: (method form ``x.block_until_ready()`` and ``jax.block_until_ready``)
+SYNC_TAILS = frozenset({"block_until_ready"})
+
+#: dotted origins that copy device values to host (and therefore block)
+SYNC_ORIGINS = frozenset({
+    "jax.device_get",
+    "numpy.asarray",
+    "numpy.array",
+})
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+_COMPS = (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+
+
+class SyncChecker(Checker):
+    name = "sync"
+    rules = {
+        "sync-in-loop": "host sync (block_until_ready/np.asarray/"
+                        "device_get) inside a rep-loop body — fetch once "
+                        "at the reduction boundary",
+    }
+
+    def applies_to(self, relpath: str) -> bool:
+        # the replication hot path only: these are the modules where a
+        # per-iteration sync is a throughput bug rather than a style
+        # choice (analysis code, tests and the serving layer fetch
+        # values because they *need* them on host)
+        parts = relpath.split("/")
+        return (relpath.endswith("sim.py") or relpath.endswith("grid.py")
+                or "parallel" in parts or "benchmarks" in parts
+                or parts[-1] == "bench.py")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        imports = imported_names(module.tree)
+        seen: set[tuple[int, int]] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, _LOOPS):
+                roots = node.body
+            elif isinstance(node, ast.DictComp):
+                roots = [node.key, node.value]
+            elif isinstance(node, _COMPS):
+                roots = [node.elt]
+            else:
+                continue
+            for root in roots:
+                yield from self._scan(module, root, imports, seen)
+
+    def _scan(self, module: Module, root, imports, seen,
+              ) -> Iterator[Violation]:
+        """Yield sync calls under ``root``, skipping nested function
+        scopes (a closure defined in a loop runs when *called*, and its
+        own call sites are scanned wherever they sit) and deduplicating
+        across nested loops."""
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            chain = call_chain(node)
+            if not chain:
+                continue
+            origin = ".".join((imports.get(chain[0], chain[0]),)
+                              + chain[1:])
+            if chain[-1] not in SYNC_TAILS and origin not in SYNC_ORIGINS:
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield Violation(
+                "sync-in-loop", module.relpath, node.lineno,
+                f"{'.'.join(chain)}(...) forces a host sync inside a "
+                f"loop body — dispatch stays async until the reduction "
+                f"boundary (one fetch per run, obs.transfer)")

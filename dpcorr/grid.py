@@ -57,6 +57,17 @@ class GridConfig:
     mixquant_mode: str = "det"
     seed: int = rng.MASTER_SEED
     chunk_size: int = 4096
+    #: "pinned" (use ``chunk_size`` as given) | "auto": read this host's
+    #: persisted geometry cache (``utils.geometry``, populated by the
+    #: bench autotuner / an explicit ``autotune()`` call) and use the
+    #: tuned chunk width for each bucket's (family, n) when one exists,
+    #: falling back to ``chunk_size``. Read-only — the grid never
+    #: probe-times (a probe inside a resumable grid would burn reps and
+    #: jitter timings); tuning happens at bench start. Bit-safe by
+    #: construction: every chunk width ≥ 2 yields bitwise-identical
+    #: results (geometry.CHUNK_FLOOR) and the resume-cache stamp
+    #: canonicalizes the chunk axis accordingly (see :func:`_stamp`).
+    geometry: str = "pinned"
     #: "local" | "sharded" (replications of each point over the mesh) |
     #: "bucketed" (one kernel per (n, ε) shape bucket) |
     #: "bucketed-sharded" (bucket kernels with the flat point×rep axis
@@ -128,6 +139,25 @@ class GridConfig:
         # reference order: n varies fastest, then rho, then eps
         return pd.DataFrame(rows)
 
+    def grid_family(self) -> str:
+        """Geometry-cache family tag for this grid's estimator pair
+        (``utils.geometry`` cache key axis)."""
+        return "grid-subg" if self.use_subg else "grid-sign"
+
+    def _resolve_chunk(self, row) -> int:
+        if self.geometry != "auto":
+            return self.chunk_size
+        from dpcorr.utils import geometry as geometry_mod
+
+        import jax
+
+        plat = jax.devices()[0].platform
+        geo = geometry_mod.lookup(
+            self.grid_family(), int(row["n"]),
+            device_kind="tpu" if plat in ("tpu", "axon") else plat,
+            eps_pairs=[(float(row["eps1"]), float(row["eps2"]))])
+        return geo.chunk_size if geo is not None else self.chunk_size
+
     def sim_config(self, row) -> SimConfig:
         return SimConfig(
             n=int(row["n"]), rho=float(row["rho"]),
@@ -135,7 +165,7 @@ class GridConfig:
             b=self.b, alpha=self.alpha, dgp=self.dgp, dgp_args=self.dgp_args,
             use_subg=self.use_subg, ci_mode=self.ci_mode,
             normalise=self.normalise, mixquant_mode=self.mixquant_mode,
-            seed=self.seed, chunk_size=self.chunk_size,
+            seed=self.seed, chunk_size=self._resolve_chunk(row),
         )
 
 
@@ -158,7 +188,15 @@ def _stamp(cfg: SimConfig) -> str:
     mc-mode real-variant runs additionally stamp the mixquant draw count:
     ``ci_int_subg``'s default moved 1000 → 2000 for ``variant="real"``
     (the reference's real-data-sims.R:161-164 count), and a resume must
-    not mix pre-move cached points with post-move fresh ones."""
+    not mix pre-move cached points with post-move fresh ones.
+
+    The chunk axis is canonicalized (``chunk_size=0``) for every width
+    ≥ 2: all such widths produce bitwise-identical results (measured r08,
+    ``utils.geometry.CHUNK_FLOOR``), so a geometry retune between runs
+    must not invalidate caches it cannot have changed. Width 1 lowers
+    differently — different bits — and keeps its literal stamp."""
+    if cfg.chunk_size >= 2:
+        cfg = dataclasses.replace(cfg, chunk_size=0)
     stamp = f"{cfg!r}|prng={rng.impl_tag()}"
     if cfg.mixquant_mode == "mc" and getattr(cfg, "subg_variant",
                                              "grid") == "real":
@@ -278,6 +316,15 @@ def validate_precompile(precompile: str) -> None:
             f"precompile must be 'off', 'auto' or 'on', got {precompile!r}")
 
 
+def validate_geometry(geometry: str) -> None:
+    """Fail-fast for the geometry knob (value check only; like
+    precompile it is backend-agnostic — every backend builds SimConfigs
+    through ``sim_config``)."""
+    if geometry not in ("pinned", "auto"):
+        raise ValueError(
+            f"geometry must be 'pinned' or 'auto', got {geometry!r}")
+
+
 def _precompile_bucket(cfg: SimConfig, m: int, merged: bool, k_pad,
                        observer, parent):
     """Phase-0 pool worker: AOT-compile one bucket's flat kernel at its
@@ -370,6 +417,15 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             for r in to_run])
         rhos = jnp.repeat(jnp.asarray([r.rho for r in to_run], jnp.float32),
                           gcfg.b)
+        if gcfg.backend != "bucketed-sharded":
+            # pre-shard the flat operands onto the kernel's (single)
+            # device before dispatch, counting placements into the
+            # transfer registry — the sharded backend does its own
+            # mesh-aware preshard inside run_detail_flat_sharded
+            from dpcorr.parallel.backend import _preshard
+
+            keys, rhos = _preshard((keys, rhos),
+                                   compile_mod.host_sharding())
         if merged:
             eps1s = jnp.repeat(jnp.asarray([r.eps1 for r in to_run],
                                            jnp.float32), gcfg.b)
@@ -586,7 +642,8 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
         try:
             if to_run:
                 try:
-                    raw = [np.asarray(a) for a in raw]  # completion barrier
+                    raw = [np.asarray(a)  # dpcorr-lint: ignore[sync-in-loop]
+                           for a in raw]  # completion barrier
                 except Exception as e:
                     if not fused:
                         raise
@@ -614,6 +671,8 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                         else:
                             still.append(r)
                     to_run = still
+                    # the degraded bucket's own fetch boundary
+                    # dpcorr-lint: ignore[sync-in-loop]
                     raw = ([np.asarray(a)
                             for a in xla_dispatch(cfg, to_run)]
                            if to_run else None)
@@ -686,6 +745,7 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
     validate_bucket_merge(gcfg.bucket_merge, gcfg.backend, gcfg.use_subg,
                           gcfg.eps_pairs)
     validate_precompile(gcfg.precompile)
+    validate_geometry(gcfg.geometry)
     design = gcfg.design_points()
     master = rng.master_key(gcfg.seed)
     out_dir = Path(gcfg.out_dir) if gcfg.out_dir else None
@@ -725,6 +785,9 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
                 if not cached:
                     res = _run_point(gcfg, cfg, rng.design_key(master, i),
                                      mesh)
+                    # per-point fetch boundary (local backend
+                    # persists each point before the next dispatches)
+                    # dpcorr-lint: ignore[sync-in-loop]
                     detail = {k: np.asarray(v)
                               for k, v in res.detail.items()}
                     if path is not None:

@@ -1,0 +1,129 @@
+"""Host↔device transfer accounting for the replication hot path.
+
+The r03→r04 headline halving went unexplained for a round because
+nothing recorded *where* the per-block time went — dispatch, reshard,
+or fetch. These counters make the donated rep-block pipeline
+(``dpcorr.sim.RepBlockPipeline``) and the grid dispatch attributable
+from the artifact alone:
+
+- ``dpcorr_transfer_donated_blocks_total`` — blocks dispatched through
+  a ``donate_argnums`` kernel (the carry buffers were offered to XLA
+  for reuse).
+- ``dpcorr_transfer_donation_unused_total`` — dispatches where the
+  runtime *declined* a donated buffer (the "Some donated buffers were
+  not usable" warning). Zero when donation actually engages — the
+  pipeline A/B tests assert on exactly this.
+- ``dpcorr_transfer_fetches_total`` — host fetches at a reduction
+  boundary (``block_until_ready``/``device_get`` of the accumulator).
+  One per pipeline run, not one per block: a rising fetches:blocks
+  ratio is the accidental-sync smell the lint ``sync`` rule guards.
+- ``dpcorr_transfer_device_put_total`` / ``_bytes_total`` — explicit
+  host→device placements (pre-sharding inputs before dispatch).
+- ``dpcorr_transfer_reshard_mismatch_total`` — dispatches whose input
+  sharding did not match the kernel's declared ``in_shardings`` (XLA
+  inserts a copy; on the 1-device CPU box this is free, through the
+  TPU tunnel it is the silent tax the explicit shardings exist to
+  remove).
+
+All counters live in the process default registry (``dpcorr.obs``), so
+``/metrics``, ``benchmarks/roofline.py`` and the bench ``detail`` stamp
+read one source of truth.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Mapping
+
+from dpcorr.obs.metrics import Registry, default_registry
+
+#: substring of the CPython warning emitted when a donated buffer
+#: cannot be aliased to any output (jax/_src/interpreters/mlir.py)
+_DONATION_WARNING = "donated buffers were not usable"
+
+
+class TransferCounters:
+    """The transfer-counter bundle for one registry (usually the
+    process default — construct with an explicit registry in tests so
+    concurrent pipelines never cross-contaminate counts)."""
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.donated_blocks = self.registry.counter(
+            "dpcorr_transfer_donated_blocks_total",
+            "Blocks dispatched through a donate_argnums kernel")
+        self.donation_unused = self.registry.counter(
+            "dpcorr_transfer_donation_unused_total",
+            "Dispatches where the runtime declined a donated buffer")
+        self.fetches = self.registry.counter(
+            "dpcorr_transfer_fetches_total",
+            "Host fetches at a reduction boundary")
+        self.device_puts = self.registry.counter(
+            "dpcorr_transfer_device_put_total",
+            "Explicit host-to-device placements (pre-sharding)")
+        self.device_put_bytes = self.registry.counter(
+            "dpcorr_transfer_device_put_bytes_total",
+            "Bytes moved by explicit host-to-device placements")
+        self.reshard_mismatch = self.registry.counter(
+            "dpcorr_transfer_reshard_mismatch_total",
+            "Dispatches whose input sharding mismatched in_shardings")
+
+    def snapshot(self) -> dict[str, int]:
+        """Flat dict for the bench ``detail`` stamp / roofline artifact."""
+        return {
+            "donated_blocks": int(self.donated_blocks.value()),
+            "donation_unused": int(self.donation_unused.value()),
+            "fetches": int(self.fetches.value()),
+            "device_put": int(self.device_puts.value()),
+            "device_put_bytes": int(self.device_put_bytes.value()),
+            "reshard_mismatch": int(self.reshard_mismatch.value()),
+        }
+
+
+_default: TransferCounters | None = None
+
+
+def default_counters() -> TransferCounters:
+    """The process-wide bundle over the default registry."""
+    global _default
+    if _default is None:
+        _default = TransferCounters()
+    return _default
+
+
+class donation_watch(warnings.catch_warnings):
+    """Context manager that records donation-decline warnings into
+    ``counters`` instead of letting them scroll by unattributed. The
+    first dispatch of a donated kernel is run under this watch; the
+    test satellite's "donation actually engages" assertion is
+    ``donation_unused == 0`` plus the pipeline's ``donation_engaged``
+    flag this feeds."""
+
+    def __init__(self, counters: TransferCounters):
+        super().__init__(record=True)
+        self._counters = counters
+        self.declined = False
+
+    def __enter__(self):
+        self._log = super().__enter__()
+        warnings.simplefilter("always")
+        return self
+
+    def __exit__(self, *exc):
+        for w in self._log:
+            if _DONATION_WARNING in str(w.message):
+                self.declined = True
+                self._counters.donation_unused.inc()
+            else:  # re-emit anything we were not looking for
+                warnings.warn_explicit(w.message, w.category,
+                                       w.filename, w.lineno)
+        return super().__exit__(*exc)
+
+
+def diff(after: Mapping[str, int], before: Mapping[str, int],
+         ) -> dict[str, int]:
+    """Per-run counter delta between two :meth:`TransferCounters.snapshot`
+    calls (counters are process-cumulative; artifacts want the run's own
+    contribution)."""
+    return {k: int(after[k]) - int(before.get(k, 0)) for k in after}

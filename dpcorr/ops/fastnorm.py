@@ -1,0 +1,98 @@
+"""Box–Muller Gaussian sampler with polynomial sincos (CPU fast path).
+
+Why this exists (r08 profile of the bench headline on the 1-core CPU
+box): the per-rep cost is ~75% ``jax.random.normal``, and inside it XLA
+CPU *scalarizes* ``log1p`` — the erf⁻¹ rational approximation calls
+libm per element (~200 µs of the 310 µs normal-draw cost at n=2·10⁴)
+while ``log``/``exp``/``sqrt`` vectorize. Box–Muller avoids erf⁻¹
+entirely, but the naive form loses the win to ``sin``/``cos`` — also
+scalar libm calls on CPU (~120 µs each). The sampler here spends its
+transcendental budget only on the vectorized ops:
+
+- radius: ``sqrt(-2·log(u1))`` — both vectorized;
+- angle: ``sincos_2pi(u2)`` evaluates sin/cos of ``θ = 2π·u2`` with
+  degree-7/8 minimax polynomials (Cephes f32 coefficients) after an
+  *exact* range reduction: ``t = 4·u2`` is exact in f32 (a power-of-two
+  scale of a [0,1) value), the quarter-turn index ``k = round(t)`` and
+  remainder ``r = (t−k)·π/2`` then select the quadrant — no Payne–Hanek
+  machinery needed because the argument is constructed, not arbitrary.
+
+Accuracy: max |error| vs f64 sin/cos is ~4.2e-7 (≈4 ulp at 1.0) across
+[0,1) — far below the sampler's own f32 rounding noise downstream.
+Distributionally this is an *exact* Gaussian sampler (Box–Muller is
+exact; the polynomial error perturbs each draw by ≲1e-6 relative),
+but it is NOT bit-identical to ``jax.random.normal``'s inverse-CDF
+draws — same stream-independence contract as the TPU ``rbg`` impl and
+the Pallas hardware-PRNG path: acceptance is statistical (the bench
+``_sane`` gate; SURVEY.md §5 RNG), and results are stamped as their
+own path (``xla_bm``), never mixed with threefry+erf⁻¹ numbers.
+
+Measured (r08, this box, n=10⁴ bench rep): 194 µs/rep vs 411 µs on the
+inverse-CDF path — the whole-bench win that recovers the ≥1.0×-baseline
+headline on CPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["sincos_2pi", "normal_pairs", "gen_gaussian_bm"]
+
+#: Cephes single-precision minimax coefficients on [-π/4, π/4].
+_SIN_C = (-1.6666654611e-1, 8.3321608736e-3, -1.9515295891e-4)
+_COS_C = (4.166664568298827e-2, -1.388731625493765e-3,
+          2.443315711809948e-5)
+
+
+def sincos_2pi(u: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """``(sin(2πu), cos(2πu))`` for ``u ∈ [0, 1)``, f32, vectorized.
+
+    Range reduction is exact: ``t = 4u`` only scales the exponent, so
+    the quarter-turn remainder ``r = (t − round(t))·π/2 ∈ [−π/4, π/4]``
+    carries no cancellation error beyond the one rounding in the final
+    multiply. Quadrant selection rotates (sin, cos) by k·90°.
+    """
+    u = jnp.asarray(u, jnp.float32)
+    t4 = 4.0 * u
+    k = jnp.round(t4)
+    r = (t4 - k) * jnp.float32(np.pi / 2)
+    r2 = r * r
+    s = r * (1.0 + r2 * (_SIN_C[0] + r2 * (_SIN_C[1] + r2 * _SIN_C[2])))
+    c = 1.0 + r2 * (-0.5 + r2 * (_COS_C[0]
+                                 + r2 * (_COS_C[1] + r2 * _COS_C[2])))
+    km = k.astype(jnp.int32) & 3
+    sin = jnp.where(km == 0, s,
+                    jnp.where(km == 1, c, jnp.where(km == 2, -s, -c)))
+    cos = jnp.where(km == 0, c,
+                    jnp.where(km == 1, -s, jnp.where(km == 2, -c, s)))
+    return sin, cos
+
+
+def normal_pairs(key: jax.Array, n: int) -> tuple[jax.Array, jax.Array]:
+    """Two independent N(0,1) f32 vectors of length ``n`` from one key
+    (Box–Muller: each uniform pair yields a full Gaussian pair — half
+    the random bits of two inverse-CDF draws, zero erf⁻¹ calls)."""
+    u = jax.random.uniform(key, (n, 2), jnp.float32)
+    # u1 = 0 would send the radius to +inf; clamp to the smallest
+    # positive normal (probability 2⁻³² per draw, same guard the
+    # textbook form uses)
+    u1 = jnp.maximum(u[:, 0], jnp.finfo(jnp.float32).tiny)
+    rad = jnp.sqrt(-2.0 * jnp.log(u1))
+    s, c = sincos_2pi(u[:, 1])
+    return rad * c, rad * s
+
+
+def gen_gaussian_bm(key: jax.Array, n: int, rho, mu: float = 0.0,
+                    sigma: float = 1.0) -> jax.Array:
+    """Drop-in for ``dpcorr.models.dgp.gen_gaussian`` on the Box–Muller
+    sampler: (n, 2) correlated Gaussians via the same 2×2 Cholesky
+    ``y = ρ·z₁ + √(1−ρ²)·z₂``. Statistically identical law, different
+    stream — bench ``xla_bm`` path only; the simulator's replay
+    contract stays on ``gen_gaussian``."""
+    rho = jnp.asarray(rho, jnp.float32)
+    z1, z2 = normal_pairs(key, n)
+    x = z1
+    y = rho * z1 + jnp.sqrt(jnp.maximum(1.0 - rho * rho, 0.0)) * z2
+    return mu + sigma * jnp.stack([x, y], axis=1)

@@ -26,10 +26,40 @@ from dpcorr import sim as sim_mod
 from dpcorr.parallel.mesh import rep_mesh
 from dpcorr.sim import SimConfig
 from dpcorr.utils import rng
+from dpcorr.utils.compile import mesh_shardings
 
 
 def _padded_b(b: int, n_shards: int) -> int:
     return -(-b // n_shards) * n_shards
+
+
+def _preshard(arrays, sharding, counters=None):
+    """Place inputs on their kernel's declared sharding *before* dispatch,
+    so jit never inserts an implicit resharding copy (free on one CPU
+    device; through a TPU tunnel it is the silent per-dispatch tax the
+    explicit shardings exist to remove). Placements and any
+    committed-but-mismatched inputs are counted into the transfer
+    registry (``obs.transfer``) so the bench/roofline artifacts can
+    attribute them."""
+    from dpcorr.obs import transfer as transfer_mod
+
+    tc = counters if counters is not None else transfer_mod.default_counters()
+    out = []
+    for a in arrays:
+        sh = getattr(a, "sharding", None)
+        if sh is not None and sh.is_equivalent_to(sharding, a.ndim):
+            out.append(a)
+            continue
+        if sh is not None and getattr(a, "_committed", False):
+            tc.reshard_mismatch.inc()
+        a = jax.device_put(a, sharding)
+        tc.device_puts.inc()
+        try:
+            tc.device_put_bytes.inc(float(a.nbytes))
+        except Exception:  # typed-key avals may not report nbytes
+            pass
+        out.append(a)
+    return tuple(out)
 
 
 @lru_cache(maxsize=128)
@@ -39,9 +69,11 @@ def _detail_fn(cfg_norho: SimConfig, mesh: Mesh):
     def local(keys, rho):
         return sim_mod._detail_from_keys(cfg_norho, keys, rho)
 
+    rep_sh, repl_sh = mesh_shardings(mesh)
     sharded = shard_map(local, mesh=mesh,
                         in_specs=(P("rep"), P()), out_specs=P("rep"))
-    return jax.jit(sharded)
+    return jax.jit(sharded, in_shardings=(rep_sh, repl_sh),
+                   out_shardings=rep_sh)
 
 
 @lru_cache(maxsize=128)
@@ -71,9 +103,11 @@ def _summary_fn(cfg_norho: SimConfig, mesh: Mesh):
             }
         return jax.lax.psum(sums, "rep")
 
+    rep_sh, repl_sh = mesh_shardings(mesh)
     sharded = shard_map(local, mesh=mesh,
                         in_specs=(P("rep"), P(), P()), out_specs=P())
-    return jax.jit(sharded)
+    return jax.jit(sharded, in_shardings=(rep_sh, repl_sh, repl_sh),
+                   out_shardings=repl_sh)
 
 
 @lru_cache(maxsize=128)
@@ -89,9 +123,11 @@ def _flat_fn(cfg_norho: SimConfig, mesh: Mesh):
         # these bodies never diverging (jit composes inside shard_map)
         return sim_mod._run_detail_flat(cfg_norho, keys, rhos)
 
+    rep_sh, _ = mesh_shardings(mesh)
     sharded = shard_map(local, mesh=mesh,
                         in_specs=(P("rep"), P("rep")), out_specs=P("rep"))
-    return jax.jit(sharded)
+    return jax.jit(sharded, in_shardings=(rep_sh, rep_sh),
+                   out_shardings=rep_sh)
 
 
 def run_detail_flat_sharded(cfg_norho: SimConfig, keys: jax.Array,
@@ -109,6 +145,8 @@ def run_detail_flat_sharded(cfg_norho: SimConfig, keys: jax.Array,
         # mesh — e.g. one uncached point at small b after a resume)
         idx = jnp.arange(padded) % total
         keys, rhos = keys[idx], rhos[idx]
+    rep_sh, _ = mesh_shardings(mesh)
+    keys, rhos = _preshard((keys, rhos), rep_sh)
     out = _flat_fn(cfg_norho, mesh)(keys, rhos)
     return tuple(a[:total] for a in out)
 
@@ -141,10 +179,12 @@ def make_serve_batch_sharded(single, mesh: Mesh | None = None,
         def local(keys, xs, ys):
             return jax.lax.map(lambda t: single(*t), (keys, xs, ys))
 
+    rep_sh, _ = mesh_shardings(mesh)
     sharded = shard_map(local, mesh=mesh,
                         in_specs=(P("rep"), P("rep"), P("rep")),
                         out_specs=P("rep"))
-    return jax.jit(sharded)
+    return jax.jit(sharded, in_shardings=(rep_sh, rep_sh, rep_sh),
+                   out_shardings=rep_sh)
 
 
 def _prep(cfg: SimConfig, key, mesh: Mesh):
@@ -163,6 +203,7 @@ def run_detail_sharded(cfg: SimConfig, key=None, mesh: Mesh | None = None):
     """Full (B, ·) detail table, replications sharded over the mesh."""
     mesh = mesh or rep_mesh()
     cfg_norho, keys, _ = _prep(cfg, key, mesh)
+    (keys,) = _preshard((keys,), mesh_shardings(mesh)[0])
     out = _detail_fn(cfg_norho, mesh)(keys, jnp.float32(cfg.rho))
     detail = dict(zip(sim_mod.DETAIL_FIELDS,
                       (a[: cfg.b] for a in out), strict=True))
@@ -177,6 +218,7 @@ def run_summary_sharded(cfg: SimConfig, key=None, mesh: Mesh | None = None):
     """
     mesh = mesh or rep_mesh()
     cfg_norho, keys, _ = _prep(cfg, key, mesh)
+    (keys,) = _preshard((keys,), mesh_shardings(mesh)[0])
     sums = _summary_fn(cfg_norho, mesh)(
         keys, jnp.float32(cfg.rho), jnp.float32(cfg.b))
     b = float(cfg.b)

@@ -162,7 +162,8 @@ def run_grid_host(gcfg: GridConfig, host_id: int, n_hosts: int,
         try:
             res = grid_mod._run_point(gcfg, cfg,
                                       rng.design_key(master, i), mesh)
-            np.savez(path, config_stamp=stamp,
+            np.savez(path, config_stamp=stamp,  # per-point fetch boundary
+                     # dpcorr-lint: ignore[sync-in-loop]
                      **{k: np.asarray(v) for k, v in res.detail.items()})
         except Exception as e:
             failures.append((i, e))

@@ -16,6 +16,7 @@ B × n never has to fit in HBM at once (SURVEY.md §5 long-context analogue).
 from __future__ import annotations
 
 import dataclasses
+import logging
 from functools import partial
 from typing import Any, Callable, Mapping
 
@@ -30,6 +31,9 @@ from dpcorr.models.estimators import (
     correlation_ni_subg,
 )
 from dpcorr.utils import rng
+from dpcorr.utils.geometry import CHUNK_FLOOR
+
+log = logging.getLogger("dpcorr.sim")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -234,30 +238,63 @@ def stress_chunk_size(b: int, on_tpu: bool) -> int:
     return min(b, 32) if on_tpu else 1
 
 
+#: log-once flag for the chunked_vmap tail-split notice
+_TAIL_SPLIT_LOGGED = False
+
+
 def chunked_vmap(fn: Callable, args, chunk_size: int):
     """``vmap(fn)`` over axis 0, blocked into ``lax.map`` chunks.
 
     Keeps at most ``chunk_size`` replications' intermediates live in HBM.
     ``args`` is one array (→ ``fn(x)``) or a tuple of same-length arrays
-    mapped together (→ ``fn(*xs)``, e.g. per-element (key, ρ) pairs for the
-    bucketed grid). The axis is padded up to a chunk multiple; outputs are
-    truncated back.
+    mapped together (→ ``fn(*xs)``, e.g. per-element (key, ρ) pairs for
+    the bucketed grid).
+
+    A non-multiple tail runs as its OWN narrower ``vmap`` row rather than
+    being padded up to a full chunk and truncated (the pre-r08 policy,
+    which wasted up to ``chunk_size - 1`` replications per call — at
+    B=250, chunk=4096 it computed 4096 reps and threw 3846 away, skewing
+    reps/sec at small B). Bit-safety: every vmap width ≥ 2 produces
+    bitwise-identical per-rep outputs for all four estimator families,
+    but width 1 lowers differently (measured, r08 —
+    ``utils.geometry.CHUNK_FLOOR``), so a lone tail element is padded up
+    to width 2 and truncated: one wasted rep instead of ``chunk - 1``.
     """
+    global _TAIL_SPLIT_LOGGED
     is_tuple = isinstance(args, tuple)
     tree = args if is_tuple else (args,)
     b = jax.tree.leaves(tree)[0].shape[0]
     chunk = min(chunk_size, b)
-    n_chunks = -(-b // chunk)
-    pad = n_chunks * chunk - b
+    n_full, tail = divmod(b, chunk)
 
-    def block(a):
-        if pad:
-            a = jnp.concatenate([a, a[:pad]])
-        return a.reshape(n_chunks, chunk, *a.shape[1:])
+    def mapped(t, rows, width):
+        blocked = jax.tree.map(
+            lambda a: a.reshape(rows, width, *a.shape[1:]), t)
+        out = jax.lax.map(lambda tt: jax.vmap(fn)(*tt), blocked)
+        return jax.tree.map(
+            lambda a: a.reshape(rows * width, *a.shape[2:]), out)
 
-    blocked = jax.tree.map(block, tree)
-    out = jax.lax.map(lambda t: jax.vmap(fn)(*t), blocked)
-    return jax.tree.map(lambda a: a.reshape(n_chunks * chunk, *a.shape[2:])[:b], out)
+    if not tail:
+        return mapped(tree, n_full, chunk)
+
+    width = max(tail, CHUNK_FLOOR)
+    if not _TAIL_SPLIT_LOGGED:
+        _TAIL_SPLIT_LOGGED = True
+        log.info(
+            "chunked_vmap tail-split: B=%d at chunk=%d runs a width-%d "
+            "tail row (%d padded reps; the old full-chunk pad wasted %d)",
+            b, chunk, width, width - tail, chunk - tail)
+    head = jax.tree.map(lambda a: a[: n_full * chunk], tree)
+    tl = jax.tree.map(lambda a: a[n_full * chunk:], tree)
+    if width != tail:  # only tail == 1: replicate the one element to 2
+        tl = jax.tree.map(
+            lambda a: jnp.concatenate([a] * width), tl)
+    t_out = jax.tree.map(lambda a: a[:tail], mapped(tl, 1, width))
+    if not n_full:
+        return t_out
+    h_out = mapped(head, n_full, chunk)
+    return jax.tree.map(
+        lambda h, t: jnp.concatenate([h, t]), h_out, t_out)
 
 
 def _detail_from_keys(cfg: SimConfig, keys: jax.Array, rho: jax.Array):
@@ -306,6 +343,166 @@ def _run_detail(cfg: SimConfig, key: jax.Array):
     # ρ-sweep / reseeded rerun reuses one compiled kernel.
     cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
     return _run_detail_core(cfg_norho, key, jnp.float32(cfg.rho))
+
+
+class RepBlockPipeline:
+    """Donated, pre-sharded, overlapped replication-block executor.
+
+    The reduction-shaped hot loop (bench headline, power sweeps) as
+    chained fixed-size blocks with an explicit ``(key_data, accumulators)``
+    carry:
+
+    - **donation** — ``donate_argnums=(0, 1)``: the per-block key buffer
+      and the accumulator scalars are donated to XLA *and the kernel
+      returns the next block's keys*, so the uint32 key buffer aliases
+      in→out and is reused in place instead of round-tripping an
+      allocation per block. Typed PRNG-key avals are never donatable on
+      this jax, so raw ``rng.key_data`` crosses the jit boundary exactly
+      as in the ``jax.export`` contract (``utils.compile``) and is
+      rewrapped inside.
+    - **pre-sharding** — every operand and result is pinned to one
+      explicit sharding (``utils.compile.host_sharding``): degenerate on
+      a 1-device CPU host, and the machinery a TPU chain needs so
+      chained blocks never reshard between dispatches.
+    - **overlap** — the next block's keys are generated ON DEVICE inside
+      block *i*'s program (double-buffered keygen with no host
+      round-trip), dispatch is async, and the host syncs exactly once
+      per :meth:`run`, at the reduction boundary
+      (``dpcorr_transfer_fetches_total``).
+
+    ``rep_fn(key) -> tuple[out_len]`` is the per-replication body; each
+    output is sum-reduced into its accumulator. Bit-identity contract:
+    block *i* runs ``chunked_vmap(rep_fn)`` over
+    ``rng.rep_keys(rng.design_key(key, i), block_reps)`` — the same key
+    addresses and the same chunked math as the un-donated path, pinned
+    by :meth:`block_detail` and tests/test_pipeline.py for all four
+    estimator families.
+    """
+
+    def __init__(self, rep_fn: Callable, out_len: int, *, key: jax.Array,
+                 block_reps: int, chunk_size: int, family: str = "custom",
+                 device=None, counters=None, aot: bool = True,
+                 observer=None, impl: str | None = None,
+                 acc_dtype=jnp.float32):
+        from dpcorr.obs import transfer as transfer_mod
+        from dpcorr.utils import compile as compile_mod
+
+        self.rep_fn = rep_fn
+        self.out_len = int(out_len)
+        self.block_reps = int(block_reps)
+        self.chunk_size = max(int(chunk_size), CHUNK_FLOOR)
+        self.family = family
+        #: PRNG impl the raw key words are rewrapped with inside the
+        #: kernel; None = the process default (``rng.impl_tag``). The
+        #: bench's ``xla_rbg`` path passes "rbg" with a matching root key.
+        self.impl = impl
+        self.acc_dtype = acc_dtype
+        self._key = key
+        self._counters = counters if counters is not None \
+            else transfer_mod.default_counters()
+        self.sharding = compile_mod.host_sharding(device)
+        sh = self.sharding
+
+        def _body(key_data, acc, i):
+            keys = rng.keys_from_data(key_data, self.impl)
+            outs = chunked_vmap(self.rep_fn, keys, self.chunk_size)
+            # the NEXT block's keys are produced on-device as part of
+            # THIS block's program: the uint32 carry aliases in→out
+            # (that is what makes it donatable at all — donation needs a
+            # matching-shape output) and keygen overlaps the rep math
+            nxt = rng.key_data(rng.rep_keys(
+                rng.design_key(self._key, i + jnp.uint32(1)),
+                self.block_reps))
+            return nxt, tuple(a + o.sum()
+                              for a, o in zip(acc, outs, strict=True))
+
+        self._blk_jit = jax.jit(_body, donate_argnums=(0, 1),
+                                in_shardings=sh, out_shardings=sh)
+        self._blk = self._blk_jit
+        self._keygen = jax.jit(
+            lambda i: rng.key_data(rng.rep_keys(
+                rng.design_key(self._key, i), self.block_reps)),
+            out_shardings=sh)
+        #: None until the runtime has shown its hand; then True iff no
+        #: donation-decline warning was observed
+        self.donation_engaged: bool | None = None
+        self.aot_ok: bool | None = None
+        if aot:
+            acc_avals = tuple(jax.ShapeDtypeStruct((), self.acc_dtype)
+                              for _ in range(self.out_len))
+            # the key-data aval is derived from THIS pipeline's keygen
+            # (not the process-default impl): an "rbg" root carries 4
+            # uint32 words where threefry carries 2
+            kd_aval = jax.eval_shape(
+                lambda i: rng.key_data(rng.rep_keys(
+                    rng.design_key(self._key, i), self.block_reps)),
+                jax.ShapeDtypeStruct((), jnp.uint32))
+            with transfer_mod.donation_watch(self._counters) as w:
+                self._blk, self.aot_ok = compile_mod.aot_compile(
+                    self._blk_jit,
+                    (kd_aval, acc_avals,
+                     jax.ShapeDtypeStruct((), jnp.uint32)),
+                    signature={"kernel": "rep_block",
+                               "family": self.family,
+                               "block_reps": self.block_reps,
+                               "chunk_size": self.chunk_size},
+                    observer=observer)
+            if w.declined:
+                # decline warnings fire at lowering — the first-dispatch
+                # watch would never see this one
+                self.donation_engaged = False
+            elif self.aot_ok:
+                self.donation_engaged = True
+
+    def _call(self, key_data, acc, i):
+        try:
+            return self._blk(key_data, acc, i)
+        except TypeError:
+            if self._blk is self._blk_jit:
+                raise
+            # AOT executables are strict about call signatures; degrade
+            # once to the identical-HLO lazy jit
+            log.warning("rep_block AOT executable rejected the call "
+                        "signature; falling back to lazy jit")
+            self._blk = self._blk_jit
+            self.donation_engaged = None
+            return self._blk(key_data, acc, i)
+
+    def _dispatch(self, key_data, acc, i):
+        if self.donation_engaged is None:
+            from dpcorr.obs import transfer as transfer_mod
+
+            with transfer_mod.donation_watch(self._counters) as w:
+                out = self._call(key_data, acc, i)
+                jax.block_until_ready(out[1])  # surface the warning now
+            self.donation_engaged = not w.declined
+            return out
+        return self._call(key_data, acc, i)
+
+    def run(self, n_blocks: int, *, start_block: int = 0):
+        """Run ``n_blocks`` chained blocks; returns ``(sums, n_reps)``
+        with ``sums`` the tuple of float accumulator totals. Exactly one
+        host sync, at the reduction boundary."""
+        acc = tuple(jnp.zeros((), self.acc_dtype, device=self.sharding)
+                    for _ in range(self.out_len))
+        cur = self._keygen(jnp.uint32(start_block))
+        for i in range(start_block, start_block + int(n_blocks)):
+            cur, acc = self._dispatch(cur, acc, jnp.uint32(i))
+            self._counters.donated_blocks.inc()
+        acc = jax.block_until_ready(acc)
+        self._counters.fetches.inc()
+        return (tuple(float(a) for a in acc),
+                int(n_blocks) * self.block_reps)
+
+    def block_detail(self, i: int = 0):
+        """Un-reduced per-rep outputs of block ``i`` — the verification
+        hook the bit-identity A/B tests compare against the plain
+        (un-donated, un-presharded) path: same key addresses, same
+        chunked math, so equality is exact, not approximate."""
+        keys = rng.rep_keys(rng.design_key(self._key, i), self.block_reps)
+        fn = jax.jit(
+            lambda k: chunked_vmap(self.rep_fn, k, self.chunk_size))
+        return fn(keys)
 
 
 def summarize(detail: Mapping[str, jax.Array], rho: float):

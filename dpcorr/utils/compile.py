@@ -185,6 +185,38 @@ def aot_compile(jitted, lower_args, *, signature=None,
     return fn, ok
 
 
+# ------------------------------------------------------- shardings ----
+def host_sharding(device=None):
+    """The explicit placement the donated rep-block pipeline pins every
+    operand and result to (``sim.RepBlockPipeline``): pass a device (or
+    nothing for the process default) and get a sharding suitable for
+    ``in_shardings``/``out_shardings``/``jnp.zeros(device=...)``.
+
+    Degenerate on the 1-device CPU box — but keeping it *explicit* is
+    what lets chained blocks alias donated buffers with no reshard copy
+    in between, and the same call sites accept a ``NamedSharding`` when
+    a mesh exists (:func:`mesh_shardings`), so the CPU pipeline and the
+    TPU pipeline are one code path."""
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    if isinstance(dev, jax.sharding.Sharding):
+        return dev
+    return jax.sharding.SingleDeviceSharding(dev)
+
+
+def mesh_shardings(mesh, axis: str = "rep"):
+    """``(sharded, replicated)`` NamedSharding pair for a 1-axis mesh —
+    the explicit in/out shardings the parallel backend's shard_map
+    kernels declare (``parallel.backend``) so the flat replication axis
+    arrives pre-sharded (jit inserts no resharding copy) and scalars
+    stay replicated."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis)), NamedSharding(mesh, P())
+
+
 # ------------------------------------------------------- jax.export ----
 def export_supported() -> bool:
     """Version gate for the serialization path: ``jax.export`` only

@@ -1,0 +1,271 @@
+"""Per-host batch-geometry autotuner for the replication hot path.
+
+The bench's (chunk_size × block_reps) shape was a hand-flipped constant
+(``WORKER_SHAPE``), and the one time it was re-tuned by hand (2048 →
+8192 between r03 and r04) coincided with the headline silently halving.
+This module replaces the constant with a measured choice:
+
+- :func:`autotune` probe-times a small ladder of (chunk, block) shapes
+  at bench/grid start — chunk first at a fixed probe block, then block
+  at the winning chunk — and returns the fastest,
+- the winner is persisted per ``(device_kind, family, n, dtype)`` in a
+  JSON cache (``~/.cache/dpcorr/geometry.json``; ``DPCORR_GEOMETRY_CACHE``
+  overrides, ``=0`` disables), so steady-state runs skip the probe,
+- ``DPCORR_BENCH_CHUNK`` / ``DPCORR_BENCH_BLOCK_REPS`` pin the shape
+  outright (``source="pinned"``) — the tuning-run escape hatch the old
+  env overrides already provided.
+
+Bit-identity constraint (measured, r08): replication results are
+bitwise identical across every vmap chunk width **≥ 2** for all four
+estimator families, but width **1** lowers differently and produces
+different bits. The ladder therefore floors at chunk 2 — an autotuned
+geometry can never move a result by even one ulp — and
+:func:`chunk_floor` is exported for the tail-split in
+``sim.chunked_vmap``, which pads width-1 tails up to 2 for the same
+reason.
+
+The ``dtype`` cache axis reuses the f32/f64 geometry-band detector
+(``estimators.common.f32_geometry_band``): an ε set inside the
+~1e-6 band compiles a *different* batch design (adjacent m) than the
+static rule, so its tuned shape must not be shared with the off-band
+kernel of the same nominal dtype — :func:`dtype_tag` folds the band
+verdict into the cache key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+
+log = logging.getLogger("dpcorr.geometry")
+
+#: minimum bit-safe vmap chunk width (see module docstring)
+CHUNK_FLOOR = 2
+
+#: probe ladders per device kind: (chunk candidates, block candidates).
+#: CPU candidates bracket the measured r08 sweep (chunk 2-4 optimal at
+#: n=10⁴ — small widths keep one rep's sample tables inside L2; blocks
+#: amortize dispatch). TPU candidates bracket the r02 block-scaling
+#: sweep (wide chunks, 2¹⁷-2¹⁹ blocks amortize ~0.2 s/fetch of tunnel
+#: latency). Probing is cheap on CPU (a few blocks); on TPU the probe
+#: block is already the smaller candidate.
+LADDERS: dict[str, tuple[tuple[int, ...], tuple[int, ...]]] = {
+    "cpu": ((2, 4, 16, 64), (2048, 4096, 8192)),
+    "tpu": ((4096, 16384), (1 << 17, 1 << 19)),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Geometry:
+    """One chosen replication-block shape and where it came from:
+    ``autotune`` (probed now), ``cache`` (probed by an earlier run on
+    this host), ``pinned`` (env override), ``default`` (ladder fallback
+    when probing is impossible)."""
+
+    chunk_size: int
+    block_reps: int
+    source: str
+    reps_per_sec: float | None = None
+
+    def as_detail(self) -> dict:
+        """The bench-JSON ``detail.geometry`` stamp."""
+        d = {"chunk_size": self.chunk_size, "block_reps": self.block_reps,
+             "source": self.source}
+        if self.reps_per_sec is not None:
+            d["probe_reps_per_sec"] = round(self.reps_per_sec, 1)
+        return d
+
+
+def chunk_floor(width: int) -> int:
+    """Clamp a requested vmap width to the bit-safe floor."""
+    return max(CHUNK_FLOOR, int(width))
+
+
+def dtype_tag(dtype: str = "f32", eps_pairs=None, n: int | None = None,
+              ) -> str:
+    """Cache-key dtype component, band-split via the shared detector
+    (``common.f32_geometry_band``) so in-band ε sets never share a
+    tuned shape with the off-band kernel (different batch design ⇒
+    different program ⇒ different optimum)."""
+    if eps_pairs:
+        from dpcorr.models.estimators.common import f32_geometry_band
+
+        if f32_geometry_band(eps_pairs, n=n):
+            return f"{dtype}-band"
+    return dtype
+
+
+def cache_path() -> str | None:
+    """Resolved persistent-cache path, or None when disabled."""
+    raw = os.environ.get("DPCORR_GEOMETRY_CACHE")
+    if raw is not None:
+        if raw.strip().lower() in ("0", "off", "none", ""):
+            return None
+        return raw
+    return os.path.join(os.path.expanduser("~"), ".cache", "dpcorr",
+                        "geometry.json")
+
+
+def _cache_key(device_kind: str, family: str, n: int, dtype: str) -> str:
+    return f"{device_kind}|{family}|n={int(n)}|{dtype}"
+
+
+def _load(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            state = json.load(f)
+        return state if isinstance(state, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _store(path: str, key: str, geo: Geometry) -> None:
+    state = _load(path)
+    state[key] = {"chunk_size": geo.chunk_size,
+                  "block_reps": geo.block_reps,
+                  "reps_per_sec": geo.reps_per_sec,
+                  "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                time.gmtime())}
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(state, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as e:  # a read-only home must not fail the bench
+        log.warning("geometry cache write to %s failed: %s", path, e)
+
+
+#: in-process memo: one probe per (device_kind, family, n, dtype) per
+#: process even when the persistent cache is disabled
+_MEMO: dict[str, Geometry] = {}
+
+
+def _pinned() -> Geometry | None:
+    chunk = os.environ.get("DPCORR_BENCH_CHUNK")
+    block = os.environ.get("DPCORR_BENCH_BLOCK_REPS")
+    if chunk is None and block is None:
+        return None
+    # a half-pin inherits the other axis from the device default ladder
+    # at resolve time — callers pass the resolved Geometry to as_detail
+    return Geometry(chunk_size=chunk_floor(int(chunk)) if chunk else 0,
+                    block_reps=int(block) if block else 0,
+                    source="pinned")
+
+
+def resolve_pinned(geo: Geometry, device_kind: str) -> Geometry:
+    """Fill a half-pinned geometry's zero axes from the ladder default."""
+    chunks, blocks = LADDERS.get(device_kind, LADDERS["cpu"])
+    return dataclasses.replace(
+        geo,
+        chunk_size=geo.chunk_size or chunks[-1],
+        block_reps=geo.block_reps or blocks[-1])
+
+
+def lookup(family: str, n: int, *, device_kind: str = "cpu",
+           dtype: str = "f32", eps_pairs=None,
+           env_pin: bool = True) -> Geometry | None:
+    """Read-only geometry resolution (no probing): env pin → in-process
+    memo → persistent cache. The grid's ``geometry="auto"`` path —
+    probing inside a resumable grid would burn replications and jitter
+    its timings, so the grid only *reads* what a bench/autotune run on
+    this host already measured. Returns None on a cold host; the caller
+    keeps its configured shape. ``env_pin=False`` skips the env-pin rung
+    entirely — the bench's CPU fallback uses it because
+    ``DPCORR_BENCH_CHUNK``/``DPCORR_BENCH_BLOCK_REPS`` tune the TPU
+    paths and a TPU-sized pin inherited by the fallback would blow its
+    kill timeout (bench.py ``_worker_shape``)."""
+    pinned = _pinned() if env_pin else None
+    if pinned is not None:
+        return resolve_pinned(pinned, device_kind)
+    key = _cache_key(device_kind, family, n, dtype_tag(dtype, eps_pairs, n))
+    geo = _MEMO.get(key)
+    if geo is not None:
+        return geo
+    path = cache_path()
+    if path:
+        hit = _load(path).get(key)
+        if hit:
+            geo = Geometry(chunk_size=chunk_floor(hit["chunk_size"]),
+                           block_reps=int(hit["block_reps"]),
+                           source="cache",
+                           reps_per_sec=hit.get("reps_per_sec"))
+            _MEMO[key] = geo
+            return geo
+    return None
+
+
+def autotune(family: str, n: int, make_runner, *,
+             device_kind: str = "cpu", dtype: str = "f32",
+             eps_pairs=None, ladder=None, probe_reps: int | None = None,
+             clock=time.perf_counter, use_cache: bool = True,
+             force: bool = False, env_pin: bool = True) -> Geometry:
+    """Choose (chunk_size, block_reps) for one replication workload.
+
+    ``make_runner(chunk, block)`` must return a zero-arg callable that
+    runs ONE block of ``block`` replications synchronously (compile
+    excluded by the warm call the tuner makes first). ``clock`` is
+    injectable so the determinism test can script the timings; the
+    probe protocol itself is deterministic given the clock: chunk is
+    chosen first at the smallest block candidate, then block at the
+    winning chunk, ties broken toward the earlier ladder entry.
+
+    Resolution order: env pin → in-process memo → persistent cache →
+    probe (winner persisted). ``force=True`` skips memo+cache reads
+    (re-probe), never the env pin — an operator's pin outranks tuning.
+    ``env_pin=False`` removes the env-pin rung (see :func:`lookup`).
+    """
+    pinned = _pinned() if env_pin else None
+    if pinned is not None:
+        return resolve_pinned(pinned, device_kind)
+
+    tag = dtype_tag(dtype, eps_pairs, n)
+    key = _cache_key(device_kind, family, n, tag)
+    if not force:
+        geo = _MEMO.get(key)
+        if geo is not None:
+            return geo
+        path = cache_path() if use_cache else None
+        if path:
+            hit = _load(path).get(key)
+            if hit:
+                geo = Geometry(chunk_size=chunk_floor(hit["chunk_size"]),
+                               block_reps=int(hit["block_reps"]),
+                               source="cache",
+                               reps_per_sec=hit.get("reps_per_sec"))
+                _MEMO[key] = geo
+                return geo
+
+    chunks, blocks = ladder or LADDERS.get(device_kind, LADDERS["cpu"])
+    chunks = tuple(chunk_floor(c) for c in chunks)
+    probe_block = probe_reps or blocks[0]
+
+    def timed(chunk: int, block: int) -> float:
+        run = make_runner(chunk, block)
+        run()  # warm: compile + first dispatch excluded
+        t0 = clock()
+        run()
+        return max(clock() - t0, 1e-9)
+
+    try:
+        best_chunk = min(chunks, key=lambda c: timed(c, probe_block))
+        per_rep = {b: timed(best_chunk, b) / b for b in blocks}
+        best_block = min(blocks, key=lambda b: per_rep[b])
+        geo = Geometry(chunk_size=best_chunk, block_reps=best_block,
+                       source="autotune",
+                       reps_per_sec=1.0 / per_rep[best_block])
+    except Exception as e:  # probing must never kill the measurement
+        log.warning("geometry autotune failed (%s: %s); using ladder "
+                    "default", type(e).__name__, e)
+        geo = Geometry(chunk_size=chunks[-1], block_reps=blocks[-1],
+                       source="default")
+
+    _MEMO[key] = geo
+    if use_cache and geo.source == "autotune":
+        path = cache_path()
+        if path:
+            _store(path, key, geo)
+    return geo

@@ -1,0 +1,28 @@
+"""sync-rule bad fixture: per-iteration host syncs in rep loops."""
+import jax
+import numpy as np
+
+
+def drain_each(blocks):
+    out = []
+    for b in blocks:
+        out.append(np.asarray(b))  # sync-in-loop
+    return out
+
+
+def wait_each(queue):
+    total = 0.0
+    while queue:
+        x = queue.pop()
+        jax.block_until_ready(x)  # sync-in-loop
+        total += 1.0
+    return total
+
+
+def comp_fetch(blocks):
+    return [jax.device_get(b) for b in blocks]  # sync-in-loop
+
+
+def method_sync(blocks):
+    for b in blocks:
+        b.block_until_ready()  # sync-in-loop (method form)

@@ -1,0 +1,19 @@
+"""sync-rule ok fixture: async dispatch, one fetch at the boundary."""
+import jax
+import numpy as np
+
+
+def pipeline(step, blocks):
+    acc = None
+    for b in blocks:
+        acc = step(b) if acc is None else acc + step(b)  # async dispatch
+    # the reduction boundary: one sync, outside every loop
+    return np.asarray(jax.block_until_ready(acc))
+
+
+def closure_is_not_an_iteration(blocks):
+    # a helper *defined* in a loop body only syncs where it is called
+    fetchers = []
+    for b in blocks:
+        fetchers.append(lambda b=b: np.asarray(b))
+    return fetchers

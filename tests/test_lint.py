@@ -138,6 +138,38 @@ def test_purity_ok_fixture_is_clean():
     assert lint_fixture("purity_ok.py") == []
 
 
+def test_sync_bad_fixture_fires_every_form():
+    vs = lint_fixture("benchmarks/sync_bad.py")
+    assert fired(vs) == [
+        ("sync-in-loop", 9),   # np.asarray in a for body
+        ("sync-in-loop", 17),  # jax.block_until_ready in a while body
+        ("sync-in-loop", 23),  # jax.device_get in a comprehension
+        ("sync-in-loop", 28),  # method-form x.block_until_ready()
+    ]
+
+
+def test_sync_ok_fixture_is_clean():
+    assert lint_fixture("benchmarks/sync_ok.py") == []
+
+
+def test_sync_suppressed_fixture_is_clean():
+    assert lint_fixture("benchmarks/sync_suppressed_ok.py") == []
+
+
+def test_sync_scope_is_path_based():
+    """The same source outside the hot-path modules is out of scope —
+    analysis/serving code fetches values because it needs them."""
+    from dpcorr.analysis.rules.sync import SyncChecker
+
+    checker = SyncChecker()
+    assert not checker.applies_to("dpcorr/serve/kernels.py")
+    assert not checker.applies_to("dpcorr/analysis/core.py")
+    for hot in ("dpcorr/sim.py", "dpcorr/grid.py",
+                "dpcorr/parallel/backend.py", "bench.py",
+                "benchmarks/roofline.py"):
+        assert checker.applies_to(hot), hot
+
+
 # ------------------------------------------------- suppression comments ----
 def test_suppression_comment_both_placements():
     assert lint_fixture("rng_suppressed_ok.py") == []

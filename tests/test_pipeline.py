@@ -1,0 +1,447 @@
+"""RepBlockPipeline (r08): bit-identity A/B, donation, autotuner, gate.
+
+The tentpole's contract is that the donated, pre-sharded, chained-key
+executor is a pure *mechanical* change: block ``i`` of the pipeline
+produces bitwise the same per-rep outputs as the plain
+``chunked_vmap`` path from the same key addresses, for all four
+estimator families, in f32 and (via a subprocess, because
+``JAX_ENABLE_X64`` is process-global) f64. The per-rep tables are
+compared exactly — ``assert_array_equal``, never ``allclose``. The
+``run()`` accumulators are the one place a tolerance appears: XLA
+fuses the in-kernel ``o.sum()`` into the block program and may
+reassociate it relative to a detached sum over the materialized
+table, so they are checked to a few ulps instead.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bench
+from dpcorr import sim
+from dpcorr.obs.metrics import Registry
+from dpcorr.obs.transfer import TransferCounters
+from dpcorr.utils import geometry, rng
+
+BLOCK = 16
+CHUNK = 4
+
+#: one config per estimator family pair: "sign" exercises
+#: ci_ni_signbatch + ci_int_signflip, the subG configs exercise
+#: correlation_ni_subg + ci_int_subg in both variants — together the
+#: four families the bit-identity acceptance names.
+CFGS = {
+    "sign": sim.SimConfig(n=200, rho=0.35, eps1=1.0, eps2=0.5,
+                          b=BLOCK, chunk_size=CHUNK),
+    "subg-grid": sim.SimConfig(n=400, rho=0.5, eps1=1.0, eps2=1.0,
+                               b=BLOCK, chunk_size=CHUNK,
+                               dgp="bounded_factor", use_subg=True),
+    "subg-real": sim.SimConfig(n=400, rho=0.5, eps1=1.0, eps2=1.0,
+                               b=BLOCK, chunk_size=CHUNK,
+                               dgp="bounded_factor", use_subg=True,
+                               subg_variant="real"),
+}
+
+
+def _pipeline_for(cfg, key, **kw):
+    cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
+    rho = jnp.float32(cfg.rho)
+    return sim.RepBlockPipeline(
+        lambda k: sim._one_rep(k, rho, cfg_norho),
+        len(sim.DETAIL_FIELDS), key=key, block_reps=cfg.b,
+        chunk_size=cfg.chunk_size, family="test", **kw)
+
+
+# ------------------------------------------------------------------
+# Bit-identity A/B: pipeline block vs the plain chunked_vmap path
+# ------------------------------------------------------------------
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("name", sorted(CFGS))
+    def test_block_matches_plain_path_exactly(self, name):
+        cfg = CFGS[name]
+        key = rng.master_key()
+        pipe = _pipeline_for(cfg, key, aot=False,
+                             counters=TransferCounters(Registry()))
+        cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
+        for i in (0, 3):  # a non-zero index catches design_key drift
+            plain = sim._run_detail_core(cfg_norho, rng.design_key(key, i),
+                                         jnp.float32(cfg.rho))
+            piped = pipe.block_detail(i)
+            for f, a, b in zip(sim.DETAIL_FIELDS, plain, piped,
+                               strict=True):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    err_msg=f"{name}: pipeline diverged on {f} "
+                            f"(block {i})")
+
+    def test_run_sums_match_replayed_reduction(self):
+        """run()'s donated accumulators match the same reduction
+        replayed block-by-block from block_detail. Same math, but XLA
+        fuses the in-kernel sum into the block program and may
+        reassociate it, so this is ulp-tight allclose, not equality —
+        the exactness contract lives on the per-rep tables above."""
+        cfg = CFGS["sign"]
+        key = rng.master_key()
+        pipe = _pipeline_for(cfg, key, aot=False,
+                             counters=TransferCounters(Registry()))
+        n_blocks = 3
+        sums, n_reps = pipe.run(n_blocks)
+        assert n_reps == n_blocks * cfg.b
+        acc = [jnp.zeros((), jnp.float32)] * len(sim.DETAIL_FIELDS)
+        for i in range(n_blocks):
+            outs = pipe.block_detail(i)
+            acc = [a + o.sum() for a, o in zip(acc, outs, strict=True)]
+        np.testing.assert_allclose(
+            np.asarray(sums), np.asarray([float(a) for a in acc]),
+            rtol=1e-6, err_msg="accumulators drifted past reassociation")
+
+    def test_f64_bit_identity_subprocess(self):
+        """Same A/B under JAX_ENABLE_X64 (process-global, so a
+        subprocess), sign + both subG variants, f64 accumulators."""
+        script = r"""
+import dataclasses
+import jax
+import jax.numpy as jnp
+import numpy as np
+from dpcorr import sim
+from dpcorr.obs.metrics import Registry
+from dpcorr.obs.transfer import TransferCounters
+from dpcorr.utils import rng
+
+assert jax.config.jax_enable_x64
+for cfg in [
+    sim.SimConfig(n=200, rho=0.35, eps1=1.0, eps2=0.5, b=8, chunk_size=4),
+    sim.SimConfig(n=400, rho=0.5, eps1=1.0, eps2=1.0, b=8, chunk_size=4,
+                  dgp="bounded_factor", use_subg=True),
+    sim.SimConfig(n=400, rho=0.5, eps1=1.0, eps2=1.0, b=8, chunk_size=4,
+                  dgp="bounded_factor", use_subg=True,
+                  subg_variant="real"),
+]:
+    key = rng.master_key()
+    cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
+    rho = jnp.float32(cfg.rho)
+    pipe = sim.RepBlockPipeline(
+        lambda k: sim._one_rep(k, rho, cfg_norho),
+        len(sim.DETAIL_FIELDS), key=key, block_reps=cfg.b,
+        chunk_size=cfg.chunk_size, family="test-f64", aot=False,
+        counters=TransferCounters(Registry()), acc_dtype=jnp.float64)
+    plain = sim._run_detail_core(cfg_norho, rng.design_key(key, 0), rho)
+    piped = pipe.block_detail(0)
+    for f, a, b in zip(sim.DETAIL_FIELDS, plain, piped, strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"f64 diverged on {f}")
+    sums, _ = pipe.run(2)
+    acc = [jnp.zeros((), jnp.float64)] * len(sim.DETAIL_FIELDS)
+    for i in range(2):
+        outs = pipe.block_detail(i)
+        acc = [x + o.sum().astype(jnp.float64)
+               for x, o in zip(acc, outs)]
+    # ulp-tight: the fused in-kernel sum may reassociate, and some
+    # detail columns (cover, anything rho-anchored) stay f32 under
+    # x64, so the bound is f32 ulps (see the f32 accumulator test)
+    np.testing.assert_allclose(np.asarray(sums),
+                               np.asarray([float(a) for a in acc]),
+                               rtol=1e-6)
+print("F64_BIT_IDENTITY_OK")
+"""
+        env = dict(os.environ, JAX_ENABLE_X64="1", JAX_PLATFORMS="cpu")
+        r = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=600,
+                           cwd=os.path.dirname(os.path.dirname(
+                               os.path.abspath(__file__))))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "F64_BIT_IDENTITY_OK" in r.stdout
+
+
+# ------------------------------------------------------------------
+# Donation engages (and the transfer counters prove the overlap shape)
+# ------------------------------------------------------------------
+
+class TestDonation:
+    def test_donation_engages_single_fetch(self):
+        cfg = CFGS["sign"]
+        counters = TransferCounters(Registry())
+        pipe = _pipeline_for(cfg, rng.master_key(), counters=counters)
+        assert pipe.aot_ok is True
+        # AOT lowering already showed the runtime's hand: a decline
+        # warning there would have latched False
+        assert pipe.donation_engaged is True
+        before = counters.snapshot()
+        sums, n_reps = pipe.run(3)
+        assert pipe.donation_engaged is True
+        d = {k: v - before[k] for k, v in counters.snapshot().items()}
+        assert d["donated_blocks"] == 3
+        assert d["donation_unused"] == 0
+        assert d["fetches"] == 1  # ONE host sync per run()
+        assert all(np.isfinite(s) for s in sums)
+
+    def test_chunk_size_floored_to_bit_safe_width(self):
+        cfg = CFGS["sign"]
+        pipe = sim.RepBlockPipeline(
+            lambda k: (jnp.zeros(()),), 1, key=rng.master_key(),
+            block_reps=cfg.b, chunk_size=1, family="floor", aot=False,
+            counters=TransferCounters(Registry()))
+        assert pipe.chunk_size == geometry.CHUNK_FLOOR
+
+
+# ------------------------------------------------------------------
+# chunked_vmap tail-split: no more full-chunk pad waste
+# ------------------------------------------------------------------
+
+class TestChunkedVmapTail:
+    def _fn(self, x):
+        return (jnp.sin(x) * 2.0 + 1.0, jnp.exp(-x))
+
+    @pytest.mark.parametrize("b,chunk", [(13, 5), (9, 4), (8, 4), (1, 4),
+                                         (5, 8)])
+    def test_tail_rows_bitwise_equal_full_vmap(self, b, chunk):
+        xs = jnp.linspace(-1.0, 2.0, b)
+        ref = jax.vmap(self._fn)(xs)
+        got = sim.chunked_vmap(self._fn, xs, chunk)
+        for r, g in zip(ref, got, strict=True):
+            assert g.shape == r.shape
+            np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+    def test_tuple_args_tail(self):
+        xs = jnp.linspace(0.0, 1.0, 7)
+        ys = jnp.linspace(2.0, 3.0, 7)
+        fn = lambda x, y: (x * y,)
+        ref = jax.vmap(fn)(xs, ys)
+        got = sim.chunked_vmap(fn, (xs, ys), 3)
+        np.testing.assert_array_equal(np.asarray(ref[0]),
+                                      np.asarray(got[0]))
+
+
+# ------------------------------------------------------------------
+# Autotuner: deterministic given a scripted clock; pins outrank
+# ------------------------------------------------------------------
+
+def _scripted_clock(durations):
+    """Each timed() probe calls the clock twice; consecutive entries of
+    ``durations`` become the measured interval of consecutive probes."""
+    seq = iter(durations)
+    state = {"t": 0.0, "d": None}
+
+    def clock():
+        if state["d"] is None:
+            state["d"] = next(seq)
+        else:
+            state["t"] += state["d"]
+            state["d"] = None
+        return state["t"]
+
+    return clock
+
+
+def _null_runner(chunk, block):
+    return lambda: None
+
+
+def _boom_runner(chunk, block):
+    raise AssertionError("probe must not run")
+
+
+LADDER = ((2, 4, 8), (100, 200))
+
+
+@pytest.fixture()
+def clean_geometry(monkeypatch):
+    monkeypatch.setattr(geometry, "_MEMO", {})
+    monkeypatch.setenv("DPCORR_GEOMETRY_CACHE", "0")
+    monkeypatch.delenv("DPCORR_BENCH_CHUNK", raising=False)
+    monkeypatch.delenv("DPCORR_BENCH_BLOCK_REPS", raising=False)
+
+
+class TestAutotune:
+    # probe order: chunks (2, 4, 8) at block 100, then blocks (100, 200)
+    # at the winning chunk — 5 intervals
+    DUR = [0.30, 0.10, 0.20, 0.10, 0.18]
+
+    def test_deterministic_given_clock(self, clean_geometry):
+        runs = [
+            geometry.autotune("det", 10, _null_runner, device_kind="cpu",
+                              ladder=LADDER, clock=_scripted_clock(self.DUR),
+                              use_cache=False, force=True)
+            for _ in range(2)
+        ]
+        assert runs[0] == runs[1]
+        geo = runs[0]
+        assert (geo.chunk_size, geo.block_reps) == (4, 200)
+        assert geo.source == "autotune"
+        # 200 reps in 0.18 s, exactly
+        assert geo.reps_per_sec == pytest.approx(200 / 0.18)
+
+    def test_ties_break_toward_earlier_ladder_entry(self, clean_geometry):
+        # all chunks equal; blocks equal per-rep (0.2/100 == 0.4/200)
+        clock = _scripted_clock([0.1, 0.1, 0.1, 0.2, 0.4])
+        geo = geometry.autotune("tie", 10, _null_runner, device_kind="cpu",
+                                ladder=LADDER, clock=clock,
+                                use_cache=False, force=True)
+        assert (geo.chunk_size, geo.block_reps) == (2, 100)
+
+    def test_env_pin_outranks_probe(self, clean_geometry, monkeypatch):
+        monkeypatch.setenv("DPCORR_BENCH_CHUNK", "16")
+        monkeypatch.setenv("DPCORR_BENCH_BLOCK_REPS", "512")
+        geo = geometry.autotune("pin", 10, _boom_runner, device_kind="cpu",
+                                ladder=LADDER, use_cache=False)
+        assert (geo.chunk_size, geo.block_reps, geo.source) == \
+            (16, 512, "pinned")
+        assert geometry.lookup("pin", 10).source == "pinned"
+
+    def test_env_pin_false_ignores_pin(self, clean_geometry, monkeypatch):
+        monkeypatch.setenv("DPCORR_BENCH_CHUNK", "16")
+        monkeypatch.setenv("DPCORR_BENCH_BLOCK_REPS", "512")
+        geo = geometry.autotune("nopin", 10, _null_runner,
+                                device_kind="cpu", ladder=LADDER,
+                                clock=_scripted_clock(self.DUR),
+                                use_cache=False, force=True,
+                                env_pin=False)
+        assert geo.source == "autotune"
+        assert (geo.chunk_size, geo.block_reps) == (4, 200)
+        # lookup honors the same opt-out: memo, not the pin
+        assert geometry.lookup("nopin", 10, env_pin=False) == geo
+
+    def test_pinned_chunk_floored(self, clean_geometry, monkeypatch):
+        monkeypatch.setenv("DPCORR_BENCH_CHUNK", "1")
+        geo = geometry.autotune("floorpin", 10, _boom_runner,
+                                device_kind="cpu", use_cache=False)
+        assert geo.chunk_size == geometry.CHUNK_FLOOR
+
+    def test_probe_failure_degrades_to_ladder_default(self,
+                                                      clean_geometry):
+        def broken(chunk, block):
+            def run():
+                raise RuntimeError("device fell over")
+            return run
+
+        geo = geometry.autotune("broken", 10, broken, device_kind="cpu",
+                                ladder=LADDER, use_cache=False, force=True)
+        assert geo.source == "default"
+        assert (geo.chunk_size, geo.block_reps) == (8, 200)
+
+
+# ------------------------------------------------------------------
+# Regression gate
+# ------------------------------------------------------------------
+
+def _measured(value, kind="cpu"):
+    return {"metric": bench.METRIC, "value": value,
+            "detail": {"device_kind": kind} if kind else {}}
+
+
+class TestGateCheck:
+    LKG = {"metric": bench.METRIC, "value": 1000.0, "device_kind": "cpu"}
+
+    def test_above_floor_passes(self):
+        ok, reason = bench.gate_check(_measured(900.0), self.LKG, 0.85)
+        assert ok and "0.900x" in reason
+
+    def test_below_floor_fails(self):
+        ok, reason = bench.gate_check(_measured(700.0), self.LKG, 0.85)
+        assert not ok and reason.startswith("REGRESSION")
+
+    def test_device_kind_mismatch_passes_with_note(self):
+        ok, reason = bench.gate_check(_measured(10.0, kind="tpu"),
+                                      self.LKG, 0.85)
+        assert ok and "mismatch" in reason
+
+    def test_zero_value_artifact_fails(self):
+        # the all-paths-failed artifact stamps value 0 — must gate red
+        ok, _ = bench.gate_check(_measured(0.0), self.LKG, 0.85)
+        assert not ok
+
+    def test_missing_measured_kind_still_compared(self):
+        ok, _ = bench.gate_check(_measured(0.0, kind=None), self.LKG, 0.85)
+        assert not ok
+
+    def test_missing_baseline_bootstraps(self):
+        ok, reason = bench.gate_check(_measured(1.0), None, 0.85)
+        assert ok and "bootstrap" in reason
+
+    def test_foreign_metric_baseline_passes(self):
+        ok, _ = bench.gate_check(
+            _measured(1.0), {"metric": "other_metric", "value": 9e9}, 0.85)
+        assert ok
+
+    def test_unusable_baseline_value_passes(self):
+        ok, _ = bench.gate_check(_measured(1.0),
+                                 {"metric": bench.METRIC, "value": 0}, 0.85)
+        assert ok
+
+    def test_floor_env_parsing(self, monkeypatch):
+        monkeypatch.setenv("DPCORR_BENCH_GATE_FLOOR", "0.5")
+        assert bench._gate_floor() == 0.5
+        monkeypatch.setenv("DPCORR_BENCH_GATE_FLOOR", "not-a-float")
+        assert bench._gate_floor() == bench.GATE_FLOOR_DEFAULT
+
+
+class TestGateCli:
+    def _run_gate(self, monkeypatch, capsys, artifact_path, lkg_path,
+                  extra_env=None):
+        for k, v in (extra_env or {}).items():
+            monkeypatch.setenv(k, v)
+        monkeypatch.setattr(sys, "argv",
+                            ["bench.py", "--gate-measured",
+                             str(artifact_path), "--lkg", str(lkg_path)])
+        prev = signal.getsignal(signal.SIGTERM)
+        try:
+            with pytest.raises(SystemExit) as exc:
+                bench.main()
+        finally:
+            # main() installs a process-global SIGTERM handler
+            signal.signal(signal.SIGTERM, prev)
+        out = json.loads(capsys.readouterr().out)
+        return exc.value.code, out
+
+    @pytest.fixture()
+    def lkg(self, tmp_path):
+        p = tmp_path / "lkg.json"
+        p.write_text(json.dumps({"metric": bench.METRIC, "value": 1000.0,
+                                 "device_kind": "cpu"}))
+        return p
+
+    def _artifact(self, tmp_path, value):
+        p = tmp_path / "measured.json"
+        p.write_text(json.dumps(_measured(value)))
+        return p
+
+    def test_regression_exits_1(self, monkeypatch, capsys, tmp_path, lkg):
+        code, out = self._run_gate(monkeypatch, capsys,
+                                   self._artifact(tmp_path, 100.0), lkg)
+        assert code == 1
+        assert out["detail"]["gate"]["ok"] is False
+        assert "REGRESSION" in out["detail"]["gate"]["reason"]
+
+    def test_healthy_exits_0_and_stamps_gate(self, monkeypatch, capsys,
+                                             tmp_path, lkg):
+        code, out = self._run_gate(monkeypatch, capsys,
+                                   self._artifact(tmp_path, 990.0), lkg)
+        assert code == 0
+        gate = out["detail"]["gate"]
+        assert gate["ok"] is True
+        assert gate["lkg_value"] == 1000.0
+        assert gate["floor"] == bench.GATE_FLOOR_DEFAULT
+
+    def test_derated_floor_env(self, monkeypatch, capsys, tmp_path, lkg):
+        # the CI job's derate: 100/1000 fails at 0.85 but passes at 0.05
+        code, out = self._run_gate(monkeypatch, capsys,
+                                   self._artifact(tmp_path, 100.0), lkg,
+                                   {"DPCORR_BENCH_GATE_FLOOR": "0.05"})
+        assert code == 0
+        assert out["detail"]["gate"]["floor"] == 0.05
+
+    def test_missing_lkg_bootstraps(self, monkeypatch, capsys, tmp_path):
+        code, out = self._run_gate(monkeypatch, capsys,
+                                   self._artifact(tmp_path, 1.0),
+                                   tmp_path / "absent.json")
+        assert code == 0
+        assert "bootstrap" in out["detail"]["gate"]["reason"]

@@ -32,6 +32,10 @@ Replaces the reference's "source the script" workflow (README.md:28-46):
                   (threads, inproc or loopback TCP); ``protocol scan``
                   is the jax-free transcript auditor (schema,
                   no-raw-columns, ε balance)
+- ``chaos``       deterministic step-kill matrix over the two-party
+                  protocol: crash a party process at each named point,
+                  restart it, prove the resumed session bit-identical
+                  with ε spent exactly once (docs/ROBUSTNESS.md)
 
 Grids persist per-design-point ``.npz`` + parquet tables into ``--out`` and
 resume from them (the reference only saves one blob at the end).
@@ -337,15 +341,37 @@ def _result_json(res) -> dict:
 
 def cmd_party(args):
     """One side of the two-party protocol over TCP (docs/PROTOCOL.md).
-    Role y listens, role x connects; each process sees one column."""
+    Role y listens, role x connects; each process sees one column.
+
+    With ``--journal`` the session is crash-safe (docs/ROBUSTNESS.md):
+    state journals durably as the session progresses, the TCP link
+    redials through peer restarts, and rerunning this exact command
+    after a crash resumes the session instead of restarting it. A
+    ``--chaos`` plan (or ``DPCORR_CHAOS``) arms a deterministic kill at
+    a named crash point — the chaos harness's victim hook."""
     import numpy as np
 
+    from dpcorr import chaos
     from dpcorr.obs import trace as obs_trace
     from dpcorr.obs.audit import AuditTrail
-    from dpcorr.protocol import Party, ReliableChannel, Transcript
-    from dpcorr.protocol.transport import tcp_accept, tcp_connect, tcp_listen
+    from dpcorr.protocol import (
+        Party,
+        ReliableChannel,
+        SessionJournal,
+        Transcript,
+    )
+    from dpcorr.protocol.transport import (
+        ReconnectingTcpLink,
+        tcp_accept,
+        tcp_connect,
+        tcp_listen,
+    )
     from dpcorr.serve.ledger import PrivacyLedger
 
+    plan = (chaos.plan_from_spec(args.chaos) if args.chaos
+            else chaos.plan_from_env())
+    if plan is not None:
+        chaos.install(plan)
     if args.trace:
         obs_trace.configure(args.trace)
     spec = _protocol_spec(args)
@@ -357,29 +383,64 @@ def cmd_party(args):
     else:
         cols = _party_columns(args, spec.n)
         col = cols[0] if args.role == "x" else cols[1]
+    srv = None
+    # A journaled RESTART must not block waiting for a live peer before
+    # the session logic runs: when the peer already finished and left,
+    # the bounded resume handshake concludes peer-gone and the session
+    # completes offline from the journal (docs/ROBUSTNESS.md) — so on
+    # resume the first accept/connect goes lazily through the
+    # reconnecting link instead of an eager blocking call here.
+    resuming = bool(args.journal) and os.path.exists(args.journal)
     if args.role == "y":
         srv, bound = tcp_listen(args.host, args.port)
         print(json.dumps({"party": {"role": "y", "session": spec.session,
                                     "listening": [args.host, bound]}}),
               flush=True)
-        link = tcp_accept(srv, timeout_s=args.connect_timeout)
-        srv.close()
+        if args.journal:
+            # keep the server socket: a crashed peer's restart redials
+            # the same port, and the reconnecting link re-accepts it
+            first = (None if resuming
+                     else tcp_accept(srv, timeout_s=args.connect_timeout))
+            link = ReconnectingTcpLink(
+                lambda: tcp_accept(srv, timeout_s=5.0), link=first,
+                max_outage_s=args.connect_timeout)
+        else:
+            link = tcp_accept(srv, timeout_s=args.connect_timeout)
+            srv.close()
+            srv = None
     else:
         print(json.dumps({"party": {"role": "x", "session": spec.session,
                                     "connecting": [args.host, args.port]}}),
               flush=True)
-        link = tcp_connect(args.host, args.port,
-                           timeout_s=args.connect_timeout)
+        if args.journal:
+            first = (None if resuming
+                     else tcp_connect(args.host, args.port,
+                                      timeout_s=args.connect_timeout))
+            link = ReconnectingTcpLink(
+                lambda: tcp_connect(args.host, args.port, timeout_s=5.0),
+                link=first, max_outage_s=args.connect_timeout)
+        else:
+            link = tcp_connect(args.host, args.port,
+                               timeout_s=args.connect_timeout)
     audit = AuditTrail(args.audit) if args.audit else None
     ledger = PrivacyLedger(args.budget, path=args.ledger, audit=audit)
     channel = ReliableChannel(link, timeout_s=args.timeout,
                               max_retries=args.max_retries)
+    transcript = Transcript(args.transcript)
+    if plan is not None:
+        # reproducibility-from-the-artifact: the kill plan is in the
+        # transcript header, so any chaos run replays from its own log
+        transcript.meta(chaos=plan.to_dict(), session=spec.session)
+    journal = SessionJournal(args.journal) if args.journal else None
     party = Party(args.role, col, spec, channel, ledger,
-                  transcript=Transcript(args.transcript))
+                  transcript=transcript,
+                  recv_timeout_s=args.recv_timeout, journal=journal)
     try:
         res = party.run()
     finally:
         link.close()
+        if srv is not None:
+            srv.close()
     print(json.dumps({"result": _result_json(res)}, indent=2))
 
 
@@ -395,6 +456,12 @@ def cmd_protocol_run(args):
         fault = {"drop": args.fault_drop,
                  "delay_s": args.fault_delay_ms / 1000.0,
                  "duplicate": args.fault_duplicate}
+    if args.fault_seed is not None:
+        # one knob reproducing both sides' fault streams; the runner
+        # stamps it (with the rest of the fault config) into each
+        # transcript header, so a failure replays from the artifact
+        fault = dict(fault or {})
+        fault["seed"] = args.fault_seed
     run = run_tcp if args.transport == "tcp" else run_inproc
     try:
         results = run(spec, x, y, fault=fault,
@@ -431,6 +498,199 @@ def cmd_protocol_scan(args):
     print(json.dumps(out, indent=2))
     if not ok:
         sys.exit(1)
+
+
+def cmd_chaos(args):
+    """Deterministic step-kill sweep (docs/ROBUSTNESS.md): per (family,
+    victim role, crash point) case, run the two-party protocol as two
+    real TCP processes with journals, kill the victim at the named
+    point (exit 42), restart it with the identical command line, and
+    assert the finished session is bit-identical to an uninterrupted
+    in-process reference with each role's ε spent exactly once."""
+    import subprocess
+    import tempfile
+
+    from dpcorr import chaos
+    from dpcorr.obs import read_events
+    from dpcorr.protocol import ProtocolSpec, run_inproc
+    from dpcorr.protocol.scan import ledger_balance, scan_transcript
+
+    points = (args.points.split(",") if args.points
+              else list(chaos.MATRIX_POINTS))
+    roles = args.roles.split(",") if args.roles else ["x", "y"]
+    families = (args.families.split(",") if args.families
+                else [args.family])
+    if args.chaos_seed is not None:
+        plan = chaos.plan_from_seed(args.chaos_seed)
+        points, roles = [plan.point], [plan.role]
+    workdir = args.workdir or tempfile.mkdtemp(prefix="dpcorr-chaos-")
+    os.makedirs(workdir, exist_ok=True)
+    # the restarted victim must NOT re-arm the kill it is recovering from
+    env = {k: v for k, v in os.environ.items() if k != "DPCORR_CHAOS"}
+
+    def spec_for(family: str) -> "ProtocolSpec":
+        return ProtocolSpec(family=family, n=args.n, eps1=args.eps1,
+                            eps2=args.eps2, alpha=args.alpha,
+                            normalise=args.normalise == "on",
+                            seed=args.seed, noise_mode=args.noise_mode)
+
+    # the oracle every crashed run must match bit-for-bit: one clean
+    # uninterrupted run per family, same spec, same synthetic columns
+    refs = {}
+    for family in families:
+        spec = spec_for(family)
+        cx, cy = _party_columns(args, spec.n)
+        refs[family] = run_inproc(spec, cx, cy)["x"]
+
+    def party_argv(family: str, role: str, port: int,
+                   case_dir: str) -> list[str]:
+        return [sys.executable, "-m", "dpcorr", "party",
+                "--role", role, "--host", "127.0.0.1",
+                "--port", str(port),
+                "--family", family, "--n", str(args.n),
+                "--eps1", str(args.eps1), "--eps2", str(args.eps2),
+                "--alpha", str(args.alpha), "--normalise", args.normalise,
+                "--seed", str(args.seed), "--noise-mode", args.noise_mode,
+                "--rho", str(args.rho),
+                "--timeout", str(args.timeout),
+                "--max-retries", str(max(args.max_retries, 40)),
+                "--connect-timeout", str(args.case_timeout),
+                "--recv-timeout", str(args.case_timeout),
+                "--journal", os.path.join(case_dir, f"journal.{role}.json"),
+                "--ledger", os.path.join(case_dir, f"ledger.{role}.json"),
+                "--audit", os.path.join(case_dir, f"audit.{role}.jsonl"),
+                "--transcript",
+                os.path.join(case_dir, f"transcript.{role}.jsonl")]
+
+    def launch(argv: list[str], case_dir: str, role: str):
+        errlog = open(os.path.join(case_dir, f"{role}.stderr.log"), "ab")
+        return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=errlog, env=env, text=True)
+
+    def parse_result(text: str) -> dict:
+        """Drop single-line ``{"party": ...}`` banners; parse the
+        multi-line ``{"result": ...}`` document that follows."""
+        lines = [ln for ln in text.splitlines() if ln.strip()]
+        while lines:
+            try:
+                obj = json.loads(lines[0])
+            except json.JSONDecodeError:
+                break
+            if isinstance(obj, dict) and "party" in obj:
+                lines.pop(0)
+            else:
+                break
+        return json.loads("\n".join(lines))["result"]
+
+    reports = []
+    failures = []
+    for family in families:
+        for role in roles:
+            for point in points:
+                case = f"{family}.{role}.{point}"
+                case_dir = os.path.join(workdir,
+                                        case.replace(".", "_"))
+                os.makedirs(case_dir, exist_ok=True)
+                errs = _run_chaos_case(
+                    args, family, role, point, case_dir, refs[family],
+                    spec_for(family), party_argv, launch, parse_result,
+                    ledger_balance, scan_transcript, read_events,
+                    chaos.EXIT_CODE)
+                reports.append({"case": case, "ok": not errs,
+                                "errors": errs, "dir": case_dir})
+                failures.extend(f"{case}: {e}" for e in errs)
+    print(json.dumps({"workdir": workdir, "cases": reports,
+                      "ok": not failures}, indent=2))
+    if failures:
+        sys.exit(1)
+
+
+def _run_chaos_case(args, family, role, point, case_dir, ref, spec,
+                    party_argv, launch, parse_result, ledger_balance,
+                    scan_transcript, read_events, exit_code) -> list[str]:
+    """One (family, victim role, point) case; returns error strings."""
+    import subprocess
+
+    # seed-derived sweeps pass the seed form through: the victim
+    # re-derives the identical (point, role) and — unlike the concrete
+    # point= form — keeps the seed on the plan, so the transcript
+    # header records the provenance the run is reproducible from
+    if getattr(args, "chaos_seed", None) is not None:
+        chaos_spec = f"seed={args.chaos_seed}"
+    else:
+        chaos_spec = f"point={point},hit=1,mode=exit"
+    timeout = args.case_timeout
+    procs = {}
+    try:
+        y_argv = party_argv(family, "y", 0, case_dir)
+        procs["y"] = launch(
+            y_argv + (["--chaos", chaos_spec] if role == "y" else []),
+            case_dir, "y")
+        banner = json.loads(procs["y"].stdout.readline())
+        port = int(banner["party"]["listening"][1])
+        x_argv = party_argv(family, "x", port, case_dir)
+        procs["x"] = launch(
+            x_argv + (["--chaos", chaos_spec] if role == "x" else []),
+            case_dir, "x")
+        victim = procs[role]
+        try:
+            rc = victim.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return [f"victim {role} did not crash at {point} within "
+                    f"{timeout:.0f}s"]
+        victim.stdout.read()  # drain the dead pipe
+        if rc != exit_code:
+            return [f"victim {role} exited {rc}, expected the chaos "
+                    f"kill code {exit_code}"]
+        # restart: the identical command line, minus the kill plan
+        # (y rebinds its concrete port — port 0 was only for discovery)
+        restart_argv = (party_argv(family, "y", port, case_dir)
+                        if role == "y" else x_argv)
+        procs[role] = launch(restart_argv, case_dir, role)
+        out, results = {}, {}
+        for r in ("x", "y"):
+            try:
+                rc = procs[r].wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                return [f"party {r} hung after restart (>{timeout:.0f}s)"]
+            out[r] = procs[r].stdout.read()
+            if rc != 0:
+                return [f"party {r} exited {rc} after restart; see "
+                        f"{case_dir}/{r}.stderr.log"]
+            results[r] = parse_result(out[r])
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+    errs = []
+    for r in ("x", "y"):
+        got = results[r]
+        if (got["rho_hat"] != ref.rho_hat or got["ci_low"] != ref.ci_low
+                or got["ci_high"] != ref.ci_high):
+            errs.append(
+                f"role {r} result {got['rho_hat']!r} diverged from the "
+                f"uninterrupted reference {ref.rho_hat!r}")
+        transcript = os.path.join(case_dir, f"transcript.{r}.jsonl")
+        rep = scan_transcript(transcript)
+        if not rep["ok"]:
+            errs.append(f"role {r} transcript scan: {rep['violations']}")
+        bal = ledger_balance(
+            transcript,
+            read_events(os.path.join(case_dir, f"audit.{r}.jsonl")))
+        if not bal["ok"]:
+            errs.append(f"role {r} ledger balance: "
+                        f"sends {bal['unmatched_sends']} "
+                        f"charges {bal['unmatched_charges']}")
+        with open(os.path.join(case_dir, f"ledger.{r}.json")) as fh:
+            spent = json.load(fh)["spent"]
+        for party_name, eps in spec.charges_for(r).items():
+            if abs(spent.get(party_name, 0.0) - eps) > 1e-9:
+                errs.append(
+                    f"role {r} spent {spent.get(party_name, 0.0)!r} for "
+                    f"{party_name}, expected exactly one charge of "
+                    f"{eps!r}")
+    return errs
 
 
 def cmd_lint(args):
@@ -625,6 +885,21 @@ def main(argv=None):
                           "the wire, so both parties' logs join")
     pp_.add_argument("--audit", default=None,
                      help="budget audit-trail JSONL path (obs.audit)")
+    pp_.add_argument("--journal", default=None,
+                     help="session journal path (JSON): makes the "
+                          "session crash-safe — rerun the identical "
+                          "command after a crash and it resumes instead "
+                          "of restarting (docs/ROBUSTNESS.md)")
+    pp_.add_argument("--chaos", default=None,
+                     help="crash plan 'point=NAME[,hit=K][,mode=exit|"
+                          "raise]' or 'seed=N' (dpcorr.chaos); default: "
+                          "$DPCORR_CHAOS. The plan is recorded in the "
+                          "transcript header")
+    pp_.add_argument("--recv-timeout", dest="recv_timeout", type=float,
+                     default=30.0,
+                     help="seconds to wait for the peer's next protocol "
+                          "message (raise it when the peer may be "
+                          "restarting mid-session)")
     _add_spec_flags(pp_)
     pp_.set_defaults(fn=cmd_party)
 
@@ -648,6 +923,11 @@ def main(argv=None):
     prr.add_argument("--fault-duplicate", dest="fault_duplicate",
                      type=float, default=0.0,
                      help="fault injection: duplicate rate")
+    prr.add_argument("--fault-seed", dest="fault_seed", type=int,
+                     default=None,
+                     help="base seed for both sides' fault injectors "
+                          "(stamped into the transcript headers); "
+                          "default: the fixed per-side seeds")
     _add_spec_flags(prr)
     prr.set_defaults(fn=cmd_protocol_run)
     prs = pr_sub.add_parser("scan", help="audit a party transcript: "
@@ -661,6 +941,37 @@ def main(argv=None):
                      help="that party's audit-trail JSONL; enables the "
                           "ε balance check")
     prs.set_defaults(fn=cmd_protocol_scan, platform=None, jax_free=True)
+
+    pc_ = sub.add_parser("chaos", help="deterministic step-kill sweep: "
+                         "two party processes over real TCP, kill the "
+                         "victim at each named crash point, restart it, "
+                         "assert bit-identical results and exactly-once "
+                         "ε spend (docs/ROBUSTNESS.md)")
+    pc_.add_argument("--points", default=None,
+                     help="comma list of crash points (default: the "
+                          "standard matrix, dpcorr.chaos.MATRIX_POINTS)")
+    pc_.add_argument("--roles", default=None,
+                     help="comma list of victim roles from {x,y} "
+                          "(default: both)")
+    pc_.add_argument("--families", default=None,
+                     help="comma list of estimator families to sweep "
+                          "(default: just --family)")
+    pc_.add_argument("--workdir", default=None,
+                     help="artifact directory — per-case journals, "
+                          "ledgers, audits, transcripts, stderr logs "
+                          "(default: a fresh temp dir; keep it for CI "
+                          "artifact upload)")
+    pc_.add_argument("--chaos-seed", dest="chaos_seed", type=int,
+                     default=None,
+                     help="derive one (point, victim) case from a seed "
+                          "(dpcorr.chaos.plan_from_seed) instead of "
+                          "sweeping")
+    pc_.add_argument("--case-timeout", dest="case_timeout", type=float,
+                     default=180.0,
+                     help="per-process wait bound within one case "
+                          "(seconds)")
+    _add_spec_flags(pc_)
+    pc_.set_defaults(fn=cmd_chaos)
 
     backends_by_cmd = {
         "grid": ("local", "sharded", "bucketed", "bucketed-sharded"),

@@ -1,0 +1,232 @@
+"""Deterministic crash-point chaos: named kill sites, seeded plans.
+
+PR 5's fault injector perturbs the *wire* (drop/delay/duplicate); this
+module injects the harder failure class — the process dies at a chosen
+instruction boundary. Recovery code is only trustworthy if every crash
+window it claims to survive is actually exercised, so the windows are
+named: code that has a durability boundary calls
+``chaos.point("gate.post_charge")`` at the boundary, and a *plan*
+(installed from the CLI, the ``DPCORR_CHAOS`` env var, or a test) kills
+the process on a chosen traversal of a chosen point.
+
+Design constraints, in order:
+
+- **Deterministic and reproducible.** A plan is fully described by
+  ``(point, hit, mode)`` or by a single integer seed that derives them
+  (stdlib ``random.Random`` over the static :data:`MATRIX_POINTS`
+  list — the jax key tree is never touched, so chaos can never perturb
+  estimator noise). The party runtime records the active plan in its
+  transcript header; re-running with that seed reproduces the same
+  crash at the same step.
+- **Honest kills.** The default mode ``exit`` is ``os._exit(42)`` — no
+  ``finally`` blocks, no atexit, no flushes — the closest a test can
+  get to SIGKILL from inside the victim. Mode ``raise`` throws
+  :class:`SimulatedCrash` (a ``BaseException``, so transport-failure
+  handlers like the gate's refund path do NOT treat it as a delivery
+  failure) for fast in-process resume tests.
+- **Near-zero cost when off.** ``point()`` is one global ``is None``
+  check when no plan is installed — it is called from the ledger's
+  charge path and the coalescer's flush loop.
+
+jax-free and import-light on purpose: the ledger, gate, party and
+coalescer all import this module, including under jax-free CLI paths.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+#: Exit status a chaos kill dies with — the restart driver asserts on
+#: it so an ordinary crash (bug, OOM) is never mistaken for the plan.
+EXIT_CODE = 42
+
+#: Every registered crash point. Static, ordered, and append-only by
+#: convention: seed-derived plans index into this list, so reordering
+#: would silently change what historical seeds reproduce.
+KNOWN_POINTS = (
+    # protocol session (party.py / gate.py / journal consumers)
+    "party.post_handshake",   # handshake done, nothing journaled yet
+    "journal.post_prepare",   # outbound slot durable, not charged/sent
+    "gate.post_charge",       # eps durably charged, release not sent
+    "gate.post_send",         # release acked, journal not marked
+    "party.post_gated",       # journal marked acked, transcript pending
+    # ledger durability windows (serve/ledger.py; also traversed by the
+    # protocol parties — the gate charges the same ledger)
+    "ledger.pre_persist",     # spend mutated in memory, file untouched
+    "ledger.post_persist",    # spend on disk, audit event not written
+    # serve flush pipeline (serve/coalescer.py)
+    "coalescer.pre_flush",    # batch popped, kernel not dispatched
+    "coalescer.post_flush",   # responses resolved, stats published
+)
+
+#: The step-kill matrix `dpcorr chaos` sweeps: the points every protocol
+#: role traverses exactly once per session (the ledger windows fire
+#: inside the role's own gated charge). The coalescer points are serve-
+#: side and are exercised by the serve/ledger crash tests instead.
+MATRIX_POINTS = (
+    "party.post_handshake",
+    "journal.post_prepare",
+    "gate.post_charge",
+    "ledger.post_persist",
+    "gate.post_send",
+    "party.post_gated",
+)
+
+_MODES = ("exit", "raise")
+_KNOWN = frozenset(KNOWN_POINTS)
+
+
+class SimulatedCrash(BaseException):
+    """An in-process stand-in for a kill at a chaos point.
+
+    Deliberately a ``BaseException``: recovery handlers catch concrete
+    failure types (``TransportError`` → refund, ``Exception`` →
+    degrade), and a simulated *crash* must sail through all of them
+    exactly like ``os._exit`` would — a refund fired by a pretend kill
+    would test a code path no real crash takes.
+    """
+
+    def __init__(self, point: str):
+        self.point = point
+        super().__init__(f"simulated crash at chaos point {point!r}")
+
+
+class ChaosPlan:
+    """One planned kill: die on the ``hit``-th traversal of ``point``.
+
+    ``role`` is driver metadata (which party process receives the plan);
+    ``thread_name`` scopes an in-process plan to one victim thread so
+    the surviving party thread in a two-threads-one-process test sails
+    past the same point untouched. ``seed`` records how the plan was
+    derived, for the transcript header.
+    """
+
+    def __init__(self, point: str, hit: int = 1, mode: str = "exit",
+                 role: str | None = None, seed: int | None = None,
+                 thread_name: str | None = None):
+        if point not in _KNOWN:
+            raise ValueError(f"unknown chaos point {point!r}; "
+                             f"registered: {KNOWN_POINTS}")
+        if hit < 1:
+            raise ValueError(f"hit must be >= 1, got {hit}")
+        if mode not in _MODES:
+            raise ValueError(f"mode must be one of {_MODES}, got {mode!r}")
+        self.point = point
+        self.hit = int(hit)
+        self.mode = mode
+        self.role = role
+        self.seed = seed
+        self.thread_name = thread_name
+
+    def to_dict(self) -> dict:
+        """Transcript-header form — everything needed to reproduce."""
+        out = {"point": self.point, "hit": self.hit, "mode": self.mode}
+        if self.role is not None:
+            out["role"] = self.role
+        if self.seed is not None:
+            out["seed"] = self.seed
+        return out
+
+    def to_spec(self) -> str:
+        """The ``--chaos``/``DPCORR_CHAOS`` string form of this plan."""
+        parts = [f"point={self.point}", f"hit={self.hit}",
+                 f"mode={self.mode}"]
+        if self.role is not None:
+            parts.append(f"role={self.role}")
+        return ",".join(parts)
+
+
+def plan_from_seed(seed: int, mode: str = "exit") -> ChaosPlan:
+    """Derive a matrix kill deterministically from one integer: which
+    point, which traversal (always the first — each matrix point fires
+    once per session) and which role is the victim. stdlib RNG over the
+    static matrix, so the same seed reproduces the same kill forever."""
+    r = random.Random(int(seed))
+    point = r.choice(MATRIX_POINTS)
+    role = r.choice(("x", "y"))
+    return ChaosPlan(point, hit=1, mode=mode, role=role, seed=int(seed))
+
+
+def plan_from_spec(spec: str) -> ChaosPlan:
+    """Parse ``"point=gate.post_charge,hit=1,mode=exit"`` or
+    ``"seed=123"`` (seed-derived matrix kill)."""
+    fields: dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad chaos spec field {part!r} "
+                             "(want key=value)")
+        k, v = part.split("=", 1)
+        fields[k.strip()] = v.strip()
+    if "seed" in fields:
+        plan = plan_from_seed(int(fields["seed"]),
+                              mode=fields.get("mode", "exit"))
+        if "role" in fields:
+            plan.role = fields["role"]
+        return plan
+    if "point" not in fields:
+        raise ValueError(f"chaos spec {spec!r} names neither point= "
+                         "nor seed=")
+    return ChaosPlan(fields["point"], hit=int(fields.get("hit", "1")),
+                     mode=fields.get("mode", "exit"),
+                     role=fields.get("role"))
+
+
+def plan_from_env(env: str = "DPCORR_CHAOS") -> ChaosPlan | None:
+    """The subprocess hook: a victim process started with
+    ``DPCORR_CHAOS=point=...,hit=...`` installs its own kill."""
+    spec = os.environ.get(env)
+    return plan_from_spec(spec) if spec else None
+
+
+_lock = threading.Lock()
+_plan: ChaosPlan | None = None
+_counts: dict[str, int] = {}
+
+
+def install(plan: ChaosPlan | None) -> None:
+    """Arm ``plan`` process-wide (traversal counters reset). ``None``
+    disarms — same as :func:`clear`."""
+    global _plan
+    with _lock:
+        _plan = plan
+        _counts.clear()
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> ChaosPlan | None:
+    return _plan
+
+
+def point(name: str) -> None:
+    """Declare one crash window. No-op unless the armed plan names this
+    point (and this thread, for thread-scoped plans); on the planned
+    traversal the process dies (``exit``) or :class:`SimulatedCrash`
+    propagates (``raise``)."""
+    plan = _plan
+    if plan is None:
+        return
+    if name not in _KNOWN:
+        raise ValueError(f"unregistered chaos point {name!r}; add it to "
+                         "chaos.KNOWN_POINTS")
+    if plan.point != name:
+        return
+    if plan.thread_name is not None \
+            and threading.current_thread().name != plan.thread_name:
+        return
+    with _lock:
+        if _plan is not plan:  # disarmed while we raced here
+            return
+        _counts[name] = _counts.get(name, 0) + 1
+        if _counts[name] != plan.hit:
+            return
+    if plan.mode == "exit":
+        os._exit(EXIT_CODE)
+    raise SimulatedCrash(name)

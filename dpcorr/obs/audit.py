@@ -108,12 +108,39 @@ def read_events(path: str) -> list[dict]:
     return events
 
 
+def _dedup_walk(events: Iterable[dict]):
+    """Yield ``(kind, charges)`` applying the ledger's charge_id
+    idempotency chronologically: the first charge carrying a given id
+    spends it — even a ``dedup``-flagged one, which is how a re-charge
+    event repairs a trail whose original charge line was lost to a
+    crash between ledger persist and audit append — and every later
+    charge with that id spends nothing. A refund forgets the id, so a
+    *later* charge may legitimately reuse it. Events without a
+    charge_id always apply (pre-idempotency trails and serve-path
+    charges)."""
+    applied: set = set()
+    for ev in events:
+        kind, cid = ev["kind"], ev.get("charge_id")
+        if kind == "charge" and cid is not None:
+            if cid in applied:
+                yield ev, False
+                continue
+            applied.add(cid)
+        elif kind == "refund" and cid is not None:
+            applied.discard(cid)
+        yield ev, True
+
+
 def replay(events: Iterable[dict]) -> dict[str, float]:
     """Fold events into the per-party spend table using the ledger's
-    own arithmetic (refunds clamp at zero; refusals spend nothing).
-    The acceptance check: replay(trail) == ledger snapshot."""
+    own arithmetic (refunds clamp at zero; refusals spend nothing;
+    charge_id-deduplicated charges spend once no matter how many times
+    a resumed session re-ran them). The acceptance check:
+    replay(trail) == ledger snapshot."""
     spent: dict[str, float] = {}
-    for ev in events:
+    for ev, applies in _dedup_walk(events):
+        if not applies:
+            continue
         if ev["kind"] == "charge":
             for p, e in ev["charges"].items():
                 spent[p] = spent.get(p, 0.0) + float(e)
@@ -129,12 +156,12 @@ def timeline(events: Iterable[dict], party: str | None = None) -> list[dict]:
     timeline ``python -m dpcorr obs budget`` prints."""
     spent: dict[str, float] = {}
     rows = []
-    for ev in events:
+    for ev, applies in _dedup_walk(events):
         touched = {}
         for p, e in ev["charges"].items():
-            if ev["kind"] == "charge":
+            if applies and ev["kind"] == "charge":
                 spent[p] = spent.get(p, 0.0) + float(e)
-            elif ev["kind"] == "refund":
+            elif applies and ev["kind"] == "refund":
                 spent[p] = max(0.0, spent.get(p, 0.0) - float(e))
             touched[p] = spent.get(p, 0.0)
         if party is not None and party not in ev["charges"]:

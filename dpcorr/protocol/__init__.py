@@ -41,6 +41,8 @@ docs/PROTOCOL.md for roles, the message table and failure semantics.
 # estimators can't. An eager star-import here would weld them together.
 _EXPORTS = {
     "ReleaseGate": "gate",
+    "JournalError": "journal",
+    "SessionJournal": "journal",
     "PROTOCOL_VERSION": "messages",
     "Message": "messages",
     "Transcript": "messages",
@@ -48,6 +50,7 @@ _EXPORTS = {
     "decode_array": "messages",
     "encode_array": "messages",
     "read_transcript": "messages",
+    "read_transcript_meta": "messages",
     "Party": "party",
     "ProtocolError": "party",
     "ProtocolRefused": "party",
@@ -59,8 +62,10 @@ _EXPORTS = {
     "scan_transcript": "scan",
     "FaultInjector": "transport",
     "InProcTransport": "transport",
+    "ReconnectingTcpLink": "transport",
     "ReliableChannel": "transport",
     "TransportError": "transport",
+    "TransportTimeout": "transport",
     "tcp_connect": "transport",
     "tcp_listen": "transport",
 }

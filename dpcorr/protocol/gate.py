@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from typing import Mapping
 
+from dpcorr import chaos
 from dpcorr.protocol.transport import ReliableChannel, TransportError
 from dpcorr.serve.ledger import PrivacyLedger
 
@@ -43,17 +44,44 @@ class ReleaseGate:
 
     def send_release(self, channel: ReliableChannel, body: dict,
                      charges: Mapping[str, float],
-                     trace_id: str | None = None) -> dict:
+                     trace_id: str | None = None,
+                     charge_id: str | None = None,
+                     seq: int | None = None) -> dict:
         """Charge, then send; returns the channel receipt augmented
         with the total ε charged (for the transcript's ``eps`` column).
 
         Raises ``BudgetExceededError`` (nothing sent, nothing spent)
-        or ``TransportError`` (charge refunded)."""
-        self.ledger.charge(charges, trace_id=trace_id)
+        or ``TransportError`` (charge refunded).
+
+        ``charge_id`` makes the charge leg idempotent (a crash-resumed
+        session re-runs this whole sequence; the ledger spends the id
+        once) and ``seq`` pins a journal-replayed send to its original
+        wire sequence. Both default off, preserving the pre-journal
+        call shape — including for channel test doubles that only
+        implement ``send(body)``."""
+        self.ledger.charge(charges, trace_id=trace_id, charge_id=charge_id)
+        chaos.point("gate.post_charge")
         try:
-            receipt = channel.send(body)
+            if seq is None:
+                receipt = channel.send(body)
+            else:
+                receipt = channel.send(body, seq=seq)
         except TransportError:
-            self.ledger.refund(charges, trace_id=trace_id)
+            self.ledger.refund(charges, trace_id=trace_id,
+                               charge_id=charge_id)
             raise
+        chaos.point("gate.post_send")
         receipt["eps"] = float(sum(charges.values()))
         return receipt
+
+    def charge_replayed(self, charges: Mapping[str, float],
+                        trace_id: str | None = None,
+                        charge_id: str | None = None) -> None:
+        """The charge leg alone, for journal-replay slots whose
+        delivery is already established (the peer finished and left —
+        party.py peer-gone path): the ε must still land exactly once,
+        which the idempotent ``charge_id`` guarantees, but there is no
+        wire send to pair it with and no failure that could justify a
+        refund."""
+        self.ledger.charge(charges, trace_id=trace_id,
+                           charge_id=charge_id)

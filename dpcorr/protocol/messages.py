@@ -165,16 +165,37 @@ class Transcript:
 
     def record(self, direction: str, msg: Message, seq: int,
                n_bytes: int, retries: int = 0, latency_s: float = 0.0,
-               eps: float = 0.0) -> None:
+               eps: float = 0.0, charge_id: str | None = None,
+               replayed: bool = False) -> None:
         if not self.enabled:
             return
-        line = json.dumps({
+        entry = {
             "ts": time.time(), "dir": direction, "seq": seq,
             "type": msg.msg_type, "bytes": n_bytes, "retries": retries,
             "latency_s": latency_s, "eps": eps,
             "trace_id": msg.headers.get("trace_id"),
             "wire": msg.to_wire(),
-        }, sort_keys=True)
+        }
+        # resume-only columns stay absent on the normal path so a
+        # crash-free transcript is byte-shaped exactly as before
+        if charge_id is not None:
+            entry["charge_id"] = charge_id
+        if replayed:
+            entry["replayed"] = True
+        line = json.dumps(entry, sort_keys=True)
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+
+    def meta(self, **fields) -> None:
+        """Append a non-message header line ``{"ts", "meta": {...}}`` —
+        fault seeds, chaos plans, resume markers. Meta lines make every
+        chaos run reproducible from the artifact alone; readers of the
+        message stream (:func:`read_transcript`) skip them."""
+        if not self.enabled or not fields:
+            return
+        line = json.dumps({"ts": time.time(), "meta": fields},
+                          sort_keys=True)
         with self._lock:
             if self._fh is not None:
                 self._fh.write(line + "\n")
@@ -187,8 +208,10 @@ class Transcript:
 
 
 def read_transcript(path: str) -> list[dict]:
-    """Load a transcript; raises ValueError naming the first bad line
-    (the audit must fail loudly on a corrupt log, not skip lines)."""
+    """Load a transcript's *message* lines (meta header lines are
+    skipped — they carry no wire traffic); raises ValueError naming the
+    first bad line (the audit must fail loudly on a corrupt log, not
+    skip lines)."""
     entries = []
     with open(path) as f:
         for i, line in enumerate(f, 1):
@@ -200,8 +223,30 @@ def read_transcript(path: str) -> list[dict]:
             except json.JSONDecodeError as e:
                 raise ValueError(
                     f"{path}:{i}: bad transcript line: {e}") from e
+            if isinstance(obj, dict) and "meta" in obj and "dir" not in obj:
+                continue
             if not isinstance(obj, dict) or "dir" not in obj \
                     or "wire" not in obj:
                 raise ValueError(f"{path}:{i}: not a transcript entry")
             entries.append(obj)
     return entries
+
+
+def read_transcript_meta(path: str) -> dict:
+    """Merge all meta header lines of a transcript (later lines win on
+    key collision). The reproducibility contract: the fault seed and
+    chaos plan a run was executed under are recoverable from here."""
+    merged: dict = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and isinstance(obj.get("meta"), dict) \
+                    and "dir" not in obj:
+                merged.update(obj["meta"])
+    return merged

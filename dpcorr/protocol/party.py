@@ -38,8 +38,10 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from dpcorr import chaos
 from dpcorr.obs import from_wire_headers, tracer, wire_headers
 from dpcorr.protocol.gate import ReleaseGate
+from dpcorr.protocol.journal import SessionJournal
 from dpcorr.protocol.messages import (
     Message,
     Transcript,
@@ -47,7 +49,11 @@ from dpcorr.protocol.messages import (
     decode_array,
     encode_array,
 )
-from dpcorr.protocol.transport import ReliableChannel, TransportError
+from dpcorr.protocol.transport import (
+    ReliableChannel,
+    SessionResumeRefused,
+    TransportError,
+)
 from dpcorr.serve.ledger import (
     BudgetExceededError,
     PrivacyLedger,
@@ -147,12 +153,24 @@ class Party:
     releases) and is never serialized. ``ledger`` is wrapped in the
     release gate immediately; the party itself keeps no direct
     reference.
+
+    With ``journal`` (a :class:`SessionJournal`), the session is
+    crash-safe: every outbound message is journaled before it is sent
+    (outbound slot *k* ↔ wire seq *k+1*), every inbound message is
+    journaled before it is acked, the gated charge carries a
+    deterministic ``charge_id`` so the ledger spends it once across
+    restarts, and a restarted party replays its journal — re-sending
+    journaled wire bytes verbatim under their original seqs — until it
+    rejoins the live session exactly where it died. Without a journal
+    nothing changes, down to the wire bytes (the determinism test
+    byte-compares transcripts).
     """
 
     def __init__(self, role: str, column, spec: ProtocolSpec,
                  channel: ReliableChannel, ledger: PrivacyLedger,
                  transcript: Transcript | None = None,
-                 recv_timeout_s: float = 30.0):
+                 recv_timeout_s: float = 30.0,
+                 journal: SessionJournal | None = None):
         if role not in ("x", "y"):
             raise ValueError(f"role must be 'x' or 'y', got {role!r}")
         col = np.asarray(column, dtype=np.float32)
@@ -166,7 +184,13 @@ class Party:
         self._gate = ReleaseGate(ledger)
         self.transcript = transcript or Transcript(None)
         self.recv_timeout_s = recv_timeout_s
+        self.journal = journal
         self._span = None
+        self._resumed = False
+        self._peer_gone = False  # resume went unanswered: peer finished
+        self._out_slot = 0   # next outbound journal slot
+        self._in_slot = 0    # next inbound journal slot
+        self._replay_in = 0  # inbound slots below this replay from journal
 
     # ------------------------------------------------------- plumbing ----
     def _headers(self) -> dict:
@@ -177,55 +201,157 @@ class Party:
         return self._span.trace_id if self._span is not None else None
 
     def _record(self, direction: str, msg: Message, receipt: dict,
-                eps: float = 0.0) -> None:
+                eps: float = 0.0, charge_id: str | None = None,
+                replayed: bool = False) -> None:
         self.transcript.record(
             direction, msg, seq=receipt.get("seq", -1),
             n_bytes=receipt.get("bytes", len(msg.encode())),
             retries=receipt.get("retries", 0),
-            latency_s=receipt.get("latency_s", 0.0), eps=eps)
+            latency_s=receipt.get("latency_s", 0.0), eps=eps,
+            charge_id=charge_id, replayed=replayed)
+
+    def _journal_outbound(self, msg: Message, charges=None,
+                          charge_id=None) -> dict:
+        """Claim the next outbound slot and journal the wire dict under
+        it — durably, before anything irreversible happens. On a resume
+        the slot may already exist, in which case the *journaled* entry
+        wins wholesale: replaying recomputed bytes would diverge from
+        what the peer may have already acked."""
+        slot = self._out_slot
+        self._out_slot += 1
+        entry = self.journal.outbound_entry(slot)
+        if entry is None:
+            entry = self.journal.prepare_outbound(
+                slot, msg.to_wire(), charges=charges, charge_id=charge_id)
+            chaos.point("journal.post_prepare")
+        return entry
 
     def _send_plain(self, msg: Message) -> None:
         """Ungated send — only for messages that carry no DP release
         (hello/hello_ack/error; the lint rule keys on this split)."""
-        receipt = self.channel.send(msg.to_wire())
-        self._record("send", msg, receipt)
+        if self.journal is None:
+            receipt = self.channel.send(msg.to_wire())
+            self._record("send", msg, receipt)
+            return
+        entry = self._journal_outbound(msg)
+        wire_msg = Message.from_wire(entry["wire"])
+        if entry["acked"]:
+            # delivered before the crash; keep the transcript complete
+            self._record("send", wire_msg, {"seq": entry["seq"]},
+                         replayed=True)
+            return
+        if self._peer_gone:
+            # peer completed without us: this frame was necessarily
+            # delivered (see _attach_journal) — record, don't resend
+            self.journal.mark_acked(entry["slot"])
+            self._record("send", wire_msg, {"seq": entry["seq"]},
+                         replayed=True)
+            return
+        receipt = self.channel.send(entry["wire"], seq=entry["seq"])
+        self.journal.mark_acked(entry["slot"])
+        self._record("send", wire_msg, receipt)
 
     def _linger(self) -> None:
         """Drain the channel after receiving the session's final
         message — but only when loss is actually possible (fault
-        injection active, or retransmissions already happened): a clean
-        queue/TCP link never drops an ack, and the idle window would
-        otherwise tax every clean session's latency for nothing."""
-        if self.channel.fault is not None or self.channel.total_retries:
+        injection active, retransmissions already happened, this is
+        a crash-resumed session whose peer may still be retransmitting
+        into the gap the restart left, or we just acknowledged a
+        *peer's* re-attach and its journal replay is about to arrive):
+        a clean queue/TCP link never drops an ack, and the idle window
+        would otherwise tax every clean session's latency for
+        nothing."""
+        if self.channel.fault is not None or self.channel.total_retries \
+                or self._resumed or self.channel.peer_resumed:
             self.channel.drain()
 
     def _send_best_effort(self, msg: Message) -> None:
         """Abort notification: the peer may already be gone (its own
         abort crossed ours, or chaos ate the session) — a delivery
-        failure here must not mask the refusal we are about to raise."""
+        failure here must not mask the refusal we are about to raise.
+        Deliberately unjournaled: aborts are terminal, there is no
+        resume that would replay one."""
         try:
-            self._send_plain(msg)
+            receipt = self.channel.send(msg.to_wire())
+            self._record("send", msg, receipt)
         except TransportError:
             pass
 
     def _send_gated(self, msg: Message) -> None:
         """Charge this role's ε, then send; refund handled inside the
         gate. On refusal, signal the peer with an ungated ``error`` so
-        it stops waiting, then raise :class:`ProtocolRefused`."""
+        it stops waiting, then raise :class:`ProtocolRefused`.
+
+        Journaled sessions make the whole sequence crash-repeatable:
+        the slot (wire + charges + a deterministic charge_id) is
+        durable before the charge, the charge is idempotent under that
+        id, the send is pinned to the journaled seq (the peer's dedupe
+        absorbs a pre-crash delivery), and a slot already marked acked
+        skips straight to the transcript — ε spent exactly once no
+        matter where in this function the process last died."""
         charges = self.spec.charges_for(self.role)
+        if self.journal is None:
+            try:
+                receipt = self._gate.send_release(
+                    self.channel, msg.to_wire(), charges,
+                    trace_id=self._trace_id())
+            except BudgetExceededError as e:
+                abort = self._msg("error", {
+                    "kind": "budget", "reason": str(e), "party": e.party})
+                self._send_best_effort(abort)
+                raise ProtocolRefused(str(e)) from e
+            self._record("send", msg, receipt, eps=receipt["eps"])
+            return
+        cid = f"{self.spec.session}:{self.role}:out{self._out_slot}"
+        entry = self._journal_outbound(msg, charges=charges, charge_id=cid)
+        cid = entry["charge_id"]
+        wire_msg = Message.from_wire(entry["wire"])
+        entry_charges = entry["charges"] or charges
+        if entry["acked"]:
+            self._record("send", wire_msg, {"seq": entry["seq"]},
+                         eps=float(sum(entry_charges.values())),
+                         charge_id=cid, replayed=True)
+            return
+        if self._peer_gone:
+            # The peer finished and left before our journal saw this
+            # slot acked — but it cannot have completed without the
+            # release, so delivery happened at the channel level and
+            # only the local bookkeeping is behind. Land the
+            # (idempotent) charge, skip the wire, and mark the slot so
+            # a further restart replays it identically. Refunding here
+            # would double-credit a consumed release.
+            self._gate.charge_replayed(entry_charges,
+                                       trace_id=self._trace_id(),
+                                       charge_id=cid)
+            self.journal.mark_acked(entry["slot"])
+            self._record("send", wire_msg, {"seq": entry["seq"]},
+                         eps=float(sum(entry_charges.values())),
+                         charge_id=cid, replayed=True)
+            return
         try:
             receipt = self._gate.send_release(
-                self.channel, msg.to_wire(), charges,
-                trace_id=self._trace_id())
+                self.channel, entry["wire"], entry_charges,
+                trace_id=self._trace_id(), charge_id=cid,
+                seq=entry["seq"])
         except BudgetExceededError as e:
             abort = self._msg("error", {
                 "kind": "budget", "reason": str(e), "party": e.party})
             self._send_best_effort(abort)
             raise ProtocolRefused(str(e)) from e
-        self._record("send", msg, receipt, eps=receipt["eps"])
+        self.journal.mark_acked(entry["slot"])
+        chaos.point("party.post_gated")
+        self._record("send", wire_msg, receipt, eps=receipt["eps"],
+                     charge_id=cid)
 
     def _recv(self, *expect: str) -> Message:
-        got = self.channel.recv(timeout_s=self.recv_timeout_s)
+        if self.journal is not None and self._in_slot < self._replay_in:
+            # journaled before the crash; the channel pre-marked its seq
+            # delivered, so the live link will re-ack but never re-queue
+            got = dict(self.journal.inbound_entry(self._in_slot))
+            self._in_slot += 1
+        else:
+            got = self.channel.recv(timeout_s=self.recv_timeout_s)
+            self._in_slot += 1
         msg = Message.from_wire(got["body"])
         self._record("recv", msg, {"seq": got["seq"]})
         if msg.session != self.spec.session:
@@ -252,17 +378,45 @@ class Party:
                        headers=self._headers())
 
     # ------------------------------------------------------ handshake ----
+    def _register_session_info(self) -> None:
+        """Tell the channel which (session, token) a peer's resume
+        handshake must present — the surviving side answers resumes
+        from whatever loop it is blocked in."""
+        token = self.journal.resume_token if self.journal else None
+        if token:
+            self.channel.session_info = {"session": self.spec.session,
+                                         "token": token}
+
     def _handshake(self) -> None:
         """X proposes (opening the trace root), Y verifies the spec
         hash and parents its root span on the proposal's context —
-        from here both processes share one trace ID."""
+        from here both processes share one trace ID.
+
+        Journaled sessions thread two extra facts through the same two
+        messages: X mints a resume token into the hello (journal-gated,
+        so unjournaled sessions keep byte-identical wire traffic), and
+        a restarted X pins its root span to the journaled trace ID so
+        the resumed half of the session joins the original trace. Y
+        needs no special casing — its root span parents on the hello
+        headers, which a resume replays verbatim from the journal."""
         if self.role == "x":
-            self._span = tracer().start_span(
-                "protocol.session", role=self.role,
-                family=self.spec.family, session=self.spec.session)
-            hello = self._msg("hello", {
-                "spec": self.spec.to_public(),
-                "spec_hash": self.spec.spec_hash()})
+            if self.journal is not None and self.journal.trace_id:
+                self._span = tracer().start_span(
+                    "protocol.session", trace_id=self.journal.trace_id,
+                    role=self.role, family=self.spec.family,
+                    session=self.spec.session, resumed=True)
+            else:
+                self._span = tracer().start_span(
+                    "protocol.session", role=self.role,
+                    family=self.spec.family, session=self.spec.session)
+                if self.journal is not None and self._span.trace_id:
+                    self.journal.set_trace(self._span.trace_id)
+            payload = {"spec": self.spec.to_public(),
+                       "spec_hash": self.spec.spec_hash()}
+            if self.journal is not None:
+                payload["resume_token"] = self.journal.ensure_token()
+                self._register_session_info()
+            hello = self._msg("hello", payload)
             self._send_plain(hello)
             self._recv("hello_ack")
         else:
@@ -271,6 +425,13 @@ class Party:
                 "protocol.session", parent=from_wire_headers(first.headers),
                 role=self.role, family=self.spec.family,
                 session=self.spec.session)
+            if self.journal is not None:
+                token = first.payload.get("resume_token")
+                if token:
+                    self.journal.adopt_token(token)
+                    self._register_session_info()
+                if self._span.trace_id:
+                    self.journal.set_trace(self._span.trace_id)
             theirs = first.payload.get("spec_hash")
             if theirs != self.spec.spec_hash():
                 refusal = self._msg("error", {
@@ -383,12 +544,59 @@ class Party:
             out["fault"] = ch.fault.stats()
         return out
 
+    def _attach_journal(self) -> None:
+        """Bind the journal to this session and reload channel state.
+
+        The resume re-attach handshake runs only when there is evidence
+        the *peer* already knows this session (something of ours was
+        acked, or something of theirs journaled): before that point the
+        peer is still parked in its opening recv and a resume frame
+        would go unanswered — the plain journal replay alone is
+        sufficient and correct there."""
+        j = self.journal
+        s = self.spec
+        self._resumed = j.begin(s.session, self.role, s.spec_hash())
+        self._replay_in = len(j.inbound)
+        self.channel.on_deliver = j.record_inbound
+        self.channel.restore(send_seq=len(j.outbound),
+                             delivered=j.delivered_seqs())
+        self._register_session_info()
+        token = j.resume_token
+        peer_knows_us = bool(j.inbound) \
+            or any(e["acked"] for e in j.outbound)
+        if self._resumed and token and peer_knows_us:
+            budget = max(10.0 * self.channel.timeout_s, 5.0)
+            try:
+                self.channel.resume(s.session, token,
+                                    max_wait_s=budget)
+            except SessionResumeRefused:
+                raise  # wrong session/token — never a peer-gone case
+            except TransportError:
+                # Unanswered: the peer finished and left. Single-crash
+                # soundness: it cannot have completed without every
+                # release we journaled — the channel acks a frame only
+                # after journaling it, and the peer's final recv could
+                # not have returned otherwise — so delivery of our
+                # unacked slots already happened and replay can finish
+                # from the journal alone (_send_gated/_send_plain skip
+                # the wire when this flag is set). A dual-crash that
+                # violates the premise fails loudly via recv timeout.
+                self._peer_gone = True
+
     def run(self) -> ProtocolResult:
-        """Execute this role's side of the session to completion."""
+        """Execute this role's side of the session to completion. A
+        journaled session that already finished returns its journaled
+        result without touching the wire or the ledger — the terminal
+        idempotency level."""
         from dpcorr.models.estimators import split_reference as sr
 
         s = self.spec
+        if self.journal is not None:
+            if self.journal.status == "finished" and self.journal.result:
+                return ProtocolResult(**self.journal.result)
+            self._attach_journal()
         self._handshake()
+        chaos.point("party.post_handshake")
         releaser, _ = sr.split_roles(s.family, s.eps1, s.eps2)
         try:
             if self.role == releaser:
@@ -399,4 +607,11 @@ class Party:
             if self._span is not None:
                 self._span.end()
             self.transcript.close()
+        if self.journal is not None:
+            self.journal.set_result({
+                "role": result.role, "session": result.session,
+                "rho_hat": result.rho_hat, "ci_low": result.ci_low,
+                "ci_high": result.ci_high, "trace_id": result.trace_id,
+                "stats": result.stats})
+            self.journal.finish()
         return result

@@ -33,11 +33,17 @@ from dpcorr.serve.ledger import PrivacyLedger
 DEFAULT_BUDGET = 1e6
 
 
-def _mk_fault(fault: dict | None, seed: int) -> FaultInjector | None:
+def _mk_fault(fault: dict | None, default_seed: int) -> FaultInjector | None:
     """Build one side's injector from a shared fault spec; each side
-    gets a distinct stdlib-RNG seed so their chaos is independent."""
+    gets a distinct stdlib-RNG seed so their chaos is independent. A
+    caller-supplied base seed (``fault["seed"]``, the CLI's
+    ``--fault-seed``) is folded with the per-side default so one knob
+    reproduces *both* sides' fault sequences."""
     if not fault:
         return None
+    base = fault.get("seed")
+    seed = default_seed if base is None \
+        else int(base) * 1000003 + default_seed
     return FaultInjector(drop=fault.get("drop", 0.0),
                          delay_s=fault.get("delay_s", 0.0),
                          duplicate=fault.get("duplicate", 0.0),
@@ -89,17 +95,24 @@ def _make_parties(spec: ProtocolSpec, x, y, link_x, link_y,
     chan_x = ReliableChannel(link_x, timeout_s=timeout_s,
                              max_retries=max_retries,
                              backoff_max_s=backoff_max,
-                             fault=_mk_fault(fault, seed=11))
+                             fault=_mk_fault(fault, default_seed=11))
     chan_y = ReliableChannel(link_y, timeout_s=timeout_s,
                              max_retries=max_retries,
                              backoff_max_s=backoff_max,
-                             fault=_mk_fault(fault, seed=23))
+                             fault=_mk_fault(fault, default_seed=23))
     ledger_x = ledger_x or PrivacyLedger(DEFAULT_BUDGET)
     ledger_y = ledger_y or PrivacyLedger(DEFAULT_BUDGET)
-    px = Party("x", x, spec, chan_x, ledger_x,
-               transcript=_transcript(transcript_dir, spec, "x"))
-    py = Party("y", y, spec, chan_y, ledger_y,
-               transcript=_transcript(transcript_dir, spec, "y"))
+    tx = _transcript(transcript_dir, spec, "x")
+    ty = _transcript(transcript_dir, spec, "y")
+    if fault:
+        # reproducibility-from-the-artifact: a chaos failure's fault
+        # config (seed included) is in the transcript header itself
+        header = {"fault": {k: v for k, v in fault.items()},
+                  "session": spec.session}
+        tx.meta(**header)
+        ty.meta(**header)
+    px = Party("x", x, spec, chan_x, ledger_x, transcript=tx)
+    py = Party("y", y, spec, chan_y, ledger_y, transcript=ty)
     return px, py
 
 

@@ -134,6 +134,7 @@ def scan_transcript(transcript, spec: dict | None = None,
 
     releases = 0
     gated_eps = 0.0
+    seen_charge_ids: set = set()
     for idx, entry in enumerate(entries):
         w = entry["wire"]
         if w.get("version") != PROTOCOL_VERSION:
@@ -153,7 +154,14 @@ def scan_transcript(transcript, spec: dict | None = None,
             continue
         releases += 1
         if entry.get("dir") == "send":
-            gated_eps += float(entry.get("eps", 0.0))
+            # a crash-resumed session may log the same gated send twice
+            # (original + journal-replayed line); its charge_id is the
+            # collapse key — ε was spent once, count it once
+            cid = entry.get("charge_id")
+            if cid is None or cid not in seen_charge_ids:
+                gated_eps += float(entry.get("eps", 0.0))
+                if cid is not None:
+                    seen_charge_ids.add(cid)
         if schema is None:
             _violation(viol, idx, "no-spec",
                        "release before any hello spec; cannot validate")
@@ -196,39 +204,78 @@ def scan_transcript(transcript, spec: dict | None = None,
 def ledger_balance(transcript, audit_events: list[dict]) -> dict:
     """Match every gated send in the transcript to exactly one durable
     ``charge`` event and vice versa (same trace ID, same total ε), and
-    compare per-party replay totals. Refunded charges (a refund event
-    with the same trace) are excluded from the expected set — their
-    release never counted. Returns ``{"ok", "unmatched_sends",
-    "unmatched_charges", "spent"}``."""
+    compare per-party replay totals. Refunded charges are excluded from
+    the expected set — their release never counted. Returns ``{"ok",
+    "unmatched_sends", "unmatched_charges", "spent"}``.
+
+    Crash-resumed sessions balance through the ``charge_id`` lens, the
+    audit walked chronologically exactly like the ledger walked it:
+    only the first charge under a given id spends (later ones are the
+    resumed session's idempotent re-runs — including a ``dedup`` event
+    standing in for an original line lost between ledger persist and
+    audit append); a refund forgets the id so a genuinely new charge
+    may reuse it; transcript send lines sharing a charge_id (an
+    original plus its journal-replayed duplicate) collapse to one."""
     entries = (read_transcript(transcript) if isinstance(transcript, str)
                else list(transcript))
-    sends = [e for e in entries
-             if e.get("dir") == "send" and float(e.get("eps", 0.0)) > 0.0]
-    refunded = {ev.get("trace_id") for ev in audit_events
-                if ev["kind"] == "refund"}
-    charges = [ev for ev in audit_events
-               if ev["kind"] == "charge"
-               and ev.get("trace_id") not in refunded]
+    sends = []
+    seen_cids: set = set()
+    for e in entries:
+        if e.get("dir") != "send" or float(e.get("eps", 0.0)) <= 0.0:
+            continue
+        cid = e.get("charge_id")
+        if cid is not None:
+            if cid in seen_cids:
+                continue
+            seen_cids.add(cid)
+        sends.append(e)
+
+    # chronological effective-charge set, mirroring the ledger's own
+    # idempotency arithmetic (obs.audit._dedup_walk)
+    applied: dict = {}     # charge_id -> its first (spending) event
+    anon: list = []        # charges without an id (legacy / serve path)
+    refunded_tids = set()  # refunds without an id match by trace_id
+    for ev in audit_events:
+        kind, cid = ev["kind"], ev.get("charge_id")
+        if kind == "charge":
+            if cid is not None:
+                applied.setdefault(cid, ev)
+            else:
+                anon.append(ev)
+        elif kind == "refund":
+            if cid is not None:
+                applied.pop(cid, None)
+            else:
+                refunded_tids.add(ev.get("trace_id"))
+    charges = list(applied.values()) + [
+        ev for ev in anon if ev.get("trace_id") not in refunded_tids]
 
     unmatched_sends = []
     pool = list(charges)
     for e in sends:
         eps = float(e.get("eps", 0.0))
         tid = e.get("trace_id")
+        cid = e.get("charge_id")
         hit = None
         for ev in pool:
-            if ev.get("trace_id") == tid \
+            if cid is not None:
+                if ev.get("charge_id") == cid \
+                        and abs(sum(ev["charges"].values()) - eps) < 1e-9:
+                    hit = ev
+                    break
+            elif ev.get("trace_id") == tid \
                     and abs(sum(ev["charges"].values()) - eps) < 1e-9:
                 hit = ev
                 break
         if hit is None:
             unmatched_sends.append({"seq": e.get("seq"), "eps": eps,
-                                    "trace_id": tid})
+                                    "trace_id": tid, "charge_id": cid})
         else:
             pool.remove(hit)
     unmatched_charges = [{"seq": ev.get("seq"),
                           "eps": sum(ev["charges"].values()),
-                          "trace_id": ev.get("trace_id")}
+                          "trace_id": ev.get("trace_id"),
+                          "charge_id": ev.get("charge_id")}
                          for ev in pool]
     return {
         "ok": not unmatched_sends and not unmatched_charges,

@@ -41,6 +41,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from dpcorr import chaos
 from dpcorr.obs import trace as obs_trace
 from dpcorr.serve.kernels import KernelCache
 from dpcorr.serve.request import (
@@ -175,6 +176,11 @@ class Coalescer:
         that served it; the physical launch itself is one
         ``serve.kernel`` span (dispatch through fetch barrier) under
         the first rider's flush span, carrying the batch size."""
+        # crash points bracketing the launch: pre_flush models a crash
+        # after charge but before any kernel ran (budget wasted, nothing
+        # leaked — server module docstring), post_flush one after the
+        # answers landed but before the client read them
+        chaos.point("coalescer.pre_flush")
         by_kernel: dict[tuple, list[_Pending]] = {}
         for p in group:
             by_kernel.setdefault(kernel_key(p.req), []).append(p)
@@ -224,6 +230,7 @@ class Coalescer:
                 p.span.set(latency_s=lat, batch_size=len(ps),
                            batched=batched)
                 p.span.end()
+        chaos.point("coalescer.post_flush")
 
     def _dispatch(self, kkey, ps: list[_Pending]):
         """Launch one exact-n subgroup asynchronously (no fetch)."""

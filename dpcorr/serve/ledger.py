@@ -51,11 +51,23 @@ import os
 import threading
 from typing import Mapping
 
+from dpcorr import chaos
 from dpcorr.obs.audit import AuditTrail
 from dpcorr.obs.metrics import Registry
 from dpcorr.serve.request import EstimateRequest
 
 _STATE_VERSION = 1
+
+# Idempotency memory: how many distinct charge_ids the ledger remembers
+# (FIFO). Far above any live session's outstanding charges — the bound
+# only exists so a long-lived server's snapshot cannot grow unboundedly.
+_CHARGE_ID_CAP = 4096
+
+
+class LedgerCorruptError(ValueError):
+    """The persisted ledger snapshot could not be parsed. The bad file
+    has been quarantined to a ``.corrupt`` sidecar; the message says
+    exactly what to do next."""
 
 
 class BudgetExceededError(Exception):
@@ -123,6 +135,9 @@ class PrivacyLedger:
         self.audit = audit
         self._lock = threading.Lock()
         self._spent: dict[str, float] = {}  # guarded by: _lock
+        # insertion-ordered set of applied charge_ids (dict keys) — what
+        # makes a resumed session's re-charge a no-op
+        self._charge_ids: dict[str, None] = {}  # guarded by: _lock
         self._events = self._spent_gauge = None
         if registry is not None:
             self._events = registry.counter(
@@ -132,16 +147,51 @@ class PrivacyLedger:
                 "dpcorr_ledger_spent_eps",
                 "Cumulative per-party eps spend under basic composition",
                 labelnames=("party",))
+        if path:
+            self._sweep_stale_tmp(path)
         if path and os.path.exists(path):
-            with open(path) as f:
-                state = json.load(f)
+            try:
+                with open(path) as f:
+                    state = json.load(f)
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                quarantine = path + ".corrupt"
+                os.replace(path, quarantine)
+                raise LedgerCorruptError(
+                    f"ledger snapshot {path!r} is corrupt ({e}); the bad "
+                    f"file was moved to {quarantine!r}. To recover, "
+                    "rebuild the spend table from the audit trail "
+                    "(`python -m dpcorr obs budget --audit <trail>`) and "
+                    "restart, or delete the sidecar to start from zero "
+                    "spend (spends budget-safety: never do this in "
+                    "production without the audit replay).") from e
             if state.get("version") != _STATE_VERSION:
                 raise ValueError(
                     f"ledger state {path!r} has version "
                     f"{state.get('version')!r}, expected {_STATE_VERSION}")
             self._spent = {str(k): float(v)
                            for k, v in state["spent"].items()}
+            # absent in pre-idempotency snapshots — same version, additive
+            self._charge_ids = {str(c): None
+                                for c in state.get("charge_ids", [])}
             self._publish_locked()
+
+    @staticmethod
+    def _sweep_stale_tmp(path: str) -> None:
+        """Remove ``{path}.tmp.*`` crash artifacts: a tmp file that was
+        never renamed belongs to a write that never committed, and a
+        dead writer will never finish it."""
+        d = os.path.dirname(path) or "."
+        prefix = os.path.basename(path) + ".tmp."
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith(prefix):
+                try:
+                    os.unlink(os.path.join(d, name))
+                except OSError:
+                    pass
 
     def _publish_locked(self) -> None:
         """Mirror the spend table into the per-party gauge (caller holds
@@ -162,7 +212,8 @@ class PrivacyLedger:
             return self.budget_for(party) - self._spent.get(party, 0.0)
 
     def charge(self, charges: Mapping[str, float],
-               trace_id: str | None = None) -> None:
+               trace_id: str | None = None,
+               charge_id: str | None = None) -> None:
         """Atomically spend ``{party: ε}`` across all named parties.
 
         All-or-nothing: if any party would exceed its budget the whole
@@ -171,11 +222,27 @@ class PrivacyLedger:
         success the new state is durably persisted before returning.
         ``trace_id`` stamps the audit event so a budget decision joins
         the request's span chain.
+
+        ``charge_id`` makes the charge idempotent: a charge whose id the
+        persisted snapshot already contains is a no-op (recorded as a
+        deduped audit event, spending nothing). This is how a resumed
+        protocol session re-runs its charge-then-send sequence without
+        double-spending — the ledger and the session journal are two
+        separate durable stores that cannot commit atomically, so the
+        charge itself must be safely repeatable. A later ``refund`` with
+        the same id forgets it, so a genuinely new charge can reuse it.
         """
         for party, eps in charges.items():
             if eps < 0.0:
                 raise ValueError(f"negative charge {eps} for {party!r}")
         with self._lock:
+            if charge_id is not None and charge_id in self._charge_ids:
+                if self._events is not None:
+                    self._events.inc(kind="dedup")
+                if self.audit is not None:
+                    self.audit.record("charge", charges, trace_id=trace_id,
+                                      charge_id=charge_id, dedup=True)
+                return
             for party, eps in charges.items():
                 spent = self._spent.get(party, 0.0)
                 # strict >: a charge landing exactly on the budget is
@@ -192,14 +259,22 @@ class PrivacyLedger:
                                               self.budget_for(party))
             for party, eps in charges.items():
                 self._spent[party] = self._spent.get(party, 0.0) + eps
+            if charge_id is not None:
+                self._charge_ids[charge_id] = None
+                while len(self._charge_ids) > _CHARGE_ID_CAP:
+                    self._charge_ids.pop(next(iter(self._charge_ids)))
+            chaos.point("ledger.pre_persist")
             self._persist_locked()
+            chaos.point("ledger.post_persist")
             # observers fire only after the spend is durably on disk —
             # a crash here under-reports the audit view, never the budget
             if self._events is not None:
                 self._events.inc(kind="charge")
             self._publish_locked()
             if self.audit is not None:
-                self.audit.record("charge", charges, trace_id=trace_id)
+                detail = {} if charge_id is None else {"charge_id": charge_id}
+                self.audit.record("charge", charges, trace_id=trace_id,
+                                  **detail)
 
     def charge_request(self, req: EstimateRequest,
                        trace_id: str | None = None) -> dict[str, float]:
@@ -209,7 +284,8 @@ class PrivacyLedger:
         return charges
 
     def refund(self, charges: Mapping[str, float],
-               trace_id: str | None = None) -> None:
+               trace_id: str | None = None,
+               charge_id: str | None = None) -> None:
         """Reverse a charge whose query provably never executed.
 
         Only valid when no kernel ran and nothing was released under
@@ -227,12 +303,18 @@ class PrivacyLedger:
             for party, eps in charges.items():
                 self._spent[party] = max(
                     0.0, self._spent.get(party, 0.0) - eps)
+            # the id is forgotten so a genuinely new attempt may charge
+            # under it again — refund means "that charge never happened"
+            if charge_id is not None:
+                self._charge_ids.pop(charge_id, None)
             self._persist_locked()
             if self._events is not None:
                 self._events.inc(kind="refund")
             self._publish_locked()
             if self.audit is not None:
-                self.audit.record("refund", charges, trace_id=trace_id)
+                detail = {} if charge_id is None else {"charge_id": charge_id}
+                self.audit.record("refund", charges, trace_id=trace_id,
+                                  **detail)
 
     def snapshot(self) -> dict:
         """Point-in-time accounting view (the stats endpoint's shape)."""
@@ -251,7 +333,8 @@ class PrivacyLedger:
         intact and a completed charge is never lost."""
         if not self.path:
             return
-        state = {"version": _STATE_VERSION, "spent": self._spent}
+        state = {"version": _STATE_VERSION, "spent": self._spent,
+                 "charge_ids": list(self._charge_ids)}
         tmp = f"{self.path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(state, f)

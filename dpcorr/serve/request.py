@@ -81,11 +81,22 @@ class EstimateRequest:
     alpha: float = 0.05
     normalise: bool = True
     seed: int | None = None
+    #: client retry token: two submissions with the same key are the
+    #: same logical request — the second returns the first's response
+    #: without a second ledger charge or noise draw (server idempotency
+    #: cache). Pinned-seed requests get a content-derived default key,
+    #: so a dropped-response retry is always safe without client
+    #: bookkeeping.
+    idempotency_key: str | None = None
 
     def __post_init__(self):
         if self.family not in FAMILIES:
             raise ValueError(f"unknown estimator family {self.family!r}; "
                              f"expected one of {FAMILIES}")
+        if self.idempotency_key is not None \
+                and not isinstance(self.idempotency_key, str):
+            raise ValueError("idempotency_key must be a string or None, "
+                             f"got {type(self.idempotency_key).__name__}")
         x = np.asarray(self.x, dtype=np.float32)
         y = np.asarray(self.y, dtype=np.float32)
         if x.ndim != 1 or y.ndim != 1 or x.shape != y.shape:

@@ -50,6 +50,7 @@ import json
 import logging
 import secrets
 import threading
+from collections import OrderedDict
 from concurrent.futures import Future
 
 import numpy as np
@@ -68,12 +69,12 @@ from dpcorr.utils import rng
 log = logging.getLogger("dpcorr.serve")
 
 
-def request_digest_words(req: EstimateRequest) -> tuple[int, ...]:
-    """The request's kernel inputs as eight 31-bit ``fold_in`` words —
-    a 248-bit SHA-256 content binding, far past birthday range for any
-    realistic query volume. Everything the noise touches is digested
-    (family, ε, α, normalise, the data vectors); party names are not,
-    as they only route budget accounting."""
+def request_digest(req: EstimateRequest) -> bytes:
+    """SHA-256 over the request's kernel inputs — everything the noise
+    touches is digested (family, ε, α, normalise, the data vectors);
+    party names are not, as they only route budget accounting. Feeds
+    both the pinned-key derivation words and the default idempotency
+    key, so "same content" means the same thing in both places."""
     h = hashlib.sha256()
     h.update(req.family.encode())
     h.update(np.asarray([req.eps1, req.eps2, req.alpha],
@@ -81,7 +82,14 @@ def request_digest_words(req: EstimateRequest) -> tuple[int, ...]:
     h.update(b"\x01" if req.normalise else b"\x00")
     h.update(req.x.tobytes())
     h.update(req.y.tobytes())
-    d = h.digest()
+    return h.digest()
+
+
+def request_digest_words(req: EstimateRequest) -> tuple[int, ...]:
+    """The request digest as eight 31-bit ``fold_in`` words — a 248-bit
+    content binding, far past birthday range for any realistic query
+    volume."""
+    d = request_digest(req)
     return tuple(int.from_bytes(d[4 * i:4 * i + 4], "big") & 0x7FFFFFFF
                  for i in range(8))
 
@@ -114,7 +122,8 @@ class DpcorrServer:
                  warmup: str | list | None = None,
                  warmup_manifest: str | None = None,
                  aot: bool = True, export_dir: str | None = None,
-                 warmup_autostart: bool = True):
+                 warmup_autostart: bool = True,
+                 max_idempotency_cache: int = 1024):
         self.seed = seed
         # obs wiring (ISSUE 2): one tracer spans the request lifecycle
         # (admit → charge → enqueue → flush → respond; default is the
@@ -145,6 +154,18 @@ class DpcorrServer:
         # (module docstring — the ledger persists, the counter must not
         # need to)
         self._boot_nonce = secrets.randbits(31)
+        # -- idempotency (ISSUE 7) ----------------------------------------
+        # a retried request (client timeout, dropped response) must not
+        # charge ε or draw noise twice: completed responses are cached
+        # under the request's idempotency key and replayed verbatim;
+        # duplicates of a still-running request attach to its future.
+        # Failures are never cached — a retry after a refusal genuinely
+        # re-runs.
+        self._idem_cap = max(int(max_idempotency_cache), 0)
+        self._idem_lock = threading.Lock()
+        self._idem_done: OrderedDict[str, EstimateResponse] = \
+            OrderedDict()  # guarded by: _idem_lock
+        self._idem_inflight: dict[str, Future] = {}  # guarded by: _idem_lock
         # -- warmup / readiness (ISSUE 4; serve.warmup) -------------------
         # signature sources: explicit spec (CLI --warmup) + the previous
         # boot's manifest, merged and deduplicated. An empty set means
@@ -234,11 +255,90 @@ class DpcorrServer:
             rng.design_key(rng.stream(master, "serve/boot"),
                            self._boot_nonce), seed)
 
+    # -- idempotency -----------------------------------------------------
+    def _idem_key(self, req: EstimateRequest) -> str | None:
+        """The request's retry identity. Explicit key wins; pinned-seed
+        requests default to their content digest (the same bytes the
+        noise stream is bound to, so "same key" implies "same answer")
+        plus the charged party names — the digest itself excludes them
+        (they only route budget), but two submissions billing different
+        parties are different ledger operations and must not dedupe;
+        assigned-stream requests have no stable identity to key on —
+        every submission is a fresh draw by design."""
+        if req.idempotency_key is not None:
+            return req.idempotency_key
+        if req.seed is not None:
+            h = hashlib.sha256(request_digest(req))
+            for party in (req.party_x, req.party_y):
+                raw = party.encode()
+                h.update(len(raw).to_bytes(4, "big"))
+                h.update(raw)
+            return f"pinned:{req.seed}:{h.hexdigest()}"
+        return None
+
+    def _idem_complete(self, idem: str, fut: Future) -> None:
+        """Done-callback for the original submission: publish success
+        into the completed cache (bounded, LRU eviction) and resolve
+        the shared placeholder every duplicate is holding."""
+        err = fut.exception()
+        with self._idem_lock:
+            placeholder = self._idem_inflight.pop(idem, None)
+            if err is None:
+                self._idem_done[idem] = fut.result()
+                self._idem_done.move_to_end(idem)
+                while len(self._idem_done) > self._idem_cap:
+                    self._idem_done.popitem(last=False)
+        if placeholder is not None:
+            # resolve outside the lock: waiter callbacks run inline
+            if err is None:
+                placeholder.set_result(fut.result())
+            else:
+                placeholder.set_exception(err)
+
     # -- API -------------------------------------------------------------
     def submit(self, req: EstimateRequest) -> Future:
         """Admit one request: charge the ledger (may raise
         BudgetExceededError), then enqueue (may raise
         ServerOverloadedError). Returns a Future[EstimateResponse].
+
+        Idempotency runs first: a key that already completed returns
+        the ORIGINAL response object (byte-identical on the wire) with
+        no charge, no noise draw and no kernel execution; a key still
+        in flight returns the original's future. The reservation is
+        taken BEFORE the charge so a concurrent duplicate can never
+        race past the cache into a second spend."""
+        idem = self._idem_key(req)
+        if idem is not None and self._idem_cap > 0:
+            with self._idem_lock:
+                done = self._idem_done.get(idem)
+                if done is not None:
+                    self._idem_done.move_to_end(idem)
+                    self.stats.idempotent_hit("completed")
+                    fut: Future = Future()
+                    fut.set_result(done)
+                    return fut
+                running = self._idem_inflight.get(idem)
+                if running is not None:
+                    self.stats.idempotent_hit("inflight")
+                    return running
+                placeholder: Future = Future()
+                self._idem_inflight[idem] = placeholder
+            try:
+                inner = self._admit(req)
+            except BaseException as e:
+                # refused admissions are not cached (a retry genuinely
+                # re-runs), but duplicates already attached must fail too
+                with self._idem_lock:
+                    self._idem_inflight.pop(idem, None)
+                placeholder.set_exception(e)
+                raise
+            inner.add_done_callback(
+                lambda f, k=idem: self._idem_complete(k, f))
+            return placeholder
+        return self._admit(req)
+
+    def _admit(self, req: EstimateRequest) -> Future:
+        """Charge + enqueue (the pre-idempotency submit).
 
         The root ``serve.request`` span opens here and closes on the
         flush thread when the response lands; its trace ID stamps the
@@ -339,7 +439,10 @@ def _request_from_json(body: dict) -> EstimateRequest:
             alpha=float(body.get("alpha", 0.05)),
             normalise=bool(body.get("normalise", True)),
             seed=(int(body["seed"]) if body.get("seed") is not None
-                  else None))
+                  else None),
+            idempotency_key=(str(body["idempotency_key"])
+                             if body.get("idempotency_key") is not None
+                             else None))
     except KeyError as e:
         raise ValueError(f"missing required field {e.args[0]!r}") from e
 

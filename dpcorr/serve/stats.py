@@ -97,6 +97,12 @@ class ServeStats:
             "Live compiled kernels held by the LRU-bounded cache")
         self._depth = r.gauge(
             "dpcorr_serve_queue_depth", "Requests pending in the coalescer")
+        self._idem = r.counter(
+            "dpcorr_serve_idempotent_hits_total",
+            "Requests answered from the idempotency cache instead of "
+            "re-executing — 'completed' replays a cached response, "
+            "'inflight' attaches to a duplicate already running",
+            labelnames=("stage",))
         self._latency = r.histogram(
             "dpcorr_serve_latency_seconds",
             "Admission-to-completion request latency",
@@ -157,6 +163,14 @@ class ServeStats:
     def queue_depth(self) -> int:
         return int(self._depth.value())
 
+    @property
+    def idempotent_hits_completed(self) -> int:
+        return int(self._idem.value(stage="completed"))
+
+    @property
+    def idempotent_hits_inflight(self) -> int:
+        return int(self._idem.value(stage="inflight"))
+
     # -- recording -------------------------------------------------------
     def admitted(self) -> None:
         self._requests.inc()
@@ -193,6 +207,12 @@ class ServeStats:
 
     def set_queue_depth(self, depth: int) -> None:
         self._depth.set(depth)
+
+    def idempotent_hit(self, stage: str) -> None:
+        """A duplicate submission short-circuited — ``stage`` is
+        ``"completed"`` (cached response replayed) or ``"inflight"``
+        (attached to the original's future)."""
+        self._idem.inc(stage=stage)
 
     def set_kernel_cache_size(self, n: int) -> None:
         """Gauge: live compiled kernels held by the LRU-bounded cache
@@ -241,6 +261,8 @@ class ServeStats:
             "kernel_cache_size": self.kernel_cache_size,
             "queue_depth": self.queue_depth,
             "latency_s": lat,
+            "idempotent_hits_completed": self.idempotent_hits_completed,
+            "idempotent_hits_inflight": self.idempotent_hits_inflight,
             # additive (the pre-ISSUE-2 keys above are a stable shape):
             # the bucketed view behind the /metrics histogram series
             "latency_histogram": self._latency.snapshot(),

@@ -1,0 +1,492 @@
+"""Crash-safety tests (ISSUE 7): the deterministic crash-point chaos
+harness, durable protocol resume, ledger kill-recovery, and the
+transport-level robustness satellites.
+
+The in-process matrix uses raise-mode chaos plans scoped to the victim
+thread: the ``SimulatedCrash`` unwinds one party exactly like a process
+death (its journal, ledger file and transcript survive; its in-memory
+state does not), then a fresh Party on the same journal resumes the
+live session. The subprocess tests use exit-mode plans (``os._exit``)
+for genuine process kills; the full TCP step-kill sweep is the slow
+test and the ``dpcorr chaos`` CI job.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dpcorr import chaos
+from dpcorr.chaos import ChaosPlan, SimulatedCrash
+from dpcorr.obs.audit import AuditTrail, read_events, replay
+from dpcorr.protocol import (
+    FaultInjector,
+    InProcTransport,
+    JournalError,
+    ProtocolSpec,
+    ReliableChannel,
+    SessionJournal,
+    TransportError,
+    ledger_balance,
+    run_inproc,
+    scan_transcript,
+)
+from dpcorr.protocol.messages import Transcript
+from dpcorr.protocol.party import Party
+from dpcorr.protocol.transport import tcp_accept, tcp_connect, tcp_listen
+from dpcorr.serve.ledger import LedgerCorruptError, PrivacyLedger
+
+FAMILIES = ("ni_sign", "int_sign", "ni_subg", "int_subg")
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    """Every test starts and ends with no chaos plan armed."""
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _columns(n=512, rho=0.6, seed=99):
+    r = np.random.default_rng(seed)
+    xy = r.multivariate_normal([0.0, 0.0], [[1.0, rho], [rho, 1.0]],
+                               size=n)
+    return (np.asarray(xy[:, 0], np.float32),
+            np.asarray(xy[:, 1], np.float32))
+
+
+def _bits(res):
+    return (res.rho_hat, res.ci_low, res.ci_high)
+
+
+# ------------------------------------------------------- chaos plans ----
+def test_plan_from_spec_fields():
+    p = chaos.plan_from_spec("point=gate.post_charge,hit=3,mode=raise")
+    assert (p.point, p.hit, p.mode) == ("gate.post_charge", 3, "raise")
+    assert chaos.plan_from_spec("point=ledger.pre_persist").hit == 1
+
+
+def test_plan_from_spec_rejects_unknown_point():
+    with pytest.raises(ValueError):
+        chaos.plan_from_spec("point=not.a.point")
+
+
+def test_plan_from_seed_is_deterministic():
+    a, b = chaos.plan_from_seed(123), chaos.plan_from_seed(123)
+    assert a.to_dict() == b.to_dict()
+    assert a.point in chaos.MATRIX_POINTS
+    assert a.role in ("x", "y")
+    assert a.seed == 123
+    # the recorded spec reconstructs the same concrete plan (transcript
+    # replay); the seed itself is provenance, not part of the spec form
+    again = chaos.plan_from_spec(a.to_spec())
+    redo = {k: v for k, v in again.to_dict().items() if k != "seed"}
+    orig = {k: v for k, v in a.to_dict().items() if k != "seed"}
+    assert redo == orig
+    # the seed FORM of the spec re-derives the identical plan AND keeps
+    # the seed — the chaos driver hands this form to a seed-derived
+    # victim so its transcript header records the provenance
+    seeded = chaos.plan_from_spec("seed=123")
+    assert seeded.to_dict() == a.to_dict()
+    assert seeded.to_dict()["seed"] == 123
+
+
+def test_plan_from_env(monkeypatch):
+    monkeypatch.setenv("DPCORR_CHAOS", "point=gate.post_send,hit=2")
+    p = chaos.plan_from_env()
+    assert (p.point, p.hit) == ("gate.post_send", 2)
+    monkeypatch.delenv("DPCORR_CHAOS")
+    assert chaos.plan_from_env() is None
+
+
+def test_point_counts_hits_and_trips():
+    chaos.install(ChaosPlan(point="gate.post_charge", hit=2,
+                            mode="raise"))
+    chaos.point("gate.post_charge")        # hit 1: survives
+    chaos.point("gate.post_send")          # different point: ignored
+    with pytest.raises(SimulatedCrash):
+        chaos.point("gate.post_charge")    # hit 2: trips
+    chaos.clear()
+    chaos.point("gate.post_charge")        # no plan: fast no-op
+
+
+def test_point_scoped_to_thread_name():
+    chaos.install(ChaosPlan(point="gate.post_charge", hit=1,
+                            mode="raise", thread_name="victim-thread"))
+    chaos.point("gate.post_charge")  # wrong thread: survives
+    tripped = {}
+
+    def victim():
+        try:
+            chaos.point("gate.post_charge")
+        except SimulatedCrash:
+            tripped["yes"] = True
+
+    t = threading.Thread(target=victim, name="victim-thread")
+    t.start()
+    t.join()
+    assert tripped.get("yes")
+
+
+# --------------------------------------------------- session journal ----
+def test_journal_roundtrip_survives_reload(tmp_path):
+    path = str(tmp_path / "j.json")
+    j = SessionJournal(path)
+    assert j.begin("s1", "x", "hash1") is False  # fresh
+    token = j.ensure_token()
+    j.prepare_outbound(0, {"kind": "msg", "seq": 1}, charges={"a": 1.0},
+                       charge_id="s1:x:out0")
+    j.prepare_outbound(0, {"kind": "msg", "seq": 1})  # idempotent re-prepare
+    j.mark_acked(0)
+    j.record_inbound(1, {"kind": "msg", "seq": 1})
+    j.record_inbound(1, {"ignored": "duplicate"})
+
+    j2 = SessionJournal(path)
+    assert j2.begin("s1", "x", "hash1") is True  # resumed
+    assert j2.resume_token == token
+    assert j2.outbound_entry(0)["acked"] is True
+    assert j2.outbound_entry(0)["charge_id"] == "s1:x:out0"
+    assert j2.delivered_seqs() == {1}
+    assert len(j2.inbound) == 1
+
+
+def test_journal_refuses_mixed_sessions(tmp_path):
+    path = str(tmp_path / "j.json")
+    SessionJournal(path).begin("s1", "x", "hash1")
+    with pytest.raises(JournalError):
+        SessionJournal(path).begin("s2", "x", "hash1")
+    with pytest.raises(JournalError):
+        SessionJournal(path).begin("s1", "x", "other-hash")
+
+
+def test_journal_corrupt_quarantined(tmp_path):
+    path = str(tmp_path / "j.json")
+    with open(path, "w") as f:
+        f.write("{truncated")
+    with pytest.raises(JournalError):
+        SessionJournal(path)
+    assert os.path.exists(path + ".corrupt")
+    assert not os.path.exists(path)
+    # the quarantine unblocks a fresh session at the same path
+    assert SessionJournal(path).begin("s1", "y", "h") is False
+
+
+# ------------------------------------------------- ledger robustness ----
+def test_ledger_corrupt_snapshot_quarantined(tmp_path):
+    path = str(tmp_path / "led.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    (tmp_path / "led.json.tmp.123").write_text("stale half-write")
+    with pytest.raises(LedgerCorruptError) as ei:
+        PrivacyLedger(10.0, path=path)
+    msg = str(ei.value)
+    assert "corrupt" in msg and "obs budget" in msg  # actionable
+    assert os.path.exists(path + ".corrupt")
+    assert not os.path.exists(path)
+    assert not os.path.exists(str(tmp_path / "led.json.tmp.123"))
+    led = PrivacyLedger(10.0, path=path)  # path reusable after quarantine
+    led.charge({"a": 1.0})
+    assert led.spent("a") == 1.0
+
+
+def test_ledger_charge_id_dedup_and_refund_forget(tmp_path):
+    trail = AuditTrail(str(tmp_path / "audit.jsonl"))
+    led = PrivacyLedger(10.0, path=str(tmp_path / "led.json"), audit=trail)
+    led.charge({"a": 2.0}, charge_id="c1")
+    led.charge({"a": 2.0}, charge_id="c1")  # resumed re-run: no-op
+    assert led.spent("a") == 2.0
+    # reload sees the persisted id — dedup survives the crash boundary
+    led2 = PrivacyLedger(10.0, path=str(tmp_path / "led.json"),
+                         audit=trail)
+    led2.charge({"a": 2.0}, charge_id="c1")
+    assert led2.spent("a") == 2.0
+    led2.refund({"a": 2.0}, charge_id="c1")  # forgets the id
+    led2.charge({"a": 2.0}, charge_id="c1")  # genuinely new charge
+    assert led2.spent("a") == 2.0
+    assert replay(trail.events()) == {"a": pytest.approx(2.0)}
+
+
+_KILL_SCRIPT = """\
+import sys
+from dpcorr import chaos
+from dpcorr.obs.audit import AuditTrail
+from dpcorr.serve.ledger import PrivacyLedger
+
+plan = chaos.plan_from_env()
+if plan is not None:
+    chaos.install(plan)
+led = PrivacyLedger(10.0, path=sys.argv[1], audit=AuditTrail(sys.argv[2]))
+led.charge({"a": 1.0}, charge_id="warm")
+led.charge({"a": 2.5}, charge_id="victim")
+print("SURVIVED")
+"""
+
+
+@pytest.mark.parametrize("point,disk_spent", [
+    # killed between spend and persist: disk still shows the pre-crash
+    # state; killed just after persist: disk shows the post-charge
+    # state (its audit line is the one that died) — never in between
+    ("ledger.pre_persist", 1.0),
+    ("ledger.post_persist", 3.5),
+])
+def test_ledger_kill_mid_charge_recovers(tmp_path, point, disk_spent):
+    ledger = str(tmp_path / "led.json")
+    audit = str(tmp_path / "audit.jsonl")
+    env = dict(os.environ,
+               DPCORR_CHAOS=f"point={point},hit=2,mode=exit")
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_SCRIPT, ledger, audit],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == chaos.EXIT_CODE, proc.stderr
+    assert "SURVIVED" not in proc.stdout
+    with open(ledger) as fh:
+        state = json.load(fh)
+    assert state["spent"] == {"a": pytest.approx(disk_spent)}
+    # recovery: reload and re-issue the interrupted charge under its
+    # charge_id — it lands exactly once regardless of where the kill hit
+    led = PrivacyLedger(10.0, path=ledger, audit=AuditTrail(audit))
+    led.charge({"a": 2.5}, charge_id="victim")
+    assert led.spent("a") == pytest.approx(3.5)
+    # the audit replay agrees, even across the persisted-but-unlogged
+    # window (the re-charge's dedup event stands in for the lost line)
+    assert replay(read_events(audit)) == {"a": pytest.approx(3.5)}
+
+
+# ------------------------------------------------ transport satellites ----
+def _free_port() -> int:
+    srv, port = tcp_listen("127.0.0.1", 0)
+    srv.close()
+    return port
+
+
+def test_tcp_connect_retries_until_listener_appears():
+    port = _free_port()
+    got = {}
+
+    def listen_later():
+        time.sleep(0.4)
+        srv, _ = tcp_listen("127.0.0.1", port)
+        got["link"] = tcp_accept(srv, timeout_s=10.0)
+        srv.close()
+
+    t = threading.Thread(target=listen_later)
+    t.start()
+    link = tcp_connect("127.0.0.1", port, timeout_s=15.0)
+    t.join()
+    link.send_bytes(b"hello")
+    assert got["link"].recv_bytes(5.0) == b"hello"
+    link.close()
+    got["link"].close()
+
+
+def test_tcp_connect_refused_error_names_address():
+    port = _free_port()
+    with pytest.raises(TransportError) as ei:
+        tcp_connect("127.0.0.1", port, timeout_s=0.3)
+    assert str(port) in str(ei.value)
+
+
+def test_tcp_link_eof_error_names_peer():
+    srv, port = tcp_listen("127.0.0.1", 0)
+    links = {}
+    t = threading.Thread(
+        target=lambda: links.setdefault("y", tcp_accept(srv, 10.0)))
+    t.start()
+    x = tcp_connect("127.0.0.1", port, timeout_s=10.0)
+    t.join()
+    srv.close()
+    links["y"].close()
+    with pytest.raises(TransportError) as ei:
+        x.recv_bytes(5.0)
+    msg = str(ei.value)
+    assert "closed connection" in msg and str(port) in msg
+    x.close()
+
+
+def test_tcp_link_mid_frame_eof_is_flagged():
+    srv, port = tcp_listen("127.0.0.1", 0)
+    links = {}
+    t = threading.Thread(
+        target=lambda: links.setdefault("y", tcp_accept(srv, 10.0)))
+    t.start()
+    x = tcp_connect("127.0.0.1", port, timeout_s=10.0)
+    t.join()
+    srv.close()
+    # half a length prefix, then death: the reader must call out a
+    # truncated frame, not just "closed"
+    links["y"]._sock.sendall(b"\x00\x00")
+    links["y"].close()
+    with pytest.raises(TransportError) as ei:
+        x.recv_bytes(5.0)
+    assert "mid-frame" in str(ei.value)
+    x.close()
+
+
+def test_reliable_channel_drain_under_duplicate_storm():
+    """Every frame (messages AND acks) duplicated at p=1.0: delivery
+    stays exactly-once and both drains terminate cleanly."""
+    pair = InProcTransport()
+    mk = lambda link, seed: ReliableChannel(  # noqa: E731
+        link, timeout_s=0.05, max_retries=30, backoff_base_s=0.01,
+        backoff_max_s=0.05, fault=FaultInjector(duplicate=1.0, seed=seed))
+    a, b = mk(pair.a, 1), mk(pair.b, 2)
+    got = []
+
+    def receiver():
+        for _ in range(8):
+            got.append(b.recv(timeout_s=30.0)["body"]["i"])
+        b.drain()
+
+    t = threading.Thread(target=receiver)
+    t.start()
+    for i in range(8):
+        a.send({"i": i})
+    a.drain()
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert got == list(range(8))
+
+
+# ------------------------------------------- in-process crash-resume ----
+def _crash_resume(family, victim, point, tmp_path, n=512):
+    """Kill ``victim`` at ``point`` mid-session, resume it from its
+    journal against the still-live survivor, and assert the recovered
+    session is indistinguishable from an uninterrupted one."""
+    x, y = _columns(n)
+    spec = ProtocolSpec(family=family, n=n, eps1=1.0, eps2=0.5,
+                        session=f"cr-{family}-{victim}-{point}")
+    ref = run_inproc(spec, x, y)  # the uninterrupted oracle
+
+    pair = InProcTransport()
+    links = {"x": pair.a, "y": pair.b}
+    cols = {"x": x, "y": y}
+    paths = {
+        r: {"ledger": str(tmp_path / f"ledger-{r}.json"),
+            "journal": str(tmp_path / f"journal-{r}.json"),
+            "audit": str(tmp_path / f"audit-{r}.jsonl"),
+            "transcript": str(tmp_path / f"transcript-{r}.jsonl")}
+        for r in ("x", "y")
+    }
+
+    def mk_party(role):
+        chan = ReliableChannel(links[role], timeout_s=0.1,
+                               max_retries=400, backoff_base_s=0.02,
+                               backoff_max_s=0.1)
+        ledger = PrivacyLedger(100.0, path=paths[role]["ledger"],
+                               audit=AuditTrail(paths[role]["audit"]))
+        return Party(role, cols[role], spec, chan, ledger,
+                     transcript=Transcript(paths[role]["transcript"]),
+                     recv_timeout_s=120.0,
+                     journal=SessionJournal(paths[role]["journal"]))
+
+    results, errors = {}, {}
+
+    def drive(party):
+        try:
+            results[party.role] = party.run()
+        except BaseException as e:  # SimulatedCrash is a BaseException
+            errors[party.role] = e
+
+    survivor = "y" if victim == "x" else "x"
+    chaos.install(ChaosPlan(point=point, hit=1, mode="raise",
+                            thread_name=f"party-{victim}"))
+    t_survivor = threading.Thread(target=drive,
+                                  args=(mk_party(survivor),),
+                                  name=f"party-{survivor}")
+    t_victim = threading.Thread(target=drive, args=(mk_party(victim),),
+                                name=f"party-{victim}")
+    try:
+        t_survivor.start()
+        t_victim.start()
+        t_victim.join(timeout=120)
+        assert not t_victim.is_alive(), f"victim never crashed at {point}"
+        crash = errors.pop(victim, None)
+        assert isinstance(crash, SimulatedCrash), \
+            f"victim died of {crash!r}, expected SimulatedCrash"
+    finally:
+        chaos.clear()
+
+    # the restart: a fresh Party (fresh channel state, ledger reloaded
+    # from disk) on the same journal, same link endpoint
+    t_restart = threading.Thread(target=drive, args=(mk_party(victim),),
+                                 name=f"party-{victim}")
+    t_restart.start()
+    t_survivor.join(timeout=120)
+    t_restart.join(timeout=120)
+    assert not t_survivor.is_alive() and not t_restart.is_alive()
+    assert not errors, errors
+
+    for role in ("x", "y"):
+        assert _bits(results[role]) == _bits(ref[role]), \
+            f"role {role} diverged from the uninterrupted run"
+        rep = scan_transcript(paths[role]["transcript"])
+        assert rep["ok"], rep["violations"]
+        bal = ledger_balance(paths[role]["transcript"],
+                             read_events(paths[role]["audit"]))
+        assert bal["ok"], bal
+        with open(paths[role]["ledger"]) as fh:
+            spent = json.load(fh)["spent"]
+        for party_name, eps in spec.charges_for(role).items():
+            assert spent[party_name] == pytest.approx(eps), \
+                f"role {role} eps not spent exactly once"
+
+
+@pytest.mark.parametrize("victim", ["x", "y"])
+@pytest.mark.parametrize("point", chaos.MATRIX_POINTS)
+def test_crash_resume_matrix_inproc(point, victim, tmp_path):
+    _crash_resume("ni_sign", victim, point, tmp_path)
+
+
+@pytest.mark.parametrize("family", ["int_sign", "ni_subg", "int_subg"])
+def test_crash_resume_other_families_inproc(family, tmp_path):
+    _crash_resume(family, "y", "gate.post_send", tmp_path)
+
+
+# --------------------------------------------------- subprocess / CLI ----
+def test_chaos_cli_single_case_tcp(tmp_path):
+    """One full step-kill case through the real CLI: two TCP party
+    processes, exit-mode kill, restart, bit-identity + balance checks
+    all enforced by the driver itself."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dpcorr", "chaos",
+         "--families", "ni_sign", "--roles", "y",
+         "--points", "gate.post_charge", "--n", "256",
+         "--workdir", str(tmp_path / "chaos"),
+         "--case-timeout", "120"],
+        capture_output=True, text=True, timeout=420,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"] and all(c["ok"] for c in report["cases"])
+    case_dir = report["cases"][0]["dir"]
+    # reproducibility-from-the-artifact: the victim's transcript header
+    # records the armed plan
+    from dpcorr.protocol import read_transcript_meta
+    meta = read_transcript_meta(
+        os.path.join(case_dir, "transcript.y.jsonl"))
+    assert meta["chaos"]["point"] == "gate.post_charge"
+    assert meta["chaos"]["mode"] == "exit"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", FAMILIES)
+def test_chaos_cli_full_matrix_tcp(tmp_path, family):
+    """ISSUE 7 acceptance: every matrix crash point × both roles over
+    real TCP, per estimator family — bit-identical results, balanced
+    ledgers, clean transcripts."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dpcorr", "chaos",
+         "--families", family, "--n", "256",
+         "--workdir", str(tmp_path / "chaos"),
+         "--case-timeout", "180"],
+        capture_output=True, text=True, timeout=3600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"]
+    assert len(report["cases"]) == 2 * len(chaos.MATRIX_POINTS)

@@ -385,7 +385,9 @@ def test_server_concurrent_load_coalesces_and_bit_matches():
 
 def test_server_refuses_over_budget_first_query():
     """The first query that would overdraw is refused; earlier ones all
-    admitted — the acceptance criterion, at the server boundary."""
+    admitted — the acceptance criterion, at the server boundary.
+    Distinct seeds per query: identical pinned requests would dedupe
+    through the idempotency cache and never re-charge."""
     req = _mk_req(seed=1)  # ni_sign+normalise: spends 2*eps1 on party_x
     charges = request_charges(req)
     budget = 3 * charges["party-x"]
@@ -393,10 +395,10 @@ def test_server_refuses_over_budget_first_query():
                        per_party_budget={"party-x": budget},
                        max_delay_s=0.001, shard="off")
     try:
-        for _ in range(3):
-            srv.estimate(req)
+        for s in range(3):
+            srv.estimate(_mk_req(seed=s + 1))
         with pytest.raises(BudgetExceededError):
-            srv.estimate(req)
+            srv.estimate(_mk_req(seed=4))
         snap = srv.stats_snapshot()
         assert snap["requests_total"] == 3
         assert snap["requests_refused_budget"] == 1
@@ -432,11 +434,120 @@ def test_server_ledger_survives_restart(tmp_path):
                         per_party_budget={"party-x": budget},
                         max_delay_s=0.001, shard="off")
     try:
-        srv2.estimate(req)  # second query still fits
+        # distinct seeds: a replay of seed=1 would be an idempotency
+        # hit on a fresh server only if the cache persisted — it does
+        # not, so use new queries to probe the reloaded ledger state
+        srv2.estimate(_mk_req(seed=2))  # second query still fits
         with pytest.raises(BudgetExceededError):
-            srv2.estimate(req)  # third would double-spend — refused
+            srv2.estimate(_mk_req(seed=3))  # would double-spend — refused
     finally:
         srv2.close()
+
+
+def test_idempotent_replay_no_second_charge_or_launch():
+    """ISSUE 7 acceptance: retrying a pinned request returns the
+    ORIGINAL response object with zero additional ledger charge and
+    zero additional kernel launches — proven by the obs counters, not
+    just by value equality."""
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+    try:
+        r1 = srv.estimate(_mk_req(seed=7))
+        spent = srv.ledger.spent("party-x")
+        flushes = srv.stats.batches_flushed
+        admitted = srv.stats.requests_total
+        r2 = srv.estimate(_mk_req(seed=7))  # same bytes, same seed
+        assert r2 is r1  # the cached object itself — byte-identical
+        assert srv.ledger.spent("party-x") == pytest.approx(spent)
+        assert srv.stats.batches_flushed == flushes  # no kernel ran
+        assert srv.stats.requests_total == admitted  # never re-admitted
+        assert srv.stats.idempotent_hits_completed == 1
+    finally:
+        srv.close()
+
+
+def test_idempotent_inflight_duplicates_share_future():
+    """A duplicate arriving while the original is still queued attaches
+    to the same future: one charge, one launch, both callers answered."""
+    srv = DpcorrServer(budget=1e6, max_batch=1024, max_delay_s=30.0,
+                       shard="off")
+    try:
+        f1 = srv.submit(_mk_req(seed=11))
+        spent = srv.ledger.spent("party-x")
+        f2 = srv.submit(_mk_req(seed=11))
+        assert f2 is f1
+        assert srv.stats.idempotent_hits_inflight == 1
+        assert srv.ledger.spent("party-x") == pytest.approx(spent)
+    finally:
+        srv.close()  # drains the held bucket, resolving the future
+    assert f1.result(timeout=60) is f2.result(timeout=60)
+
+
+def test_idempotency_scoped_by_charged_parties():
+    """Same bytes, same seed, different billed party: a different
+    ledger operation, never deduped. The content digest deliberately
+    excludes party names (noise-stream binding) — the idempotency key
+    must not."""
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+    try:
+        srv.estimate(_mk_req(seed=7))
+        srv.estimate(_mk_req(seed=7, party_x="alice"))
+        assert srv.stats.idempotent_hits_completed == 0
+        assert srv.ledger.spent("party-x") > 0.0
+        assert srv.ledger.spent("alice") > 0.0
+    finally:
+        srv.close()
+
+
+def test_explicit_idempotency_key_on_assigned_stream():
+    """Unpinned requests have no default retry identity (every
+    submission is deliberately a fresh draw), but an explicit client
+    key makes retries safe; without one, resubmission charges and
+    draws again."""
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+    try:
+        r1 = srv.estimate(_mk_req(idempotency_key="job-1"))
+        r2 = srv.estimate(_mk_req(idempotency_key="job-1"))
+        assert r2 is r1
+        spent = srv.ledger.spent("party-x")
+        a = srv.estimate(_mk_req())
+        b = srv.estimate(_mk_req())
+        assert a.seed != b.seed  # fresh streams, not a replay
+        assert srv.ledger.spent("party-x") > spent
+    finally:
+        srv.close()
+
+
+def test_http_idempotent_retry_byte_identical():
+    """The wire-level acceptance check: POSTing the same pinned request
+    twice returns byte-identical bodies, with the stats endpoint
+    counting one admission and one idempotent hit."""
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+    httpd = make_http_server(srv, host="127.0.0.1", port=0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    req = _mk_req(seed=5)
+    body = json.dumps({"family": "ni_sign", "x": req.x.tolist(),
+                       "y": req.y.tolist(), "eps1": 1.0, "eps2": 0.5,
+                       "seed": 5}).encode()
+
+    def post():
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{base}/estimate", data=body,
+                headers={"Content-Type": "application/json"})) as r:
+            assert r.status == 200
+            return r.read()
+    try:
+        first, second = post(), post()
+        assert first == second
+        with urllib.request.urlopen(f"{base}/stats") as r:
+            snap = json.load(r)
+        assert snap["requests_total"] == 1
+        assert snap["idempotent_hits_completed"] == 1
+    finally:
+        httpd.shutdown()
+        srv.close()
 
 
 def test_overload_shed_refunds_budget():

@@ -24,6 +24,18 @@ the three serving invariants end to end:
    first-request latency and its ratio to the steady-state p50 (the
    compile-ahead pipeline's whole point: no client pays a compile).
 
+7. **overload resilience** (with ``--overload``, ISSUE 8) — a separate
+   scenario against a deliberately small server (tight queue, slow- and
+   failing-kernel chaos faults) at ~4× capacity through
+   :class:`~dpcorr.serve.RetryingClient`:
+   every logical request eventually succeeds; sheds/evictions happened
+   and refunded (exact ledger balance + jax-free audit replay — zero ε
+   consumed by any shed or expired request); admitted-request latency
+   holds the SLO; the circuit breaker trips (``/readyz`` degrades, open
+   refusals charge-free) and recovers to bit-identical answers; a
+   16-way duplicate storm of one pinned request lands ONE charge with
+   15 idempotent hits.
+
 Prints one JSON document: serving stats snapshot + latency percentiles
 + throughput + the verification verdicts. Exit code 1 if any invariant
 fails, so the unattended queue can gate on it.
@@ -31,6 +43,8 @@ fails, so the unattended queue can gate on it.
 Usage:
     python benchmarks/serve_load.py [--requests 1000] [--clients 32]
         [--n 500] [--max-batch 64] [--max-delay-ms 20] [--verify 64]
+    python benchmarks/serve_load.py --overload [--requests 192]
+        [--slo-ms 2000] [--out-json overload.json]
 """
 
 from __future__ import annotations
@@ -80,12 +94,24 @@ def main() -> int:
                          "gates on zero compiles during traffic "
                          "(ok.warm_boot) and records first-request "
                          "latency vs steady p50")
+    ap.add_argument("--overload", action="store_true",
+                    help="run the ISSUE 8 overload-resilience scenario "
+                         "instead of the standard load: chaos faults + "
+                         "~4x capacity through RetryingClient, gating "
+                         "on eventual success, refunded sheds, breaker "
+                         "trip/recovery and the duplicate storm")
+    ap.add_argument("--slo-ms", dest="slo_ms", type=float, default=2000.0,
+                    help="overload mode: server-side p99 latency SLO "
+                         "for ADMITTED requests")
     args = ap.parse_args()
 
     import jax
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+
+    if args.overload:
+        return run_overload(args)
     import numpy as np
 
     from dpcorr.models.estimators.registry import serving_entry
@@ -328,6 +354,297 @@ def main() -> int:
         "ok": ok,
         "errors": errors[:5],
         "stats": stats,
+    }
+    blob = json.dumps(out, indent=2)
+    print(blob)
+    if args.out_json:
+        with open(args.out_json, "w") as f:
+            f.write(blob)
+    return 0 if all(ok.values()) else 1
+
+
+def run_overload(args) -> int:
+    """The ISSUE 8 scenario: a deliberately small server under chaos
+    faults at ~4x capacity, driven through RetryingClient. Every gate
+    is exact — eventual success is 100%, shed/expired requests consume
+    zero ε (binary-exact ledger balance + jax-free audit replay),
+    breaker recovery is bit-identical, the duplicate storm charges
+    once."""
+    import jax
+    import numpy as np
+
+    from dpcorr import chaos
+    from dpcorr.models.estimators.registry import serving_entry
+    from dpcorr.obs.audit import AuditTrail, replay
+    from dpcorr.serve import (
+        CircuitOpenError,
+        DeadlineExpiredError,
+        DpcorrServer,
+        EstimateRequest,
+        InProcessClient,
+        RetryingClient,
+        RetryPolicy,
+        ServerOverloadedError,
+        pinned_request_key,
+        request_charges,
+    )
+    from dpcorr.utils import rng
+
+    n_req = args.requests
+    n_obs = 128
+    trail = AuditTrail()
+    # Small on purpose: a 16-deep queue against 32 client threads is
+    # guaranteed overflow, and threshold-3 breaker trips fast.
+    srv = DpcorrServer(budget=1e9, max_batch=8, max_delay_s=0.002,
+                       max_queue=16, batch_mode=args.batch_mode,
+                       audit=trail, breaker_threshold=3,
+                       breaker_reset_s=0.75, brownout_exit_s=0.5,
+                       # compile-ahead (ISSUE 4): the SLO gate measures
+                       # overload behaviour, not first-flush compiles
+                       warmup=f"{args.family}:{n_obs}:{args.eps1}:"
+                              f"{args.eps2}:auto")
+    srv.wait_ready(timeout=900)
+    rc = RetryingClient(
+        InProcessClient(srv),
+        RetryPolicy(max_attempts=16, base_delay_s=0.02,
+                    max_delay_s=0.5, deadline_s=120.0))
+
+    # ---------------- phase A: overload storm under a slow kernel ------
+    chaos.clear_faults()
+    chaos.install_fault(chaos.fault_from_spec(
+        "point=serve.kernel_slow,mode=sleep,delay_ms=25"))
+    rs = np.random.RandomState(7)
+    reqs = [EstimateRequest(
+        args.family, rs.randn(n_obs).astype(np.float32),
+        rs.randn(n_obs).astype(np.float32), args.eps1, args.eps2,
+        party_x="ld-x", party_y="ld-y", seed=i,
+        priority=i % 3 - 1,  # mixed -1 / 0 / 1
+        deadline_s=0.5 if i % 4 == 0 else None)
+        for i in range(n_req)]
+    per_req = request_charges(reqs[0])
+
+    responses: dict[int, object] = {}
+    failures: list[str] = []
+    lock = threading.Lock()
+    per_client = -(-n_req // args.clients)
+
+    def client(c: int) -> None:
+        for i in range(c * per_client,
+                       min((c + 1) * per_client, n_req)):
+            try:
+                r = rc.estimate(reqs[i], timeout=60)
+                with lock:
+                    responses[i] = r
+            except Exception as e:
+                with lock:
+                    failures.append(f"{i}: {type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    # deterministic prioritized-shed probe: slow each flush to 120ms so
+    # the single flush thread drains at most one max_batch round while
+    # we saturate the queue with high-priority work, then offer
+    # lower-priority requests. A lower-priority arrival at a full queue
+    # outranks nothing, so admission MUST refuse it with a Retry-After
+    # hint and refund its charge — by pigeonhole within max_queue + 1
+    # attempts, since every admitted probe deepens the queue and the
+    # drain is two orders of magnitude slower than the attempt loop.
+    chaos.clear_faults()
+    chaos.install_fault(chaos.fault_from_spec(
+        "point=serve.kernel_slow,mode=sleep,delay_ms=120"))
+    fill_futs = []
+    for j in range(srv.coalescer.max_queue + 8):
+        try:
+            fill_futs.append(srv.submit(EstimateRequest(
+                args.family, reqs[0].x, reqs[0].y, args.eps1,
+                args.eps2, party_x="rf-x", party_y="rf-y",
+                seed=20_000 + j, priority=1)))
+        except ServerOverloadedError:
+            pass  # equal-rank spill among the fillers themselves
+    probe_refused = False
+    probe_retry_after = None
+    for k in range(srv.coalescer.max_queue + 8):
+        try:
+            fill_futs.append(srv.submit(EstimateRequest(
+                args.family, reqs[0].x, reqs[0].y, args.eps1,
+                args.eps2, party_x="rf-x", party_y="rf-y",
+                seed=30_000 + k, priority=0)))
+        except ServerOverloadedError as e:
+            probe_refused = True
+            probe_retry_after = e.retry_after_s
+            break
+    fill_ok = 0
+    for f in fill_futs:
+        try:
+            f.result(timeout=60)
+            fill_ok += 1
+        except ServerOverloadedError:
+            pass
+    # every refused/spilled filler and the probe were refunded exactly:
+    # the rf parties paid for completed work and nothing else
+    rf_exact = (srv.ledger.spent("rf-x") == fill_ok * per_req["ld-x"]
+                and srv.ledger.spent("rf-y") == fill_ok * per_req["ld-y"])
+
+    # a guaranteed expiry: queued with an already-hopeless deadline,
+    # dropped before launch, charge refunded (net-zero on the ledger)
+    try:
+        srv.submit(EstimateRequest(
+            args.family, reqs[0].x, reqs[0].y, args.eps1, args.eps2,
+            party_x="ld-x", party_y="ld-y", seed=n_req + 1,
+            deadline_s=1e-6)).result(timeout=30)
+        expiry_probe_expired = False
+    except DeadlineExpiredError:
+        expiry_probe_expired = True
+    chaos.clear_faults()
+
+    snap_a = srv.stats_snapshot()
+    rc_stats = rc.stats()
+    shed_total = sum(snap_a["shed"].values())
+    refused_total = sum(snap_a["refused"].values())
+    p99 = snap_a.get("latency_s", {}).get("p99")
+    # binary-exact ε accounting: every success charged exactly once,
+    # every shed/expired/abandoned attempt refunded exactly
+    ledger_exact = (
+        srv.ledger.spent("ld-x") == len(responses) * per_req["ld-x"]
+        and srv.ledger.spent("ld-y") == len(responses) * per_req["ld-y"])
+
+    # ---------------- phase B: breaker trip → recover, bit-identical ---
+    rsb = np.random.RandomState(11)
+    chaos.install_fault(chaos.fault_from_spec(
+        "point=serve.kernel,mode=fail,times=6"))
+    # each whole-request failure traverses the fault twice (batched
+    # attempt + unbatched fallback): times=6 → exactly 3 failures,
+    # tripping the threshold-3 breaker, then the plan is spent
+    executed_failures = 0
+    for j in range(3):
+        try:
+            srv.estimate(EstimateRequest(
+                args.family, rsb.randn(n_obs).astype(np.float32),
+                rsb.randn(n_obs).astype(np.float32),
+                args.eps1, args.eps2, party_x="bk-x", party_y="bk-y",
+                seed=5000 + j), timeout=60)
+        except chaos.SimulatedFault:
+            executed_failures += 1
+    tripped = srv.readiness()
+    spent_bk = srv.ledger.spent("bk-x")
+    breaker_refused = False
+    probe_req = EstimateRequest(
+        args.family, reqs[0].x, reqs[0].y, args.eps1, args.eps2,
+        party_x="bk-x", party_y="bk-y", seed=777)
+    try:
+        srv.estimate(probe_req, timeout=60)
+    except CircuitOpenError:
+        breaker_refused = True
+    refusal_charge_free = srv.ledger.spent("bk-x") == spent_bk
+    time.sleep(0.9)  # cooldown: the next admission is the probe
+    recovered_resp = srv.estimate(probe_req, timeout=60)
+    recovered = srv.readiness()
+    single = jax.jit(serving_entry(args.family, args.eps1, args.eps2,
+                                   alpha=0.05, normalise=True))
+    ref = single(pinned_request_key(rng.master_key(srv.seed),
+                                    probe_req, 777),
+                 probe_req.x, probe_req.y)
+    check_ci = args.batch_mode == "exact"
+    recovery_bit_identical = (
+        recovered_resp.rho_hat == float(ref[0])
+        and (not check_ci or (recovered_resp.ci_low == float(ref[1])
+                              and recovered_resp.ci_high == float(ref[2]))))
+
+    # ---------------- phase C: duplicate storm, charge-once ------------
+    storm_req = EstimateRequest(
+        args.family, reqs[1].x, reqs[1].y, args.eps1, args.eps2,
+        party_x="dup-x", party_y="dup-y", seed=31337)
+    hits_before = (srv.stats.idempotent_hits_completed
+                   + srv.stats.idempotent_hits_inflight)
+    storm_out: list[object] = []
+    barrier = threading.Barrier(16)
+
+    def dup_client() -> None:
+        barrier.wait()
+        try:
+            r = rc.estimate(storm_req, timeout=60)
+            with lock:
+                storm_out.append(r)
+        except Exception as e:
+            with lock:
+                failures.append(f"storm: {type(e).__name__}: {e}")
+
+    storm_threads = [threading.Thread(target=dup_client)
+                     for _ in range(16)]
+    for t in storm_threads:
+        t.start()
+    for t in storm_threads:
+        t.join()
+    idem_hits = (srv.stats.idempotent_hits_completed
+                 + srv.stats.idempotent_hits_inflight - hits_before)
+    storm_single_charge = (
+        srv.ledger.spent("dup-x") == request_charges(storm_req)["dup-x"])
+    storm_identical = (len(storm_out) == 16 and len(
+        {(r.rho_hat, r.ci_low, r.ci_high, r.seed)
+         for r in storm_out}) == 1)
+
+    srv.close()
+    # the ε story end to end, reproducible WITHOUT jax or the server:
+    # folding the audit trail reproduces the ledger's final balances
+    replayed = replay(trail.events())
+    parties = srv.ledger.snapshot()["parties"]
+    audit_matches = (set(replayed) == set(parties) and all(
+        replayed[p] == parties[p]["spent"] for p in replayed))
+
+    ok = {
+        "eventual_success": len(responses) == n_req and not failures,
+        "overload_exercised": shed_total > 0
+                              and rc_stats.get("retryable", 0) > 0,
+        "priority_shed": probe_refused and rf_exact
+                         and (probe_retry_after or 0) > 0,
+        "expiry_refunded": expiry_probe_expired
+                           and snap_a["shed"]["expired"] >= 1,
+        "latency_slo": p99 is not None and p99 <= args.slo_ms / 1e3,
+        "ledger_exact": ledger_exact,
+        "audit_replay": audit_matches,
+        "breaker_tripped": executed_failures == 3
+                           and tripped["ready"] is False
+                           and tripped["breakers_open"] is True
+                           and breaker_refused and refusal_charge_free,
+        "breaker_recovered": recovered["ready"] is True
+                             and recovery_bit_identical,
+        "idempotent_storm": storm_identical and idem_hits == 15
+                            and storm_single_charge,
+    }
+    out = {
+        "metric": "serve_overload",
+        "requests": n_req,
+        "clients": args.clients,
+        "n": n_obs,
+        "family": args.family,
+        "wall_s": round(wall, 3),
+        "eventual_success_rate": round(len(responses) / n_req, 4),
+        "client_stats": rc_stats,
+        "shed": snap_a["shed"],
+        "refused": snap_a["refused"],
+        "abandoned": snap_a["abandoned"],
+        "p99_s": p99,
+        "slo_s": args.slo_ms / 1e3,
+        "breaker": {"tripped_readiness": tripped,
+                    "recovered_readiness": recovered,
+                    "executed_failures": executed_failures,
+                    "transitions": srv.stats_snapshot().get("breaker")},
+        "duplicate_storm": {"fanout": 16, "idempotent_hits": idem_hits,
+                            "single_charge": storm_single_charge},
+        "priority_probe": {"refused": probe_refused,
+                           "retry_after_s": probe_retry_after,
+                           "fill_completed": fill_ok,
+                           "refund_exact": rf_exact},
+        "ok": ok,
+        "errors": failures[:5],
+        "stats": srv.stats_snapshot(),
     }
     blob = json.dumps(out, indent=2)
     print(blob)

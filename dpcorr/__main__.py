@@ -247,6 +247,13 @@ def cmd_serve(args):
         cache_dir = resolve_cache_dir("cli")
         if cache_dir:
             export_dir = os.path.join(cache_dir, "exported")
+    if args.fault:
+        # chaos faults at boot (testing only): the overload harness and
+        # operators drilling breaker/brownout behaviour on a replica
+        from dpcorr import chaos
+
+        for spec in args.fault:
+            chaos.install_fault(chaos.fault_from_spec(spec))
     server = DpcorrServer(
         budget=args.budget, ledger_path=args.ledger,
         seed=args.seed, max_batch=args.max_batch,
@@ -255,7 +262,15 @@ def cmd_serve(args):
         batch_mode=args.batch_mode, max_kernels=args.max_kernels,
         audit=args.audit, warmup=args.warmup,
         warmup_manifest=args.warmup_manifest,
-        aot=args.aot == "on", export_dir=export_dir)
+        aot=args.aot == "on", export_dir=export_dir,
+        breaker_threshold=args.breaker_threshold,
+        breaker_reset_s=args.breaker_reset_s,
+        shed_queue_frac=args.shed_queue_frac,
+        flush_slo_s=(args.flush_slo_ms / 1000.0
+                     if args.flush_slo_ms is not None else None),
+        brownout_enter_s=args.brownout_enter_s,
+        brownout_exit_s=args.brownout_exit_s,
+        brownout_min_priority=args.brownout_min_priority)
     print(json.dumps({"serving": {"host": args.host, "port": args.port,
                                   "budget": args.budget,
                                   "ledger": args.ledger,
@@ -267,7 +282,18 @@ def cmd_serve(args):
                                   "warmup": server.readiness(),
                                   "warmup_manifest": args.warmup_manifest,
                                   "aot": args.aot,
-                                  "export_dir": export_dir}}),
+                                  "export_dir": export_dir,
+                                  "breaker": {
+                                      "threshold": args.breaker_threshold,
+                                      "reset_s": args.breaker_reset_s},
+                                  "brownout": {
+                                      "queue_frac": args.shed_queue_frac,
+                                      "flush_slo_ms": args.flush_slo_ms,
+                                      "enter_s": args.brownout_enter_s,
+                                      "exit_s": args.brownout_exit_s,
+                                      "min_priority":
+                                          args.brownout_min_priority},
+                                  "faults": args.fault}}),
           flush=True)
     serve_http(server, host=args.host, port=args.port)
 
@@ -808,6 +834,40 @@ def main(argv=None):
                      help="ahead-of-time kernel compilation (utils."
                           "compile); 'off' reverts to lazy jit on first "
                           "flush (A/B measurement)")
+    ps_.add_argument("--breaker-threshold", dest="breaker_threshold",
+                     type=int, default=5,
+                     help="circuit breaker: consecutive kernel failures "
+                          "in one compile bucket before it opens "
+                          "(docs/ROBUSTNESS.md)")
+    ps_.add_argument("--breaker-reset-s", dest="breaker_reset_s",
+                     type=float, default=30.0,
+                     help="circuit breaker: cooldown before an open "
+                          "bucket admits one half-open probe")
+    ps_.add_argument("--shed-queue-frac", dest="shed_queue_frac",
+                     type=float, default=0.75,
+                     help="brownout: queue fraction counted as "
+                          "sustained pressure")
+    ps_.add_argument("--flush-slo-ms", dest="flush_slo_ms", type=float,
+                     default=None,
+                     help="brownout: flush-latency EWMA above this also "
+                          "counts as pressure (default: queue-only)")
+    ps_.add_argument("--brownout-enter-s", dest="brownout_enter_s",
+                     type=float, default=0.5,
+                     help="brownout: sustained-pressure seconds before "
+                          "entering (unbatched fallback + low-priority "
+                          "rejection)")
+    ps_.add_argument("--brownout-exit-s", dest="brownout_exit_s",
+                     type=float, default=2.0,
+                     help="brownout: calm seconds before exiting")
+    ps_.add_argument("--brownout-min-priority", dest="brownout_min_priority",
+                     type=int, default=0,
+                     help="brownout: reject requests below this priority "
+                          "while active")
+    ps_.add_argument("--fault", action="append", default=None,
+                     metavar="SPEC",
+                     help="install a chaos fault before serving, e.g. "
+                          "'point=serve.kernel,mode=fail,times=3' "
+                          "(repeatable; testing only — dpcorr.chaos)")
     ps_.set_defaults(fn=cmd_serve)
 
     po_ = sub.add_parser("obs", help="telemetry tooling: audit-trail "

@@ -24,6 +24,15 @@ a channel handed in by the gate) requests are charged by contract:
   whose handler reaches ``ledger.refund``: an enqueue refusal (or a
   transport failure) after a successful charge would consume ε for a
   query that was never answered.
+- ``budget-shed-missing-refund`` — a function settles a future with a
+  *refusal* exception (``...set_exception(ServerOverloadedError(...))``
+  and friends) without any ``*refund*`` call in the same function.
+  Post-admission sheds — deadline expiry, priority eviction,
+  close-drain — happen *below* the ledger (the coalescer refunds via a
+  helper handed the charges at submit), so this rule keys on the call
+  *name* rather than a ledger receiver: every shed site must at least
+  route through something named refund. ISSUE 8 added three such sites
+  at once; this is the shape that keeps the next one honest.
 """
 
 from __future__ import annotations
@@ -50,6 +59,11 @@ CHARGE_FNS = frozenset({"charge", "charge_request"})
 REFUND_FNS = frozenset({"refund"})
 LEDGER_NAMES = frozenset({"ledger"})
 
+#: exception classes that refuse an ALREADY-ADMITTED (hence charged)
+#: request — settling a future with one of these is a shed site.
+REFUSAL_EXCS = frozenset({"ServerOverloadedError", "ServerClosedError",
+                          "DeadlineExpiredError", "CircuitOpenError"})
+
 
 def _is_ledger_call(call: ast.Call, fns: frozenset[str]) -> bool:
     chain = attr_chain(call.func)
@@ -71,6 +85,9 @@ class BudgetChecker(Checker):
                                   "admission layer",
         "budget-missing-refund": "post-charge enqueue not guarded by a "
                                  "refund-on-failure handler",
+        "budget-shed-missing-refund": "future settled with a refusal "
+                                      "exception in a function with no "
+                                      "refund call",
     }
 
     def applies_to(self, relpath: str) -> bool:
@@ -81,9 +98,45 @@ class BudgetChecker(Checker):
         for fn in ast.walk(module.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
+            yield from self._check_shed_sites(module, fn)
             if not self._holds_ledger(fn):
                 continue
             yield from self._check_fn(module, fn)
+
+    def _check_shed_sites(self, module: Module, fn) -> Iterator[Violation]:
+        """``budget-shed-missing-refund``: shed sites live below the
+        admission layer (no ledger in scope), so the evidence of a
+        refund is a call whose *name* contains ``refund`` — the
+        coalescer's ``self._refund(...)`` helper, or ``ledger.refund``
+        itself at admission sites."""
+        sheds = [node for node in walk_same_scope(fn)
+                 if isinstance(node, ast.Call)
+                 and self._is_refusal_set_exception(node)]
+        if not sheds:
+            return
+        if any(isinstance(node, ast.Call)
+               and any("refund" in part
+                       for part in attr_chain(node.func))
+               for node in walk_same_scope(fn)):
+            return
+        for node in sheds:
+            exc = attr_chain(node.args[0].func)[-1]
+            yield Violation(
+                "budget-shed-missing-refund", module.relpath, node.lineno,
+                f"set_exception({exc}(...)) sheds an admitted request "
+                f"but no refund call appears in this function — its "
+                f"charge would be consumed for a query never answered")
+
+    @staticmethod
+    def _is_refusal_set_exception(call: ast.Call) -> bool:
+        chain = attr_chain(call.func)
+        if not chain or chain[-1] != "set_exception" or not call.args:
+            return False
+        arg = call.args[0]
+        if not isinstance(arg, ast.Call):
+            return False
+        exc_chain = attr_chain(arg.func)
+        return bool(exc_chain) and exc_chain[-1] in REFUSAL_EXCS
 
     @staticmethod
     def _holds_ledger(fn) -> bool:

@@ -37,6 +37,7 @@ from __future__ import annotations
 import os
 import random
 import threading
+import time
 
 #: Exit status a chaos kill dies with — the restart driver asserts on
 #: it so an ordinary crash (bug, OOM) is never mistaken for the plan.
@@ -230,3 +231,177 @@ def point(name: str) -> None:
     if plan.mode == "exit":
         os._exit(EXIT_CODE)
     raise SimulatedCrash(name)
+
+
+# ------------------------------------------------------------- faults ----
+# Crash points (above) model the process DYING at a boundary; fault
+# points model it LIMPING — a kernel that raises, a kernel that takes
+# 50x its budget, a flush thread that stalls. The overload-resilience
+# layer (ISSUE 8: deadlines, shedding, circuit breaker, brownout) is
+# only trustworthy if those degradations are actually injectable, so
+# they get their own registry with deliberately different semantics:
+#
+# - ``SimulatedFault`` is a plain ``Exception``. A crash must sail
+#   through every handler (BaseException); a fault must be CAUGHT by
+#   them — it stands in for "the kernel raised", which is exactly the
+#   failure class the breaker and the unbatched fallback exist for.
+# - Multiple fault plans may be armed at once (slow kernels AND a
+#   stalled flush), and a plan fires on a traversal *range* rather
+#   than one hit — sustained degradation, not a single event.
+# - ``sleep`` mode delays instead of raising, for latency faults.
+
+#: Registered fault sites. Append-only, same convention as
+#: KNOWN_POINTS; disjoint from it — a name is a crash point or a fault
+#: point, never both.
+FAULT_POINTS = (
+    "serve.kernel",        # batched/unbatched launch raises
+    "serve.kernel_slow",   # launch takes delay_s longer than it should
+    "serve.flush_stall",   # the flush thread stalls before dispatch
+)
+
+_FAULT_MODES = ("fail", "sleep")
+_KNOWN_FAULTS = frozenset(FAULT_POINTS)
+
+
+class SimulatedFault(Exception):
+    """An injected *service* fault (kernel failure, not process death).
+
+    A plain ``Exception`` on purpose — the degradation machinery under
+    test (unbatched fallback, circuit breaker, retrying client) handles
+    concrete execution failures, and the injected stand-in must be
+    caught exactly like a real lowering error or device OOM would be.
+    """
+
+    def __init__(self, point: str):
+        self.point = point
+        super().__init__(f"simulated fault at chaos point {point!r}")
+
+
+class FaultPlan:
+    """One armed degradation: traversals ``after+1 .. after+times`` of
+    ``point`` either raise :class:`SimulatedFault` (``mode="fail"``) or
+    sleep ``delay_s`` (``mode="sleep"``). ``times=None`` fires forever
+    (until cleared) — sustained overload, the brownout trigger."""
+
+    def __init__(self, point: str, mode: str = "fail",
+                 times: int | None = None, delay_s: float = 0.0,
+                 after: int = 0):
+        if point not in _KNOWN_FAULTS:
+            raise ValueError(f"unknown fault point {point!r}; "
+                             f"registered: {FAULT_POINTS}")
+        if mode not in _FAULT_MODES:
+            raise ValueError(f"mode must be one of {_FAULT_MODES}, "
+                             f"got {mode!r}")
+        if times is not None and times < 1:
+            raise ValueError(f"times must be >= 1 or None, got {times}")
+        if mode == "sleep" and delay_s <= 0.0:
+            raise ValueError("sleep mode needs delay_s > 0")
+        if after < 0:
+            raise ValueError(f"after must be >= 0, got {after}")
+        self.point = point
+        self.mode = mode
+        self.times = times
+        self.delay_s = float(delay_s)
+        self.after = int(after)
+
+    def to_dict(self) -> dict:
+        out = {"point": self.point, "mode": self.mode}
+        if self.times is not None:
+            out["times"] = self.times
+        if self.delay_s:
+            out["delay_s"] = self.delay_s
+        if self.after:
+            out["after"] = self.after
+        return out
+
+
+def fault_from_spec(spec: str) -> FaultPlan:
+    """Parse ``"point=serve.kernel,mode=fail,times=3"`` or
+    ``"point=serve.kernel_slow,mode=sleep,delay_ms=40"``."""
+    fields: dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad fault spec field {part!r} "
+                             "(want key=value)")
+        k, v = part.split("=", 1)
+        fields[k.strip()] = v.strip()
+    if "point" not in fields:
+        raise ValueError(f"fault spec {spec!r} names no point=")
+    delay = float(fields.get("delay_s", "0") or 0)
+    if "delay_ms" in fields:
+        delay = float(fields["delay_ms"]) / 1e3
+    return FaultPlan(fields["point"],
+                     mode=fields.get("mode", "fail"),
+                     times=(int(fields["times"]) if "times" in fields
+                            else None),
+                     delay_s=delay,
+                     after=int(fields.get("after", "0")))
+
+
+def faults_from_env(env: str = "DPCORR_FAULTS") -> list[FaultPlan]:
+    """``DPCORR_FAULTS`` holds ``;``-separated fault specs — the
+    subprocess hook mirroring :func:`plan_from_env`."""
+    raw = os.environ.get(env)
+    if not raw:
+        return []
+    return [fault_from_spec(s) for s in raw.split(";") if s.strip()]
+
+
+_fault_plans: list[FaultPlan] = []  # guarded by: _lock
+_fault_counts: dict[int, int] = {}  # guarded by: _lock
+
+
+def install_fault(plan: FaultPlan) -> None:
+    """Arm one fault plan (additive — unlike crash plans, several may
+    be live at once)."""
+    with _lock:
+        _fault_plans.append(plan)
+
+
+def install_faults(plans: list[FaultPlan]) -> None:
+    for p in plans:
+        install_fault(p)
+
+
+def clear_faults() -> None:
+    with _lock:
+        _fault_plans.clear()
+        _fault_counts.clear()
+
+
+def active_faults() -> list[FaultPlan]:
+    with _lock:
+        return list(_fault_plans)
+
+
+def fault(name: str) -> None:
+    """Declare one fault site. No-op unless an armed plan names this
+    point and the traversal falls in its firing window; then sleep
+    (``sleep``) or raise :class:`SimulatedFault` (``fail``)."""
+    if not _fault_plans:
+        return
+    if name not in _KNOWN_FAULTS:
+        raise ValueError(f"unregistered fault point {name!r}; add it to "
+                         "chaos.FAULT_POINTS")
+    fire: FaultPlan | None = None
+    with _lock:
+        for plan in _fault_plans:
+            if plan.point != name:
+                continue
+            k = _fault_counts.get(id(plan), 0) + 1
+            _fault_counts[id(plan)] = k
+            if k <= plan.after:
+                continue
+            if plan.times is not None and k > plan.after + plan.times:
+                continue
+            fire = plan
+            break
+    if fire is None:
+        return
+    if fire.mode == "sleep":
+        time.sleep(fire.delay_s)
+        return
+    raise SimulatedFault(name)

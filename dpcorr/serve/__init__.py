@@ -16,7 +16,13 @@ up — each module's docstring carries its own contract:
 - :mod:`stats`     — live counters: queue depth, flush sizes,
   batch-fill ratio, latency percentiles, ε spend.
 - :mod:`coalescer` — the micro-batcher: per-bucket queues, size/age
-  flush policy, backpressure, unbatched degradation.
+  flush policy, backpressure, unbatched degradation; deadline drops,
+  priority eviction and refuse-draining shutdown (every shed refunds).
+- :mod:`overload`  — circuit breaker (per-bucket failure isolation,
+  half-open probing) and brownout (sustained-pressure degradation).
+- :mod:`client`    — retrying clients: jittered backoff honoring
+  ``Retry-After``, one idempotency key across attempts (charge-once),
+  plus the HTTP client speaking the serve front end's refusal codes.
 - :mod:`warmup`    — compile-ahead signature sets (``--warmup`` spec
   parsing, kernel-cache manifest persistence) behind the ``/readyz``
   readiness gate.
@@ -27,11 +33,25 @@ See docs/SERVING.md for the end-to-end story and the bit-identity
 contract (estimators.registry).
 """
 
+from dpcorr.serve.client import (  # noqa: F401
+    HttpEstimateClient,
+    RetriableTransportError,
+    RetryingClient,
+    RetryPolicy,
+    request_to_json,
+)
 from dpcorr.serve.coalescer import (  # noqa: F401
     Coalescer,
+    ServerClosedError,
     ServerOverloadedError,
 )
 from dpcorr.serve.kernels import KernelCache, pad_batch  # noqa: F401
+from dpcorr.serve.overload import (  # noqa: F401
+    BrownoutController,
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExpiredError,
+)
 from dpcorr.serve.ledger import (  # noqa: F401
     BudgetExceededError,
     PrivacyLedger,

@@ -25,11 +25,34 @@ Degradation paths (both recorded in stats, never silent):
   overhead;
 - a batched launch that fails (lowering, OOM, device error) falls back
   to per-request direct execution, so one poisoned lane degrades its
-  batch to unbatched service instead of failing every rider.
+  batch to unbatched service instead of failing every rider; under
+  **brownout** (sustained pressure — serve.overload) every flush takes
+  this unbatched path up front, keeping launches small and predictable.
 
-Backpressure: ``submit`` raises :class:`ServerOverloadedError` once
-``max_queue`` requests are pending — the caller sheds load explicitly
-instead of the queue growing without bound.
+Overload discipline (ISSUE 8) — every shed request is an *admitted*
+(charged) request dropped **before** its kernel launched, so the
+coalescer refunds its charge (``ledger.refund`` with the shed reason)
+and the drop provably consumes zero ε:
+
+- **deadline expiry**: a request whose ``deadline_s`` passed while
+  queued resolves to :class:`~dpcorr.serve.overload.DeadlineExpiredError`
+  at flush time, before any dispatch.
+- **priority eviction**: ``submit`` at capacity no longer blindly
+  refuses the newcomer — it sheds the pending request with the lowest
+  ``(priority, remaining-deadline)`` rank when the newcomer outranks
+  it, so a queue full of idle low-priority work cannot starve urgent
+  queries. The victim's future gets :class:`ServerOverloadedError`
+  with a ``retry_after_s`` estimate.
+- **client abandonment**: a future the client managed to ``cancel()``
+  (estimate-timeout path, serve.server) is dropped at flush claim time.
+- **shutdown**: ``close()`` refuse-drains the queue — every pending
+  request resolves to :class:`ServerClosedError` with its charge
+  refunded; an answer computed after the front end stopped would spend
+  ε on a response nobody reads.
+
+The refusal constructors live in per-reason ``_refuse_*`` helpers next
+to their refunds on purpose: the ``budget-shed-missing-refund`` lint
+rule (analysis.rules.budget) checks exactly this pairing.
 """
 
 from __future__ import annotations
@@ -44,6 +67,11 @@ import numpy as np
 from dpcorr import chaos
 from dpcorr.obs import trace as obs_trace
 from dpcorr.serve.kernels import KernelCache
+from dpcorr.serve.overload import (
+    BrownoutController,
+    CircuitBreaker,
+    DeadlineExpiredError,
+)
 from dpcorr.serve.request import (
     EstimateRequest,
     EstimateResponse,
@@ -52,9 +80,23 @@ from dpcorr.serve.request import (
 )
 from dpcorr.serve.stats import ServeStats
 
+#: ceiling on the Retry-After estimate — a hint, not a promise.
+_MAX_RETRY_AFTER_S = 5.0
+
 
 class ServerOverloadedError(Exception):
-    """Admission refused: the pending queue is at capacity."""
+    """Admission refused (queue at capacity) or an admitted request
+    evicted by a higher-(priority, urgency) arrival. ``retry_after_s``
+    estimates when capacity should free up — surfaced as the HTTP
+    ``Retry-After`` header and honored by the retrying client."""
+
+    def __init__(self, msg: str, retry_after_s: float | None = None):
+        self.retry_after_s = retry_after_s
+        super().__init__(msg)
+
+
+class ServerClosedError(ServerOverloadedError):
+    """The coalescer is shut down; pending work was refuse-drained."""
 
 
 @dataclasses.dataclass
@@ -69,13 +111,28 @@ class _Pending:
     #: how one trace ID links admission to flush across threads. The
     #: disabled tracer's null span when tracing is off.
     span: object = obs_trace._NULL_SPAN
+    #: shedding rank (request.priority) — higher survives eviction
+    priority: int = 0
+    #: absolute perf_counter deadline, or None for no deadline
+    t_deadline: float | None = None
+    #: what admission charged, so a pre-launch drop can refund exactly
+    charges: dict | None = None
+
+    def rank(self, now: float) -> tuple:
+        """Eviction order: cancelled futures are free victims, then
+        lowest priority, then least remaining deadline slack."""
+        slack = (self.t_deadline - now if self.t_deadline is not None
+                 else float("inf"))
+        return (not self.future.cancelled(), self.priority, slack)
 
 
 class Coalescer:
     def __init__(self, cache: KernelCache, stats: ServeStats,
                  max_batch: int = 64, max_delay_s: float = 0.005,
                  max_queue: int = 4096,
-                 tracer: obs_trace.Tracer | None = None):
+                 tracer: obs_trace.Tracer | None = None,
+                 ledger=None, breaker: CircuitBreaker | None = None,
+                 brownout: BrownoutController | None = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.cache = cache
@@ -84,6 +141,11 @@ class Coalescer:
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
         self.max_queue = max_queue
+        #: refund sink for shed requests (None → charges are the
+        #: caller's problem, the pre-ISSUE-8 behavior)
+        self.ledger = ledger
+        self.breaker = breaker
+        self.brownout = brownout
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._buckets: dict[tuple, list[_Pending]] = {}  # guarded by: _cond
@@ -96,27 +158,140 @@ class Coalescer:
 
     # -- admission -------------------------------------------------------
     def submit(self, req: EstimateRequest, key, seed: int,
-               span=None) -> Future:
+               span=None, charges: dict | None = None) -> Future:
         """Enqueue one admitted request; resolves to EstimateResponse.
         ``span`` is the request's root span (or None/null when
         untraced); it rides the queue so the flush thread can parent
-        its spans under the same trace ID."""
+        its spans under the same trace ID. ``charges`` is what
+        admission charged the ledger — carried so any pre-launch shed
+        can refund it."""
         fut: Future = Future()
-        p = _Pending(req, key, seed, fut, time.perf_counter(),
-                     span if span is not None else obs_trace._NULL_SPAN)
+        now = time.perf_counter()
+        t_deadline = (now + req.deadline_s if req.deadline_s is not None
+                      else None)
+        p = _Pending(req, key, seed, fut, now,
+                     span if span is not None else obs_trace._NULL_SPAN,
+                     priority=req.priority, t_deadline=t_deadline,
+                     charges=charges)
+        victim = None
+        retry_after = None
         with self._cond:
             if self._closed:
-                raise RuntimeError("coalescer is closed")
+                raise ServerClosedError("coalescer is closed")
             if self._depth >= self.max_queue:
-                self.stats.refused_overload()
-                raise ServerOverloadedError(
-                    f"{self._depth} requests pending >= max_queue="
-                    f"{self.max_queue}")
+                victim = self._pick_victim_locked(p, now)
+                if victim is None:
+                    self.stats.refused_overload()
+                    raise ServerOverloadedError(
+                        f"{self._depth} requests pending >= max_queue="
+                        f"{self.max_queue}",
+                        retry_after_s=self._retry_after_locked())
+                retry_after = self._retry_after_locked()
             self._buckets.setdefault(bucket_key(req), []).append(p)
             self._depth += 1
             self.stats.set_queue_depth(self._depth)
+            self._observe_pressure_locked()
             self._cond.notify()
+        if victim is not None:
+            self._refuse_evicted(victim, retry_after)
         return fut
+
+    def _pick_victim_locked(self, incoming: _Pending,
+                            now: float) -> _Pending | None:
+        """At capacity: the lowest-ranked pending request, removed from
+        its bucket — but only when the newcomer STRICTLY outranks it
+        (equal-rank arrivals are refused, preserving FIFO fairness
+        within a priority class)."""
+        best = best_rank = best_loc = None
+        for bkey, q in self._buckets.items():
+            for i, p in enumerate(q):
+                rank = p.rank(now)
+                if best_rank is None or rank < best_rank:
+                    best, best_rank, best_loc = p, rank, (bkey, i)
+        if best is None or not best_rank < incoming.rank(now):
+            return None
+        bkey, i = best_loc
+        q = self._buckets[bkey]
+        q.pop(i)
+        if not q:
+            del self._buckets[bkey]
+        self._depth -= 1
+        return best
+
+    def _retry_after_locked(self) -> float:
+        """Back-of-envelope drain estimate: flushes left in the queue
+        times the observed (EWMA) flush duration."""
+        per_flush = max(self.stats.flush_ewma(), self.max_delay_s)
+        flushes = self._depth / max(self.max_batch, 1) + 1.0
+        return min(flushes * per_flush, _MAX_RETRY_AFTER_S)
+
+    def retry_after_s(self) -> float:
+        with self._cond:
+            return self._retry_after_locked()
+
+    def _observe_pressure_locked(self) -> None:
+        if self.brownout is not None:
+            self.brownout.observe(self._depth / max(self.max_queue, 1),
+                                  self.stats.flush_ewma())
+
+    def observe_pressure(self) -> None:
+        """Feed the brownout controller the CURRENT queue pressure —
+        called from the admission gate so the hysteresis clock keeps
+        moving even when every arrival is refused before enqueue
+        (otherwise brownout could latch active after the queue drains,
+        refusing low-priority work forever)."""
+        with self._cond:
+            self._observe_pressure_locked()
+
+    # -- shed refusals (refund + resolve, one helper per reason) ---------
+    def _refund(self, p: _Pending, reason: str) -> None:
+        """Reverse the shed request's admission charge — valid exactly
+        because every caller drops ``p`` BEFORE any kernel launched
+        (ledger.refund contract)."""
+        if self.ledger is not None and p.charges:
+            self.ledger.refund(p.charges, trace_id=p.span.trace_id,
+                               reason=reason)
+
+    def _refuse_evicted(self, p: _Pending,
+                        retry_after: float | None) -> None:
+        self._refund(p, "queue_evict")
+        self.stats.shed("queue_evict")
+        if p.future.set_running_or_notify_cancel():
+            p.future.set_exception(ServerOverloadedError(
+                "evicted from the pending queue by a higher-priority "
+                "arrival", retry_after_s=retry_after))
+        p.span.set(refused="queue_evict")
+        p.span.end()
+
+    def _refuse_expired(self, p: _Pending, now: float) -> None:
+        self._refund(p, "expired")
+        self.stats.shed("expired")
+        late_ms = (now - p.t_deadline) * 1e3
+        p.future.set_exception(DeadlineExpiredError(
+            f"deadline_s={p.req.deadline_s} expired {late_ms:.1f} ms "
+            "before the kernel launched (charge refunded)",
+            retry_after_s=self.retry_after_s()))
+        p.span.set(refused="expired")
+        p.span.end()
+
+    def _refuse_closed(self, p: _Pending) -> None:
+        self._refund(p, "closed")
+        self.stats.shed("closed")
+        if p.future.set_running_or_notify_cancel():
+            p.future.set_exception(ServerClosedError(
+                "server shut down before this request launched "
+                "(charge refunded)"))
+        p.span.set(refused="closed")
+        p.span.end()
+
+    def _drop_cancelled(self, p: _Pending) -> None:
+        """The client's ``cancel()`` won the claim race: it already
+        sees CancelledError; the request never launched, so the charge
+        reverses like any other shed."""
+        self._refund(p, "cancelled")
+        self.stats.shed("cancelled")
+        p.span.set(refused="cancelled")
+        p.span.end()
 
     # -- flush thread ----------------------------------------------------
     def _take_ready_locked(self, now: float) -> list[list[_Pending]]:
@@ -142,18 +317,12 @@ class Coalescer:
         while True:
             with self._cond:
                 while True:
-                    if self._closed and not self._buckets:
+                    if self._closed:
+                        # close() refuse-drains the queue itself; the
+                        # flush thread just stops picking up work
                         return
                     now = time.perf_counter()
-                    # drain immediately on close — pending clients must
-                    # get answers, not wait out the delay window
-                    if self._closed:
-                        ready = [q[i:i + self.max_batch]
-                                 for q in self._buckets.values()
-                                 for i in range(0, len(q), self.max_batch)]
-                        self._buckets.clear()
-                    else:
-                        ready = self._take_ready_locked(now)
+                    ready = self._take_ready_locked(now)
                     if ready:
                         break
                     deadline = self._next_deadline_locked()
@@ -166,6 +335,23 @@ class Coalescer:
                 self._flush(group)
 
     # -- execution -------------------------------------------------------
+    def _claim_live(self, group: list[_Pending]) -> list[_Pending]:
+        """The pre-launch boundary: claim each pending future (after
+        which a client ``cancel()`` can no longer race a resolution),
+        dropping the already-cancelled and the deadline-expired — both
+        refunded, neither reaches a kernel."""
+        now = time.perf_counter()
+        live = []
+        for p in group:
+            if not p.future.set_running_or_notify_cancel():
+                self._drop_cancelled(p)
+                continue
+            if p.t_deadline is not None and now >= p.t_deadline:
+                self._refuse_expired(p, now)
+                continue
+            live.append(p)
+        return live
+
     def _flush(self, group: list[_Pending]) -> None:
         """Run one flushed bucket: dispatch every exact-n subgroup, then
         fetch (dispatch-ahead), resolving futures with responses.
@@ -181,9 +367,16 @@ class Coalescer:
         # leaked — server module docstring), post_flush one after the
         # answers landed but before the client read them
         chaos.point("coalescer.pre_flush")
+        chaos.fault("serve.flush_stall")
+        t0 = time.perf_counter()
+        group = self._claim_live(group)
+        if not group:
+            chaos.point("coalescer.post_flush")
+            return
         by_kernel: dict[tuple, list[_Pending]] = {}
         for p in group:
             by_kernel.setdefault(kernel_key(p.req), []).append(p)
+        browned = self.brownout is not None and self.brownout.active()
 
         launches = []
         for kkey, ps in by_kernel.items():
@@ -191,6 +384,11 @@ class Coalescer:
                 "serve.flush", parent=p.span.context,
                 family=kkey.family, n=kkey.n, batch_size=len(ps))
                 for p in ps]
+            if browned and len(ps) > 1:
+                # brownout: skip the batched machinery up front —
+                # small, predictable unbatched launches under pressure
+                launches.append((kkey, ps, None, fspans, None))
+                continue
             ksp = self.tracer.start_span(
                 "serve.kernel", parent=fspans[0],
                 family=kkey.family, n=kkey.n, batch_size=len(ps))
@@ -210,10 +408,13 @@ class Coalescer:
                 except Exception:
                     raw, batched = None, False
                     ksp.set(error="fetch")
-            ksp.end()
+            if ksp is not None:
+                ksp.end()
             if raw is None:
                 self._flush_unbatched(kkey, ps, fspans)
                 continue
+            if self.breaker is not None:
+                self.breaker.record_success(bucket_key(ps[0].req))
             self.stats.flushed(len(ps), batched=batched)
             t_done = time.perf_counter()
             for j, p in enumerate(ps):
@@ -230,6 +431,9 @@ class Coalescer:
                 p.span.set(latency_s=lat, batch_size=len(ps),
                            batched=batched)
                 p.span.end()
+        self.stats.observe_flush(time.perf_counter() - t0)
+        with self._cond:
+            self._observe_pressure_locked()
         chaos.point("coalescer.post_flush")
 
     def _dispatch(self, kkey, ps: list[_Pending]):
@@ -258,8 +462,12 @@ class Coalescer:
 
     def _flush_unbatched(self, kkey, ps: list[_Pending],
                          fspans=None) -> None:
-        """Batch-path failure fallback: serve each rider individually;
-        only requests that fail on their own fail."""
+        """Batch-path failure fallback (and the brownout fast path):
+        serve each rider individually; only requests that fail on
+        their own fail. Per-request outcomes feed the circuit breaker
+        — this is where consecutive kernel failures accumulate into a
+        bucket trip (serve.overload)."""
+        bkey = bucket_key(ps[0].req)
         for idx, p in enumerate(ps):
             sp = fspans[idx] if fspans else obs_trace._NULL_SPAN
             sp.set(degraded=True)
@@ -275,6 +483,8 @@ class Coalescer:
                 sp.end()
                 p.span.set(latency_s=lat, batch_size=1, batched=False)
                 p.span.end()
+                if self.breaker is not None:
+                    self.breaker.record_success(bkey)
             except Exception as e:
                 self.stats.failed()
                 p.future.set_exception(e)
@@ -282,11 +492,29 @@ class Coalescer:
                 sp.end()
                 p.span.set(error=type(e).__name__)
                 p.span.end()
+                if self.breaker is not None:
+                    self.breaker.record_failure(bkey)
 
     # -- lifecycle -------------------------------------------------------
     def close(self, timeout: float = 30.0) -> None:
-        """Stop admitting, drain pending requests, join the thread."""
+        """Stop admitting, refuse-drain pending requests, join the
+        flush thread; raises if the thread fails to stop.
+
+        Draining means REFUSING, not executing: each pending request
+        resolves to :class:`ServerClosedError` with its charge
+        refunded. Executing them would spend ε computing answers for
+        clients the shutdown is about to disconnect — the retrying
+        client re-runs them against a live replica instead."""
         with self._cond:
             self._closed = True
+            drained = [p for q in self._buckets.values() for p in q]
+            self._buckets.clear()
+            self._depth = 0
+            self.stats.set_queue_depth(0)
             self._cond.notify()
+        for p in drained:
+            self._refuse_closed(p)
         self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            raise RuntimeError(
+                f"coalescer flush thread did not stop within {timeout}s")

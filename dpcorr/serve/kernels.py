@@ -59,6 +59,7 @@ from typing import Callable
 
 import numpy as np
 
+from dpcorr import chaos
 from dpcorr.models.estimators.registry import serving_entry
 from dpcorr.serve.request import KernelKey
 from dpcorr.serve.stats import ServeStats
@@ -259,6 +260,12 @@ class KernelCache:
         (b,) numpy arrays."""
         import jax.numpy as jnp
 
+        # fault sites (chaos.FAULT_POINTS): a planned SimulatedFault
+        # here stands in for a lowering error / device OOM, a planned
+        # sleep for a kernel blowing its latency budget — both land
+        # before the launch so no padded lane ever half-executes
+        chaos.fault("serve.kernel_slow")
+        chaos.fault("serve.kernel")
         b = xs.shape[0]
         b_pad = pad_batch(b)
         fn, _ = self.get(kkey, b_pad)

@@ -285,16 +285,21 @@ class PrivacyLedger:
 
     def refund(self, charges: Mapping[str, float],
                trace_id: str | None = None,
-               charge_id: str | None = None) -> None:
+               charge_id: str | None = None,
+               reason: str | None = None) -> None:
         """Reverse a charge whose query provably never executed.
 
         Only valid when no kernel ran and nothing was released under
         the charged ε — the server uses it when the enqueue itself
-        refuses an already-charged request (queue backpressure), so
-        sustained overload cannot drain budgets to exhaustion with zero
-        queries served. The reversal is persisted like a charge; spends
-        clamp at zero so a stray refund can only err toward privacy
-        (over-counting), never under-counting.
+        refuses an already-charged request (queue backpressure) and
+        when an admitted request is shed before launch (deadline
+        expiry, priority eviction, shutdown drain, client abandonment
+        — serve.coalescer), so sustained overload cannot drain budgets
+        to exhaustion with zero queries served. The reversal is
+        persisted like a charge; spends clamp at zero so a stray refund
+        can only err toward privacy (over-counting), never
+        under-counting. ``reason`` stamps the audit event with which
+        shed path fired, so an audit replay can account every refund.
         """
         for party, eps in charges.items():
             if eps < 0.0:
@@ -313,6 +318,8 @@ class PrivacyLedger:
             self._publish_locked()
             if self.audit is not None:
                 detail = {} if charge_id is None else {"charge_id": charge_id}
+                if reason is not None:
+                    detail["reason"] = reason
                 self.audit.record("refund", charges, trace_id=trace_id,
                                   **detail)
 

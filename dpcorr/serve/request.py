@@ -88,6 +88,20 @@ class EstimateRequest:
     #: so a dropped-response retry is always safe without client
     #: bookkeeping.
     idempotency_key: str | None = None
+    #: shedding rank under overload: when the queue is at capacity the
+    #: coalescer evicts the pending request with the LOWEST (priority,
+    #: remaining-deadline) in favor of a strictly better newcomer, and
+    #: brownout mode refuses work below the server's priority floor.
+    #: Routing metadata like the party names — deliberately NOT part of
+    #: the request digest (same content at different priority is the
+    #: same query, same noise stream, same idempotency identity).
+    priority: int = 0
+    #: seconds this request is worth waiting for, measured from
+    #: admission. A request still queued when it expires is dropped
+    #: BEFORE its kernel launches and its charge refunded
+    #: (DeadlineExpiredError / HTTP 504) — late answers to departed
+    #: clients must not consume ε. ``None`` = no deadline.
+    deadline_s: float | None = None
 
     def __post_init__(self):
         if self.family not in FAMILIES:
@@ -108,6 +122,13 @@ class EstimateRequest:
         if not (self.eps1 > 0.0 and self.eps2 > 0.0):
             raise ValueError(f"eps must be positive, got "
                              f"({self.eps1}, {self.eps2})")
+        if not isinstance(self.priority, int) \
+                or isinstance(self.priority, bool):
+            raise ValueError("priority must be an int, got "
+                             f"{type(self.priority).__name__}")
+        if self.deadline_s is not None and not self.deadline_s > 0.0:
+            raise ValueError("deadline_s must be positive or None, "
+                             f"got {self.deadline_s}")
         object.__setattr__(self, "x", x)
         object.__setattr__(self, "y", y)
 

@@ -5,12 +5,16 @@ the load generator drive directly; :func:`serve_http` wraps it in a
 stdlib threaded HTTP front end for ``python -m dpcorr serve``:
 
 - ``POST /estimate`` — one request (JSON body; arrays as lists) →
-  estimate, or 403 (budget refused) / 429 (overloaded) / 400 (invalid).
+  estimate, or 403 (budget refused) / 429 (overloaded or shed, with
+  ``Retry-After``) / 503 (circuit breaker open, with ``Retry-After``)
+  / 504 (deadline expired before launch, charge refunded) / 400
+  (invalid).
 - ``GET /stats`` — live counters + ledger snapshot (serve.stats shape).
 - ``GET /healthz`` — liveness.
 - ``GET /readyz`` — readiness: 503 until the warmup signature set is
-  compiled and resident (serve.warmup), 200 after — so a balancer
-  never routes traffic onto a cold kernel cache.
+  compiled and resident (serve.warmup) and 503 again while any
+  circuit breaker is open, 200 otherwise — so a balancer never routes
+  traffic onto a cold kernel cache or a tripped replica.
 
 Admission order is the privacy invariant: the ledger is charged (and
 durably persisted) BEFORE the request is enqueued, so no query ever
@@ -53,6 +57,9 @@ import threading
 from collections import OrderedDict
 from concurrent.futures import Future
 
+from concurrent.futures import TimeoutError as _FuturesTimeout
+from concurrent.futures import InvalidStateError
+
 import numpy as np
 
 from dpcorr.obs import trace as obs_trace
@@ -61,7 +68,13 @@ from dpcorr.obs.metrics import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from dpcorr.serve.coalescer import Coalescer, ServerOverloadedError
 from dpcorr.serve.kernels import KernelCache
 from dpcorr.serve.ledger import BudgetExceededError, PrivacyLedger
-from dpcorr.serve.request import EstimateRequest, EstimateResponse
+from dpcorr.serve.overload import (
+    BrownoutController,
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExpiredError,
+)
+from dpcorr.serve.request import EstimateRequest, EstimateResponse, bucket_key
 from dpcorr.serve.stats import ServeStats
 from dpcorr.serve import warmup as warmup_mod
 from dpcorr.utils import rng
@@ -123,7 +136,14 @@ class DpcorrServer:
                  warmup_manifest: str | None = None,
                  aot: bool = True, export_dir: str | None = None,
                  warmup_autostart: bool = True,
-                 max_idempotency_cache: int = 1024):
+                 max_idempotency_cache: int = 1024,
+                 breaker_threshold: int = 5,
+                 breaker_reset_s: float = 30.0,
+                 shed_queue_frac: float = 0.75,
+                 flush_slo_s: float | None = None,
+                 brownout_enter_s: float = 0.5,
+                 brownout_exit_s: float = 2.0,
+                 brownout_min_priority: int = 0):
         self.seed = seed
         # obs wiring (ISSUE 2): one tracer spans the request lifecycle
         # (admit → charge → enqueue → flush → respond; default is the
@@ -141,11 +161,28 @@ class DpcorrServer:
                                  mode=batch_mode, max_kernels=max_kernels,
                                  aot=aot, export_dir=export_dir,
                                  tracer=self.tracer)
+        # overload resilience (ISSUE 8): the breaker fail-fasts a
+        # poisoned kernel bucket BEFORE ε is charged; brownout degrades
+        # execution (unbatched launches, low-priority rejection) under
+        # sustained pressure — both observed by the coalescer, which
+        # also holds the ledger so every pre-launch shed is refunded
+        self.brownout_min_priority = int(brownout_min_priority)
+        self.breaker = CircuitBreaker(fail_threshold=breaker_threshold,
+                                      reset_after_s=breaker_reset_s,
+                                      stats=self.stats)
+        self.brownout = BrownoutController(queue_frac=shed_queue_frac,
+                                           flush_slo_s=flush_slo_s,
+                                           enter_after_s=brownout_enter_s,
+                                           exit_after_s=brownout_exit_s,
+                                           stats=self.stats)
         self.coalescer = Coalescer(self.cache, self.stats,
                                    max_batch=max_batch,
                                    max_delay_s=max_delay_s,
                                    max_queue=max_queue,
-                                   tracer=self.tracer)
+                                   tracer=self.tracer,
+                                   ledger=self.ledger,
+                                   breaker=self.breaker,
+                                   brownout=self.brownout)
         self._master = None  # guarded by: _master_lock
         self._master_lock = threading.Lock()
         self._req_counter = itertools.count()
@@ -227,13 +264,17 @@ class DpcorrServer:
 
     def readiness(self) -> dict:
         """The ``GET /readyz`` body: ready only once the warmup set is
-        resident (or there was none)."""
+        resident (or there was none) AND no circuit breaker is open —
+        a replica with a tripped bucket reports 503 so a balancer
+        drains it while the breaker cools down and probes."""
+        breakers_open = self.breaker.any_open()
         with self._warm_lock:
-            return {"ready": self._ready.is_set(),
+            return {"ready": self._ready.is_set() and not breakers_open,
                     "state": self._warm_state,
                     "warmed": self._warm_done,
                     "warm_errors": self._warm_errors,
-                    "total": len(self._warm_set)}
+                    "total": len(self._warm_set),
+                    "breakers_open": breakers_open}
 
     def wait_ready(self, timeout: float | None = None) -> bool:
         """Block until the warmup set is resident (True) or ``timeout``
@@ -289,11 +330,17 @@ class DpcorrServer:
                 while len(self._idem_done) > self._idem_cap:
                     self._idem_done.popitem(last=False)
         if placeholder is not None:
-            # resolve outside the lock: waiter callbacks run inline
-            if err is None:
-                placeholder.set_result(fut.result())
-            else:
-                placeholder.set_exception(err)
+            # resolve outside the lock: waiter callbacks run inline.
+            # The placeholder may have been cancelled by an
+            # estimate() timeout — the response is still cached above,
+            # so a retry under the same key replays it.
+            try:
+                if err is None:
+                    placeholder.set_result(fut.result())
+                else:
+                    placeholder.set_exception(err)
+            except InvalidStateError:
+                pass
 
     # -- API -------------------------------------------------------------
     def submit(self, req: EstimateRequest) -> Future:
@@ -353,6 +400,20 @@ class DpcorrServer:
                 # inner spans parent implicitly under serve.admit (the
                 # thread's current span) — all on root's trace ID
                 try:
+                    # fail-fast gates run BEFORE the charge: a request
+                    # the breaker or the brownout floor refuses never
+                    # touches the ledger, so it trivially consumes zero ε
+                    self._overload_gate(req)
+                except CircuitOpenError:
+                    self.stats.refused("breaker")
+                    root.set(refused="breaker")
+                    raise
+                except ServerOverloadedError:
+                    self.stats.refused("brownout")
+                    self.stats.shed("admission")
+                    root.set(refused="brownout")
+                    raise
+                try:
                     with self.tracer.span("serve.ledger.charge"):
                         charges = self.ledger.charge_request(
                             req, trace_id=root.trace_id)
@@ -363,13 +424,15 @@ class DpcorrServer:
                 try:
                     with self.tracer.span("serve.enqueue"):
                         fut = self.coalescer.submit(req, key, seed,
-                                                    span=root)
+                                                    span=root,
+                                                    charges=charges)
                 except Exception:
                     # the enqueue refused (backpressure / closed): no
                     # kernel ran and nothing was released, so reversing
                     # the charge is safe — shed load must not consume ε
                     # (ledger.refund)
-                    self.ledger.refund(charges, trace_id=root.trace_id)
+                    self.ledger.refund(charges, trace_id=root.trace_id,
+                                       reason="overload")
                     root.set(refused="overload")
                     raise
         except Exception:
@@ -378,13 +441,47 @@ class DpcorrServer:
         self.stats.admitted()
         return fut
 
+    def _overload_gate(self, req: EstimateRequest) -> None:
+        """Pre-charge admission gates: the request's bucket breaker
+        (raises :class:`CircuitOpenError` while open) and the brownout
+        priority floor (raises :class:`ServerOverloadedError` for work
+        below ``brownout_min_priority`` while browned out)."""
+        self.breaker.allow(bucket_key(req))
+        # keep the brownout hysteresis fed from the gate itself: with
+        # every arrival refused pre-enqueue, nothing else would observe
+        # the (now calm) queue and brownout would never exit
+        self.coalescer.observe_pressure()
+        if self.brownout.active() \
+                and req.priority < self.brownout_min_priority:
+            raise ServerOverloadedError(
+                f"brownout: priority {req.priority} below the floor "
+                f"{self.brownout_min_priority} under sustained pressure",
+                retry_after_s=self.coalescer.retry_after_s())
+
     def estimate(self, req: EstimateRequest,
                  timeout: float | None = 60.0) -> EstimateResponse:
-        """Blocking convenience wrapper around :meth:`submit`."""
-        return self.submit(req).result(timeout=timeout)
+        """Blocking convenience wrapper around :meth:`submit`.
+
+        A timeout no longer leaks the in-flight request silently
+        (ISSUE 8 satellite): the pending future is cancelled — if the
+        cancel wins (the flush thread had not claimed it) the request
+        is withdrawn and the coalescer refunds its charge at claim
+        time; if it loses, the request was already launching and
+        completes unobserved (``detached`` — its spend stands, its
+        response still lands in the idempotency cache). Either way the
+        outcome is counted in the ``abandoned`` stat."""
+        fut = self.submit(req)
+        try:
+            return fut.result(timeout=timeout)
+        except _FuturesTimeout:
+            self.stats.abandoned("cancelled" if fut.cancel()
+                                 else "detached")
+            raise
 
     def stats_snapshot(self) -> dict:
-        return self.stats.snapshot(ledger_snapshot=self.ledger.snapshot())
+        snap = self.stats.snapshot(ledger_snapshot=self.ledger.snapshot())
+        snap["breaker"] = self.breaker.snapshot()
+        return snap
 
     def close(self) -> None:
         self.coalescer.close()
@@ -442,7 +539,11 @@ def _request_from_json(body: dict) -> EstimateRequest:
                   else None),
             idempotency_key=(str(body["idempotency_key"])
                              if body.get("idempotency_key") is not None
-                             else None))
+                             else None),
+            priority=int(body.get("priority", 0)),
+            deadline_s=(float(body["deadline_s"])
+                        if body.get("deadline_s") is not None
+                        else None))
     except KeyError as e:
         raise ValueError(f"missing required field {e.args[0]!r}") from e
 
@@ -461,13 +562,27 @@ def make_http_server(server: DpcorrServer, host: str = "127.0.0.1",
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
-        def _send(self, code: int, payload: dict) -> None:
+        def _send(self, code: int, payload: dict,
+                  headers: tuple = ()) -> None:
             blob = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(blob)))
+            for name, value in headers:
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(blob)
+
+        @staticmethod
+        def _retry_after(e) -> tuple:
+            """``Retry-After`` header (whole seconds, ceil'd so a
+            client never retries early) when the refusal carries an
+            estimate."""
+            ra = getattr(e, "retry_after_s", None)
+            if ra is None:
+                return ()
+            secs = max(1, int(ra) + (1 if ra % 1 else 0))
+            return (("Retry-After", str(secs)),)
 
         def _send_text(self, code: int, text: str,
                        content_type: str) -> None:
@@ -510,9 +625,21 @@ def make_http_server(server: DpcorrServer, host: str = "127.0.0.1",
             try:
                 resp = server.estimate(req)
             except BudgetExceededError as e:
-                self._send(403, {"error": str(e), "refused": "budget"})
+                # enough detail for the client to reconstruct the typed
+                # refusal (serve.client.HttpEstimateClient) — a budget
+                # refusal is terminal, retrying it is never right
+                self._send(403, {"error": str(e), "refused": "budget",
+                                 "party": e.party, "spent": e.spent,
+                                 "charge": e.charge, "budget": e.budget})
+            except DeadlineExpiredError as e:
+                self._send(504, {"error": str(e), "refused": "expired"},
+                           headers=self._retry_after(e))
+            except CircuitOpenError as e:
+                self._send(503, {"error": str(e), "refused": "breaker"},
+                           headers=self._retry_after(e))
             except ServerOverloadedError as e:
-                self._send(429, {"error": str(e), "refused": "overload"})
+                self._send(429, {"error": str(e), "refused": "overload"},
+                           headers=self._retry_after(e))
             except Exception as e:
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
             else:

@@ -34,6 +34,13 @@ from typing import Iterable, Sequence
 
 from dpcorr.obs.metrics import LATENCY_BUCKETS, Registry
 
+#: Label vocabularies the JSON snapshot enumerates (the Prometheus side
+#: discovers labels dynamically; the fixed JSON shape needs the list).
+SHED_REASONS = ("expired", "queue_evict", "cancelled", "closed",
+                "admission")
+REFUSED_REASONS = ("budget", "overload", "breaker", "brownout")
+ABANDONED_STAGES = ("cancelled", "detached")
+
 
 def percentiles(values: Iterable[float],
                 qs: Sequence[float] = (0.5, 0.99)) -> dict[str, float]:
@@ -107,8 +114,43 @@ class ServeStats:
             "dpcorr_serve_latency_seconds",
             "Admission-to-completion request latency",
             buckets=LATENCY_BUCKETS)
+        # -- overload resilience (ISSUE 8) --------------------------------
+        self._shed = r.counter(
+            "dpcorr_serve_shed_total",
+            "Requests shed by the overload layer before any kernel "
+            "launched (admitted ones get their charge refunded): "
+            "'expired' deadline passed in queue, "
+            "'queue_evict' displaced by a higher-(priority, urgency) "
+            "arrival, 'cancelled' client abandoned the future, "
+            "'closed' drained as refusals at shutdown, 'admission' "
+            "refused by the brownout priority floor",
+            labelnames=("reason",))
+        self._abandoned = r.counter(
+            "dpcorr_serve_abandoned_total",
+            "estimate() timeouts: 'cancelled' the pending request was "
+            "withdrawn before launch, 'detached' it was already "
+            "running and completes unobserved", labelnames=("stage",))
+        self._breaker_state = r.gauge(
+            "dpcorr_serve_breaker_state",
+            "Per-bucket circuit breaker state "
+            "(0=closed, 1=open, 2=half-open)",
+            labelnames=("family", "bucket"))
+        self._breaker_trans = r.counter(
+            "dpcorr_serve_breaker_transitions_total",
+            "Circuit breaker state transitions, by destination state",
+            labelnames=("to",))
+        self._brownout = r.gauge(
+            "dpcorr_serve_brownout_active",
+            "1 while the server is browned out (unbatched fallback + "
+            "low-priority rejection under sustained pressure)")
+        self._flush_ewma_g = r.gauge(
+            "dpcorr_serve_flush_ewma_seconds",
+            "Exponentially weighted moving average of flush duration "
+            "— the load-shedding pressure signal")
         self._lock = threading.Lock()
         self._latencies: deque[float] = deque(maxlen=reservoir)  # guarded by: _lock
+        self._flush_ewma_val: float | None = None  # guarded by: _lock
+        self._ewma_alpha = 0.2
 
     # -- legacy attribute reads (tests, report layer) --------------------
     @property
@@ -180,6 +222,45 @@ class ServeStats:
 
     def refused_overload(self) -> None:
         self._refused.inc(reason="overload")
+
+    def refused(self, reason: str) -> None:
+        """Generic admission refusal by reason — the overload layer's
+        reasons ('breaker', 'brownout', 'expired') land next to the
+        legacy 'budget'/'overload' series."""
+        self._refused.inc(reason=reason)
+
+    def shed(self, reason: str) -> None:
+        """An ADMITTED (charged) request dropped before launch, charge
+        refunded — see the counter help for the reason vocabulary."""
+        self._shed.inc(reason=reason)
+
+    def abandoned(self, stage: str) -> None:
+        """An ``estimate()`` timeout outcome: ``"cancelled"`` (pending
+        request withdrawn, ε refunded by the coalescer) or
+        ``"detached"`` (already running; completes unobserved)."""
+        self._abandoned.inc(stage=stage)
+
+    def breaker_state(self, family: str, bucket: str, code: int) -> None:
+        self._breaker_state.set(code, family=family, bucket=bucket)
+
+    def breaker_transition(self, to: str) -> None:
+        self._breaker_trans.inc(to=to)
+
+    def brownout(self, active: bool) -> None:
+        self._brownout.set(1.0 if active else 0.0)
+
+    def observe_flush(self, seconds: float) -> None:
+        """Feed one flush duration into the EWMA pressure signal."""
+        s = float(seconds)
+        with self._lock:
+            prev = self._flush_ewma_val
+            self._flush_ewma_val = s if prev is None else (
+                self._ewma_alpha * s + (1.0 - self._ewma_alpha) * prev)
+            self._flush_ewma_g.set(self._flush_ewma_val)
+
+    def flush_ewma(self) -> float:
+        with self._lock:
+            return self._flush_ewma_val or 0.0
 
     def failed(self, k: int = 1) -> None:
         self._failed.inc(k)
@@ -266,6 +347,15 @@ class ServeStats:
             # additive (the pre-ISSUE-2 keys above are a stable shape):
             # the bucketed view behind the /metrics histogram series
             "latency_histogram": self._latency.snapshot(),
+            # overload resilience (ISSUE 8), additive too
+            "refused": {r: int(self._refused.value(reason=r))
+                        for r in REFUSED_REASONS},
+            "shed": {r: int(self._shed.value(reason=r))
+                     for r in SHED_REASONS},
+            "abandoned": {s: int(self._abandoned.value(stage=s))
+                          for s in ABANDONED_STAGES},
+            "brownout_active": bool(self._brownout.value()),
+            "flush_ewma_s": self.flush_ewma(),
         }
         if ledger_snapshot is not None:
             snap["ledger"] = ledger_snapshot

@@ -1,0 +1,13 @@
+"""Violating fixture: ``budget-shed-missing-refund`` fires — a future
+is settled with a refusal exception but nothing in the function routes
+through a refund."""
+
+
+class ServerOverloadedError(Exception):
+    pass
+
+
+class Coalescer:
+    def refuse_evicted(self, pending):
+        pending.future.set_exception(  # budget-shed-missing-refund
+            ServerOverloadedError("queue full"))

@@ -1,0 +1,25 @@
+"""Clean fixture for ``budget-shed-missing-refund``: the shed site
+routes through a refund helper, and settling a future with a
+non-refusal exception (or a pre-built variable) is out of scope."""
+
+
+class ServerOverloadedError(Exception):
+    pass
+
+
+class Coalescer:
+    def _refund(self, pending, reason):
+        pass
+
+    def refuse_evicted(self, pending):
+        self._refund(pending, "queue_evict")
+        pending.future.set_exception(
+            ServerOverloadedError("queue full"))
+
+    def fail(self, pending, exc):
+        # a variable, not a refusal constructor: execution errors are
+        # answers, not sheds — the charge stands
+        pending.future.set_exception(exc)
+
+    def crash(self, pending):
+        pending.future.set_exception(RuntimeError("kernel failed"))

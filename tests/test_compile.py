@@ -224,7 +224,7 @@ def test_server_with_no_warmup_is_ready_immediately():
     try:
         assert srv.readiness() == {"ready": True, "state": "ready",
                                    "warmed": 0, "warm_errors": 0,
-                                   "total": 0}
+                                   "total": 0, "breakers_open": False}
         assert srv.wait_ready(timeout=0.1)
     finally:
         srv.close()

@@ -62,6 +62,17 @@ def test_budget_ok_fixture_is_clean():
     assert lint_fixture("serve/budget_ok.py") == []
 
 
+def test_budget_shed_bad_fixture_fires_shed_rule():
+    vs = lint_fixture("serve/budget_shed_bad.py")
+    assert fired(vs) == [
+        ("budget-shed-missing-refund", 12),
+    ]
+
+
+def test_budget_shed_ok_fixture_is_clean():
+    assert lint_fixture("serve/budget_shed_ok.py") == []
+
+
 def test_locks_bad_fixture_fires_reads_and_writes():
     vs = lint_fixture("serve/locks_bad.py")
     assert fired(vs) == [

@@ -24,6 +24,7 @@ from dpcorr.serve import (
     InProcessClient,
     KernelCache,
     PrivacyLedger,
+    ServerClosedError,
     ServerOverloadedError,
     ServeStats,
     make_http_server,
@@ -468,7 +469,7 @@ def test_idempotent_replay_no_second_charge_or_launch():
 def test_idempotent_inflight_duplicates_share_future():
     """A duplicate arriving while the original is still queued attaches
     to the same future: one charge, one launch, both callers answered."""
-    srv = DpcorrServer(budget=1e6, max_batch=1024, max_delay_s=30.0,
+    srv = DpcorrServer(budget=1e6, max_batch=2, max_delay_s=30.0,
                        shard="off")
     try:
         f1 = srv.submit(_mk_req(seed=11))
@@ -477,9 +478,11 @@ def test_idempotent_inflight_duplicates_share_future():
         assert f2 is f1
         assert srv.stats.idempotent_hits_inflight == 1
         assert srv.ledger.spent("party-x") == pytest.approx(spent)
+        # a second DISTINCT request fills the size-2 bucket → flush
+        srv.submit(_mk_req(seed=12, i=1))
+        assert f1.result(timeout=60) is f2.result(timeout=60)
     finally:
-        srv.close()  # drains the held bucket, resolving the future
-    assert f1.result(timeout=60) is f2.result(timeout=60)
+        srv.close()
 
 
 def test_idempotency_scoped_by_charged_parties():
@@ -569,8 +572,12 @@ def test_overload_shed_refunds_budget():
         assert srv.stats.requests_total == 2
     finally:
         srv.close()
+    # close() drains the still-queued requests as explicit refusals and
+    # reverses their charges — nothing silently hangs, nothing is spent
     for f in futs:
-        f.result(timeout=60)
+        with pytest.raises(ServerClosedError):
+            f.result(timeout=60)
+    assert srv.ledger.spent("party-x") == pytest.approx(0.0)
 
 
 def test_ledger_refund_reverses_and_clamps(tmp_path):
@@ -602,9 +609,12 @@ def test_coalescer_backpressure_sheds_load():
             srv.submit(_mk_req(seed=99))
         assert srv.stats.requests_refused_overload == 1
     finally:
-        srv.close()  # close drains: the 4 pending still get answers
+        srv.close()  # close drains: pending become refusals + refunds
     for f in futs:
-        assert f.result(timeout=60).rho_hat == f.result().rho_hat
+        with pytest.raises(ServerClosedError):
+            f.result(timeout=60)
+    assert srv.ledger.spent("party-x") == pytest.approx(0.0)
+    assert srv.stats.snapshot()["shed"]["closed"] == 4
 
 
 def test_server_assigns_seeds_when_unpinned():
@@ -882,16 +892,18 @@ def test_overload_refund_lands_in_audit():
         with pytest.raises(ServerOverloadedError):
             srv.submit(_mk_req(seed=1, i=1))
     finally:
-        srv.close()
-    fut.result(timeout=60)
+        srv.close()  # refuse-drains the queued request (second refund)
+    with pytest.raises(ServerClosedError):
+        fut.result(timeout=60)
     events = trail.events()
     kinds = [e["kind"] for e in events]
-    assert kinds == ["charge", "charge", "refund"]
+    assert kinds == ["charge", "charge", "refund", "refund"]
     assert events[1]["trace_id"] == events[2]["trace_id"]
+    assert [e.get("reason") for e in events[2:]] == ["overload", "closed"]
+    # every charge was reversed: replay lands on zero spend throughout
     spent = replay(events)
-    total = request_charges(_mk_req(seed=0))  # one surviving request
-    for p, s in total.items():
-        assert spent[p] == pytest.approx(s)
+    for p, s in spent.items():
+        assert s == pytest.approx(0.0)
 
 
 def test_ledger_registry_publishes_spend():

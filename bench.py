@@ -914,7 +914,48 @@ def _orchestrate(args) -> dict:
             out["detail"]["git_rev"] = rev
     except Exception:
         pass
+    _stamp_metrics_snapshot(out)
     return out
+
+
+def _stamp_metrics_snapshot(out: dict) -> None:
+    """Publish the headline through the obs metrics registry and stamp
+    the snapshot into the artifact, so the bench speaks the same metric
+    dialect as the server: a dashboard scraping ``dpcorr_*`` series and
+    a human reading the JSON see the same numbers. The degrade ladder's
+    outcome — healthy, ``tpu-probe-failed`` (never attempted),
+    ``tpu-init-failed`` (attempted and died), ``all-paths-failed`` —
+    becomes a labeled counter instead of a string only greppable out of
+    ``detail``."""
+    try:
+        from dpcorr.obs.metrics import Registry
+    except Exception:
+        return  # the artifact must survive a broken obs import
+    reg = Registry()
+    reg.gauge("dpcorr_bench_headline_reps_per_sec_chip",
+              "bench headline throughput (reps/sec/chip)",
+              ).set(float(out.get("value", 0.0)))
+    reg.gauge("dpcorr_bench_vs_baseline_ratio",
+              "headline / committed interactive baseline",
+              ).set(float(out.get("vs_baseline", 0.0)))
+    degraded = out.get("detail", {}).get("degraded")
+    g = reg.gauge("dpcorr_bench_degraded",
+                  "1 when the headline came from a degraded path",
+                  labelnames=("reason",))
+    g.set(1.0 if degraded else 0.0, reason=degraded or "none")
+    c = reg.counter("dpcorr_bench_tpu_probe_failures_total",
+                    "degrade-ladder outcomes by failure reason",
+                    labelnames=("reason",))
+    if degraded:
+        c.inc(reason=degraded)
+    values = {}
+    for m in reg.metrics():
+        for name, labels, value in m.samples():
+            values[f"{name}{labels}"] = value
+    out.setdefault("detail", {})["metrics"] = {
+        "values": values,
+        "exposition": reg.render(),
+    }
 
 
 if __name__ == "__main__":

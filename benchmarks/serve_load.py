@@ -103,6 +103,27 @@ def main() -> int:
     ap.add_argument("--slo-ms", dest="slo_ms", type=float, default=2000.0,
                     help="overload mode: server-side p99 latency SLO "
                          "for ADMITTED requests")
+    ap.add_argument("--cost", action="store_true",
+                    help="gate per-request cost attribution (ISSUE 9): "
+                         "the sum of per-request kernel-time shares "
+                         "must match the server-side kernel histogram "
+                         "total, and every refused request's cost "
+                         "record must show zero ε net of refunds")
+    ap.add_argument("--recorder", default=None, metavar="PATH",
+                    help="attach a flight recorder dumping to PATH; in "
+                         "--overload mode the phase-B breaker trip "
+                         "must produce a dump from which the faulting "
+                         "request's span chain + cost record "
+                         "reconstruct jax-free (the CI obs-smoke gate)")
+    ap.add_argument("--recorder-ab", dest="recorder_ab",
+                    action="store_true",
+                    help="interleaved A/B overhead gate: admitted-"
+                         "request p50 with the recorder's span capture "
+                         "attached must stay within 3%% of detached")
+    ap.add_argument("--fault", action="append", default=None,
+                    metavar="SPEC",
+                    help="install a chaos fault before traffic (spec "
+                         "as in `dpcorr serve --fault`; testing only)")
     args = ap.parse_args()
 
     import jax
@@ -128,6 +149,11 @@ def main() -> int:
         from dpcorr.obs import trace as obs_trace
 
         obs_trace.configure(args.trace)
+    if args.fault:
+        from dpcorr import chaos
+
+        for spec in args.fault:
+            chaos.install_fault(chaos.fault_from_spec(spec))
 
     warm_spec = None
     if args.warmup:
@@ -144,6 +170,12 @@ def main() -> int:
                        max_queue=4 * args.requests,
                        batch_mode=args.batch_mode,
                        warmup=warm_spec)
+    recorder = None
+    if args.recorder:
+        from dpcorr.obs.recorder import FlightRecorder
+
+        recorder = FlightRecorder(args.recorder)
+        srv.attach_recorder(recorder)
     cli = InProcessClient(srv)
 
     # wait-for-ready hook: what a load balancer polling GET /readyz
@@ -155,6 +187,7 @@ def main() -> int:
     readiness = cli.readiness()
 
     first_request_s = None
+    warm_probe_resp = None
     if warm_spec:
         # one isolated request before the load: on a warm server its
         # latency is queueing + execution only — no compile. Recorded
@@ -165,7 +198,7 @@ def main() -> int:
             rs0.randn(args.n).astype(np.float32), args.eps1, args.eps2,
             party_x="warm-x", party_y="warm-y", seed=999983)
         t_f0 = time.perf_counter()
-        srv.estimate(probe0, timeout=300)
+        warm_probe_resp = srv.estimate(probe0, timeout=300)
         first_request_s = time.perf_counter() - t_f0
 
     rs = np.random.RandomState(7)
@@ -302,7 +335,46 @@ def main() -> int:
         except BudgetExceededError:
             refused_at = q
             break
+    srv2_cost_records = list(srv2.costs.to_dict().values())
     srv2.close()
+
+    # -- ISSUE 9: per-request cost attribution gates ---------------------
+    cost_doc = None
+    if args.cost:
+        # (a) conservation: the per-request kernel-time shares (response
+        # metadata) sum to the server-side kernel histogram total — the
+        # same seconds, attributed instead of aggregated
+        hist_total = float(stats.get("kernel_histogram", {})
+                           .get("sum", 0.0))
+        cost_resps = [r for r in list(responses.values())
+                      + ([warm_probe_resp] if warm_probe_resp else [])
+                      if r.cost is not None]
+        share_total = sum(r.cost["kernel_s"] for r in cost_resps)
+        tol = 0.01 * max(hist_total, share_total) + 1e-4
+        conserved = (len(cost_resps) == len(responses)
+                     + (1 if warm_probe_resp else 0)
+                     and abs(share_total - hist_total) <= tol)
+        # (b) refusals are free: every refused request's cost record
+        # nets zero ε after refunds (the budget-refusal probe's server)
+        refused_records = [r for r in srv2_cost_records
+                           if any(str(e).startswith("refused")
+                                  for e in r["events"])]
+        refused_zero = (len(refused_records) >= 1 and all(
+            all(v == 0.0 for v in r["eps_net"].values())
+            for r in refused_records))
+        cost_doc = {
+            "responses_with_cost": len(cost_resps),
+            "kernel_share_total_s": round(share_total, 6),
+            "kernel_histogram_total_s": round(hist_total, 6),
+            "tolerance_s": round(tol, 6),
+            "conserved": conserved,
+            "refused_records": len(refused_records),
+            "refused_zero_eps": refused_zero,
+            "cost_aggregate": stats.get("costs"),
+        }
+
+    # -- ISSUE 9: recorder overhead A/B ----------------------------------
+    ab_doc = recorder_ab(args) if args.recorder_ab else None
 
     ok = {
         "completed": len(responses) == args.requests and not errors,
@@ -313,6 +385,17 @@ def main() -> int:
     }
     if args.trace:
         ok["traced"] = trace_spans is not None and trace_spans > 0
+    if cost_doc is not None:
+        ok["cost_attribution"] = (cost_doc["conserved"]
+                                  and cost_doc["refused_zero_eps"])
+    if ab_doc is not None:
+        ok["recorder_overhead"] = ab_doc["ok"]
+    recorder_doc = None
+    if recorder is not None:
+        # publish a final dump so the run always leaves an artifact
+        recorder.dump("cli", source="serve_load")
+        recorder_doc = {"path": args.recorder, "dumps": recorder.dumps,
+                        "reasons": recorder.reasons}
     warmup_doc = None
     if warm_spec:
         compiles_during_traffic = (stats["kernel_compiles"]
@@ -351,6 +434,9 @@ def main() -> int:
         "trace": args.trace,
         "trace_spans": trace_spans,
         "warmup": warmup_doc,
+        "cost": cost_doc,
+        "recorder_ab": ab_doc,
+        "recorder": recorder_doc,
         "ok": ok,
         "errors": errors[:5],
         "stats": stats,
@@ -361,6 +447,70 @@ def main() -> int:
         with open(args.out_json, "w") as f:
             f.write(blob)
     return 0 if all(ok.values()) else 1
+
+
+def recorder_ab(args) -> dict:
+    """Interleaved A/B recorder-overhead measurement (ISSUE 9
+    acceptance): one warmed server, alternating rounds with the flight
+    recorder's span capture attached ("on") vs detached ("off");
+    admitted-request p50 with capture on must stay within 3% (+1 ms
+    timing-jitter slack) of capture off. Interleaving round-robins the
+    arms so clock drift and cache effects land on both equally."""
+    from statistics import median
+
+    import numpy as np
+
+    from dpcorr.obs.recorder import FlightRecorder
+    from dpcorr.serve import DpcorrServer, EstimateRequest, InProcessClient
+
+    rounds, per_round = 16, 24
+    srv = DpcorrServer(budget=1e9, max_batch=per_round,
+                       max_delay_s=0.002,
+                       warmup=f"{args.family}:{args.n}:{args.eps1}:"
+                              f"{args.eps2}:auto")
+    srv.wait_ready(timeout=900)
+    cli = InProcessClient(srv)
+    rec = FlightRecorder(args.recorder or "serve_ab_flightrec.json")
+    rec.watch_registry(srv.stats.registry)
+    rs = np.random.RandomState(3)
+    lat: dict[str, list[float]] = {"on": [], "off": []}
+    seed = 1_000_000
+
+    def burst(sink: list[float] | None) -> None:
+        nonlocal seed
+        futs = []
+        for _ in range(per_round):
+            x = rs.randn(args.n).astype(np.float32)
+            y = rs.randn(args.n).astype(np.float32)
+            futs.append(cli.submit(EstimateRequest(
+                args.family, x, y, args.eps1, args.eps2,
+                party_x="ab-x", party_y="ab-y", seed=seed)))
+            seed += 1
+        for f in futs:
+            r = f.result(timeout=300)
+            if sink is not None:
+                sink.append(r.latency_s)
+
+    burst(None)  # throwaway: absorb any first-flush residue
+    for rd in range(rounds):
+        arm = "on" if rd % 2 == 0 else "off"
+        if arm == "on":
+            # exactly what attach/detach toggles on the request hot
+            # path: span production + the recorder's ring append
+            srv.tracer.add_observer(rec.record_span)
+        try:
+            burst(lat[arm])
+        finally:
+            if arm == "on":
+                srv.tracer.remove_observer(rec.record_span)
+    srv.close()
+    p50_on = median(lat["on"])
+    p50_off = median(lat["off"])
+    return {"rounds": rounds, "per_round": per_round,
+            "p50_on_s": round(p50_on, 6), "p50_off_s": round(p50_off, 6),
+            "overhead_ratio": round(p50_on / p50_off, 4)
+            if p50_off > 0 else None,
+            "ok": p50_on <= p50_off * 1.03 + 1e-3}
 
 
 def run_overload(args) -> int:
@@ -403,6 +553,12 @@ def run_overload(args) -> int:
                        # overload behaviour, not first-flush compiles
                        warmup=f"{args.family}:{n_obs}:{args.eps1}:"
                               f"{args.eps2}:auto")
+    recorder = None
+    if args.recorder:
+        from dpcorr.obs.recorder import FlightRecorder
+
+        recorder = FlightRecorder(args.recorder)
+        srv.attach_recorder(recorder)
     srv.wait_ready(timeout=900)
     rc = RetryingClient(
         InProcessClient(srv),
@@ -598,6 +754,49 @@ def run_overload(args) -> int:
     audit_matches = (set(replayed) == set(parties) and all(
         replayed[p] == parties[p]["spent"] for p in replayed))
 
+    # ---------------- ISSUE 9: flight-recorder end-to-end ---------------
+    # the phase-B breaker trip must have auto-dumped; from the artifact
+    # alone (jax-free: obs.recorder) the faulting request's span chain,
+    # CostRecord and ε trail must reconstruct, and the trail must agree
+    # with the ledger (an executed-then-failed request keeps its charge)
+    recorder_doc = None
+    if recorder is not None:
+        from dpcorr.obs.recorder import read_dump, reconstruct
+        fault_trace = None
+        chain: list[str] = []
+        cost_rec = eps_net = None
+        parse_ok = False
+        try:
+            dump = read_dump(args.recorder)
+            parse_ok = True
+            fault_spans = [
+                sp for sp in dump["spans"]
+                if sp.get("attrs", {}).get("error") == "SimulatedFault"
+                and sp.get("name") == "serve.request"]
+            if fault_spans:
+                fault_trace = fault_spans[-1]["trace_id"]
+                story = reconstruct(dump, fault_trace)
+                chain = [s["name"] for s in story["spans"]]
+                cost_rec = story["cost"]
+                eps_net = story["eps_net"]
+        except Exception as e:  # a broken artifact fails the gate below
+            failures.append(f"recorder: {type(e).__name__}: {e}")
+        eps_consistent = (
+            eps_net is not None
+            and eps_net.get("bk-x") == per_req["ld-x"]
+            and eps_net.get("bk-y") == per_req["ld-y"])
+        recorder_doc = {
+            "path": args.recorder,
+            "dumps": recorder.dumps,
+            "reasons": recorder.reasons,
+            "parse_ok": parse_ok,
+            "fault_trace_id": fault_trace,
+            "span_chain": chain,
+            "cost_record": cost_rec,
+            "eps_net": eps_net,
+            "eps_consistent": eps_consistent,
+        }
+
     ok = {
         "eventual_success": len(responses) == n_req and not failures,
         "overload_exercised": shed_total > 0
@@ -618,6 +817,14 @@ def run_overload(args) -> int:
         "idempotent_storm": storm_identical and idem_hits == 15
                             and storm_single_charge,
     }
+    if recorder_doc is not None:
+        ok["flight_recorder"] = (
+            recorder_doc["parse_ok"]
+            and "breaker_open" in recorder_doc["reasons"]
+            and recorder_doc["fault_trace_id"] is not None
+            and "serve.request" in recorder_doc["span_chain"]
+            and recorder_doc["cost_record"] is not None
+            and recorder_doc["eps_consistent"])
     out = {
         "metric": "serve_overload",
         "requests": n_req,
@@ -642,6 +849,7 @@ def run_overload(args) -> int:
                            "retry_after_s": probe_retry_after,
                            "fill_completed": fill_ok,
                            "refund_exact": rf_exact},
+        "flight_recorder": recorder_doc,
         "ok": ok,
         "errors": failures[:5],
         "stats": srv.stats_snapshot(),

@@ -23,7 +23,10 @@ Replaces the reference's "source the script" workflow (README.md:28-46):
                   budget`` replays a ledger audit trail into the
                   per-party ε-spend timeline; ``obs chrome`` converts a
                   span JSONL log to Chrome trace-event format for
-                  Perfetto
+                  Perfetto; ``obs dump`` replays a flight-recorder
+                  dump (span chains, cost records, ε trail) jax-free;
+                  ``obs top`` is the live ops console over a serve
+                  replica's /metrics + /stats
 - ``party``       one side of the two-party DP protocol over TCP
                   (docs/PROTOCOL.md): role y listens, role x connects;
                   each process holds one raw column and only DP
@@ -231,12 +234,66 @@ def cmd_serve(args):
     """Online serving: micro-batched DP-correlation queries behind a
     per-party ε-budget ledger (dpcorr.serve; docs/SERVING.md)."""
     from dpcorr.obs import trace as obs_trace
-    from dpcorr.serve import DpcorrServer, serve_http
+    from dpcorr.serve import serve_http
 
     if args.trace:
         # the process tracer, so grid/profiling spans from in-server
         # kernels land in the same log as the serve lifecycle spans
         obs_trace.configure(args.trace)
+    if args.fault:
+        # chaos faults at boot (testing only): the overload harness and
+        # operators drilling breaker/brownout behaviour on a replica
+        from dpcorr import chaos
+
+        for spec in args.fault:
+            chaos.install_fault(chaos.fault_from_spec(spec))
+    rec = None
+    if args.flight_recorder:
+        # the flight recorder captures into bounded rings from boot;
+        # SIGUSR2 dumps on demand (docs/OBSERVABILITY.md), on top of
+        # the automatic chaos/breaker/brownout triggers. The handler
+        # goes in BEFORE the (slow, jax-heavy) server build so a USR2
+        # during init dumps empty rings instead of killing the boot.
+        import signal
+
+        from dpcorr.obs.recorder import FlightRecorder
+
+        rec = FlightRecorder(args.flight_recorder)
+        signal.signal(signal.SIGUSR2,
+                      lambda signum, frame: rec.dump("sigusr2"))
+    server = _build_server(args)
+    if rec is not None:
+        server.attach_recorder(rec)
+    print(json.dumps({"serving": {"host": args.host, "port": args.port,
+                                  "budget": args.budget,
+                                  "ledger": args.ledger,
+                                  "max_batch": args.max_batch,
+                                  "max_delay_ms": args.max_delay_ms,
+                                  "batch_mode": args.batch_mode,
+                                  "trace": args.trace,
+                                  "audit": args.audit,
+                                  "warmup": server.readiness(),
+                                  "warmup_manifest": args.warmup_manifest,
+                                  "aot": args.aot,
+                                  "flight_recorder": args.flight_recorder,
+                                  "breaker": {
+                                      "threshold": args.breaker_threshold,
+                                      "reset_s": args.breaker_reset_s},
+                                  "brownout": {
+                                      "queue_frac": args.shed_queue_frac,
+                                      "flush_slo_ms": args.flush_slo_ms,
+                                      "enter_s": args.brownout_enter_s,
+                                      "exit_s": args.brownout_exit_s,
+                                      "min_priority":
+                                          args.brownout_min_priority},
+                                  "faults": args.fault}}),
+          flush=True)
+    serve_http(server, host=args.host, port=args.port)
+
+
+def _build_server(args):
+    from dpcorr.serve import DpcorrServer
+
     # exported-executable persistence rides the same opt-in cache dir as
     # the XLA persistent cache (DPCORR_COMPILE_CACHE; doctor reports it)
     # — one knob, one directory tree, both warm layers on or off together
@@ -247,14 +304,7 @@ def cmd_serve(args):
         cache_dir = resolve_cache_dir("cli")
         if cache_dir:
             export_dir = os.path.join(cache_dir, "exported")
-    if args.fault:
-        # chaos faults at boot (testing only): the overload harness and
-        # operators drilling breaker/brownout behaviour on a replica
-        from dpcorr import chaos
-
-        for spec in args.fault:
-            chaos.install_fault(chaos.fault_from_spec(spec))
-    server = DpcorrServer(
+    return DpcorrServer(
         budget=args.budget, ledger_path=args.ledger,
         seed=args.seed, max_batch=args.max_batch,
         max_delay_s=args.max_delay_ms / 1000.0,
@@ -271,31 +321,6 @@ def cmd_serve(args):
         brownout_enter_s=args.brownout_enter_s,
         brownout_exit_s=args.brownout_exit_s,
         brownout_min_priority=args.brownout_min_priority)
-    print(json.dumps({"serving": {"host": args.host, "port": args.port,
-                                  "budget": args.budget,
-                                  "ledger": args.ledger,
-                                  "max_batch": args.max_batch,
-                                  "max_delay_ms": args.max_delay_ms,
-                                  "batch_mode": args.batch_mode,
-                                  "trace": args.trace,
-                                  "audit": args.audit,
-                                  "warmup": server.readiness(),
-                                  "warmup_manifest": args.warmup_manifest,
-                                  "aot": args.aot,
-                                  "export_dir": export_dir,
-                                  "breaker": {
-                                      "threshold": args.breaker_threshold,
-                                      "reset_s": args.breaker_reset_s},
-                                  "brownout": {
-                                      "queue_frac": args.shed_queue_frac,
-                                      "flush_slo_ms": args.flush_slo_ms,
-                                      "enter_s": args.brownout_enter_s,
-                                      "exit_s": args.brownout_exit_s,
-                                      "min_priority":
-                                          args.brownout_min_priority},
-                                  "faults": args.fault}}),
-          flush=True)
-    serve_http(server, host=args.host, port=args.port)
 
 
 def cmd_obs_budget(args):
@@ -331,6 +356,65 @@ def cmd_obs_chrome(args):
     n = len(read_spans(args.trace))
     write_chrome_trace(args.trace, args.out)
     print(f"wrote {args.out} ({n} spans)")
+
+
+def cmd_obs_dump(args):
+    """Replay a flight-recorder dump jax-free (docs/OBSERVABILITY.md):
+    summary mode lists what the rings held at dump time; ``--trace-id``
+    reconstructs one request's full span chain, cost record and
+    ledger-consistent ε trail from the dump alone."""
+    from dpcorr.obs.recorder import read_dump, reconstruct
+
+    dump = read_dump(args.path)
+    if args.trace_id:
+        rc = reconstruct(dump, args.trace_id)
+        if args.json:
+            print(json.dumps(rc, indent=2))
+            return
+        print(f"trace {args.trace_id} ({len(rc['spans'])} spans)")
+        for s in rc["spans"]:
+            dur = s.get("dur_s")
+            dur_txt = f"{dur * 1e3:9.3f} ms" if dur is not None else \
+                "      open"
+            print(f"  {dur_txt}  {s['name']}")
+        if rc["cost"] is not None:
+            print("cost: " + json.dumps(rc["cost"]))
+        if rc["audit"]:
+            print(f"audit: {len(rc['audit'])} events, "
+                  f"eps_net={json.dumps(rc['eps_net'])}")
+        return
+    summary = {"reason": dump["reason"], "ts": dump["ts"],
+               "detail": dump.get("detail", {}),
+               "spans": len(dump["spans"]),
+               "audit_events": len(dump["audit"]),
+               "log_lines": len(dump["logs"]),
+               "metric_samples": len(dump.get("metric_samples", [])),
+               "cost_records": len(dump["costs"]),
+               "trace_ids": sorted({s.get("trace_id")
+                                    for s in dump["spans"]
+                                    if s.get("trace_id")})}
+    if args.json:
+        print(json.dumps(summary, indent=2))
+        return
+    print(f"flight-recorder dump: reason={summary['reason']} "
+          f"detail={json.dumps(summary['detail'])}")
+    print(f"  {summary['spans']} spans over "
+          f"{len(summary['trace_ids'])} traces, "
+          f"{summary['audit_events']} audit events, "
+          f"{summary['log_lines']} log lines, "
+          f"{summary['cost_records']} cost records")
+    for tid in summary["trace_ids"][:20]:
+        print(f"  trace {tid}")
+    if len(summary["trace_ids"]) > 20:
+        print(f"  ... {len(summary['trace_ids']) - 20} more")
+
+
+def cmd_obs_top(args):
+    """Live ops console over a serve replica's /metrics + /stats."""
+    from dpcorr.obs.console import run_top
+
+    raise SystemExit(run_top(args.url, interval_s=args.interval,
+                             once=args.once))
 
 
 def _party_columns(args, n: int):
@@ -868,6 +952,13 @@ def main(argv=None):
                      help="install a chaos fault before serving, e.g. "
                           "'point=serve.kernel,mode=fail,times=3' "
                           "(repeatable; testing only — dpcorr.chaos)")
+    ps_.add_argument("--flight-recorder", dest="flight_recorder",
+                     default=None, metavar="PATH",
+                     help="flight-recorder dump path: bounded in-memory "
+                          "rings of recent spans/audit/logs/metrics, "
+                          "dumped atomically here on chaos crashes, "
+                          "breaker trips, brownout transitions and "
+                          "SIGUSR2; replay with `dpcorr obs dump PATH`")
     ps_.set_defaults(fn=cmd_serve)
 
     po_ = sub.add_parser("obs", help="telemetry tooling: audit-trail "
@@ -889,6 +980,24 @@ def main(argv=None):
     poc.add_argument("--out", required=True,
                      help="output Chrome trace JSON path")
     poc.set_defaults(fn=cmd_obs_chrome, platform=None, jax_free=True)
+    pod = obs_sub.add_parser("dump", help="replay a flight-recorder "
+                             "dump: span chains, cost records and the "
+                             "ε trail, reconstructed jax-free")
+    pod.add_argument("path", help="dump path (serve --flight-recorder)")
+    pod.add_argument("--trace-id", dest="trace_id", default=None,
+                     help="reconstruct one request's span chain + "
+                          "cost record + ε trail")
+    pod.add_argument("--json", action="store_true")
+    pod.set_defaults(fn=cmd_obs_dump, platform=None, jax_free=True)
+    pot = obs_sub.add_parser("top", help="live ops console over a "
+                             "serve replica's /metrics + /stats")
+    pot.add_argument("--url", default="http://127.0.0.1:8321",
+                     help="serve base URL")
+    pot.add_argument("--interval", type=float, default=2.0,
+                     help="refresh seconds")
+    pot.add_argument("--once", action="store_true",
+                     help="render one frame and exit (scripting/CI)")
+    pot.set_defaults(fn=cmd_obs_top, platform=None, jax_free=True)
     def _add_spec_flags(p):
         p.add_argument("--family", default="ni_sign",
                        choices=["ni_sign", "int_sign", "ni_subg",

@@ -44,8 +44,8 @@ def add_arguments(ap: argparse.ArgumentParser) -> None:
                          "the new baseline and exit 0")
     ap.add_argument("--rules", default=None,
                     help="comma-separated checker families to run "
-                         "(rng,budget,locks,purity,rawdata; "
-                         "default: all)")
+                         "(rng,budget,locks,purity,rawdata,sync,"
+                         "metrics; default: all)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     ap.add_argument("--strict", action="store_true",

@@ -9,6 +9,7 @@ derive from this list.
 
 from dpcorr.analysis.rules.budget import BudgetChecker
 from dpcorr.analysis.rules.locks import LockChecker
+from dpcorr.analysis.rules.metrics import MetricsChecker
 from dpcorr.analysis.rules.purity import PurityChecker
 from dpcorr.analysis.rules.rawdata import RawDataChecker
 from dpcorr.analysis.rules.rng import RngChecker
@@ -16,4 +17,4 @@ from dpcorr.analysis.rules.sync import SyncChecker
 
 #: registration order is report order for equal (path, line).
 ALL_CHECKERS = (RngChecker, BudgetChecker, LockChecker, PurityChecker,
-                RawDataChecker, SyncChecker)
+                RawDataChecker, SyncChecker, MetricsChecker)

@@ -187,6 +187,25 @@ def plan_from_env(env: str = "DPCORR_CHAOS") -> ChaosPlan | None:
 _lock = threading.Lock()
 _plan: ChaosPlan | None = None
 _counts: dict[str, int] = {}
+_crash_hooks: list = []  # guarded by: _lock
+
+
+def on_crash(fn) -> None:
+    """Register ``fn(point_name)`` to run just BEFORE a planned kill
+    (both modes — ahead of ``os._exit`` and ahead of the raise). The
+    flight recorder's last-gasp dump hook: ``exit`` mode skips every
+    ``finally``/atexit on purpose, so anything that must survive the
+    kill has to happen here. Hooks are best-effort — an exception in
+    one must not save the victim."""
+    with _lock:
+        if fn not in _crash_hooks:
+            _crash_hooks.append(fn)
+
+
+def remove_crash_hook(fn) -> None:
+    with _lock:
+        if fn in _crash_hooks:
+            _crash_hooks.remove(fn)
 
 
 def install(plan: ChaosPlan | None) -> None:
@@ -228,6 +247,12 @@ def point(name: str) -> None:
         _counts[name] = _counts.get(name, 0) + 1
         if _counts[name] != plan.hit:
             return
+        hooks = list(_crash_hooks)
+    for fn in hooks:
+        try:
+            fn(name)
+        except Exception:
+            pass  # a broken hook must not save the victim
     if plan.mode == "exit":
         os._exit(EXIT_CODE)
     raise SimulatedCrash(name)

@@ -18,6 +18,16 @@ package is the one spine they now share:
   charge/refund/refusal as a structured event carrying the request's
   trace ID; ``python -m dpcorr obs budget`` replays it into the
   per-party ε-spend timeline.
+- :mod:`recorder` — the flight recorder (ISSUE 9): bounded in-memory
+  rings of recent spans, audit events, log lines and metric samples,
+  dumped atomically on crash points, breaker trips, brownout
+  transitions, SIGUSR2 and ``dpcorr obs dump`` — replayable jax-free.
+- :mod:`cost`    — per-request cost attribution: the CostRecord each
+  admission accumulates (queue/compile/kernel seconds, retries, shed
+  events, ε charged/refunded per party) plus the exemplar store that
+  links latency-histogram buckets to trace IDs.
+- :mod:`console` — the live ops console behind ``dpcorr obs top``:
+  a jax-free terminal view over ``/metrics`` + ``/stats``.
 
 See docs/OBSERVABILITY.md for the span model, metric names and the
 audit-trail format.
@@ -29,6 +39,11 @@ from dpcorr.obs.audit import (  # noqa: F401
     replay,
     timeline,
 )
+from dpcorr.obs.cost import (  # noqa: F401
+    CostRecord,
+    CostRegistry,
+    ExemplarStore,
+)
 from dpcorr.obs.metrics import (  # noqa: F401
     CONTENT_TYPE,
     LATENCY_BUCKETS,
@@ -38,6 +53,11 @@ from dpcorr.obs.metrics import (  # noqa: F401
     Registry,
     default_registry,
     parse_exposition,
+)
+from dpcorr.obs.recorder import (  # noqa: F401
+    FlightRecorder,
+    read_dump,
+    reconstruct,
 )
 from dpcorr.obs.trace import (  # noqa: F401
     Span,

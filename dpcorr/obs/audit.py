@@ -46,6 +46,7 @@ class AuditTrail:
         self._seq = 0  # guarded by: _lock
         self._mem: list[dict] = []  # guarded by: _lock
         self._fh = None  # guarded by: _lock
+        self._observers: list = []  # guarded by: _lock
         if path is not None:
             d = os.path.dirname(path)
             if d:
@@ -73,7 +74,24 @@ class AuditTrail:
                 self._fh.write(json.dumps(ev) + "\n")
             else:
                 self._mem.append(ev)
+            observers = list(self._observers)
+        # outside the trail lock: the flight recorder takes its own
+        # ring lock and must not nest under ours
+        for fn in observers:
+            fn(ev)
         return ev
+
+    def add_observer(self, fn) -> None:
+        """Register ``fn(event_dict)`` to receive every recorded event
+        (the flight recorder's audit ring)."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers.append(fn)
+
+    def remove_observer(self, fn) -> None:
+        with self._lock:
+            if fn in self._observers:
+                self._observers.remove(fn)
 
     def events(self) -> list[dict]:
         """The in-memory events (memory-backed trails only; for a

@@ -1,0 +1,171 @@
+"""Live ops console: ``dpcorr obs top`` — a terminal view of a server.
+
+Scrapes the serving front end's own endpoints (``GET /stats`` for the
+structured snapshot, ``GET /metrics`` for the exposition series — the
+same two sources every dashboard would use, so what the console shows
+is exactly what production monitoring sees) and renders a compact
+refreshing frame:
+
+- queue depth / max-queue pressure and the flush EWMA;
+- circuit-breaker state per tripped bucket and the brownout latch;
+- SLO burn rate (the rolling-window gauges serve.stats publishes:
+  fraction of recent requests over the latency SLO);
+- compile activity (kernel compiles / hits / dedup, cache size);
+- latency p50/p99 with the exemplar trace IDs linking slow buckets to
+  concrete requests;
+- top-ε principals — the parties spending budget fastest, from the
+  ledger snapshot.
+
+``--once`` prints a single frame and exits (the CI smoke); otherwise
+the frame redraws every ``--interval`` seconds until interrupted.
+
+stdlib-only and jax-free on purpose: this runs on an operator laptop
+against a remote server, under the CLI's ``jax_free`` dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+from dpcorr.obs.metrics import parse_exposition
+
+#: ANSI clear-screen + home — what the refresh loop prefixes frames with.
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def scrape(base_url: str, timeout_s: float = 5.0) -> dict:
+    """One poll: ``{"stats": <//stats JSON>, "metrics": {series: value}}``.
+    Raises ``urllib.error.URLError`` / ``ValueError`` on an unreachable
+    or non-conforming server — the caller decides whether to retry."""
+    base = base_url.rstrip("/")
+    with urllib.request.urlopen(f"{base}/stats",
+                                timeout=timeout_s) as resp:
+        stats = json.loads(resp.read().decode("utf-8"))
+    with urllib.request.urlopen(f"{base}/metrics",
+                                timeout=timeout_s) as resp:
+        metrics = parse_exposition(resp.read().decode("utf-8"))
+    return {"stats": stats, "metrics": metrics}
+
+
+def _fmt_eps(v: float) -> str:
+    return f"{v:.4g}"
+
+
+def top_parties(ledger_snapshot: dict | None, k: int = 5) -> list[tuple]:
+    """(party, spent, budget) rows, highest spend first."""
+    if not ledger_snapshot:
+        return []
+    parties = ledger_snapshot.get("parties", {})
+    rows = []
+    for name, rec in parties.items():
+        if isinstance(rec, dict):
+            rows.append((name, float(rec.get("spent", 0.0)),
+                         float(rec.get("budget", 0.0))))
+        else:
+            rows.append((name, float(rec), 0.0))
+    rows.sort(key=lambda r: r[1], reverse=True)
+    return rows[:k]
+
+
+def render_frame(stats: dict, metrics: dict,
+                 now: float | None = None) -> str:
+    """One console frame from a scrape — pure (canned-dict testable)."""
+    lines = []
+    ts = time.strftime("%H:%M:%S",
+                       time.localtime(now if now is not None
+                                      else time.time()))
+    lines.append(f"dpcorr obs top  ·  {ts}")
+    lines.append("-" * 64)
+
+    depth = stats.get("queue_depth", 0)
+    ewma = stats.get("flush_ewma_s", 0.0)
+    lines.append(f"queue depth : {depth:>6}    flush ewma: {ewma * 1e3:8.2f} ms")
+
+    brk = stats.get("breaker", {})
+    tripped = brk.get("tripped_buckets", {})
+    state = ("OK" if not tripped else
+             f"{brk.get('open', 0)} open / {brk.get('half_open', 0)} half-open")
+    lines.append(f"breaker     : {state}")
+    for bucket, st in sorted(tripped.items()):
+        lines.append(f"              {bucket}: {st}")
+    lines.append(f"brownout    : "
+                 f"{'ACTIVE' if stats.get('brownout_active') else 'off'}")
+
+    burn = stats.get("slo", {})
+    if burn:
+        lines.append(
+            f"slo burn    : {burn.get('burn_rate', 0.0) * 100:6.2f}% of "
+            f"{burn.get('window_requests', 0)} req over "
+            f"{burn.get('slo_s', 0.0) * 1e3:g} ms "
+            f"(window {burn.get('window_s', 0.0):g}s)")
+
+    lines.append(
+        f"kernels     : {stats.get('kernel_compiles', 0)} compiles / "
+        f"{stats.get('kernel_hits', 0)} hits / "
+        f"{stats.get('kernel_compile_dedup', 0)} dedup   "
+        f"cache {stats.get('kernel_cache_size', 0)}")
+
+    lat = stats.get("latency_s", {})
+    if lat:
+        lines.append(f"latency     : p50 {lat.get('p50', 0.0) * 1e3:8.2f} ms"
+                     f"   p99 {lat.get('p99', 0.0) * 1e3:8.2f} ms")
+    ex = stats.get("exemplars", {})
+    if ex:
+        slowest = max(ex.items(),
+                      key=lambda kv: kv[1].get("value", 0.0))
+        lines.append(f"exemplar    : le={slowest[0]} "
+                     f"trace={slowest[1].get('trace_id')} "
+                     f"({slowest[1].get('value', 0.0) * 1e3:.2f} ms)")
+
+    costs = stats.get("costs", {})
+    if costs:
+        lines.append(
+            f"cost window : {costs.get('records', 0)} records   "
+            f"kernel {costs.get('kernel_s', 0.0):.3f}s   "
+            f"queue {costs.get('queue_wait_s', 0.0):.3f}s   "
+            f"compile {costs.get('compile_wait_s', 0.0):.3f}s")
+
+    lines.append(
+        f"traffic     : {stats.get('requests_total', 0)} admitted   "
+        f"{sum(stats.get('refused', {}).values())} refused   "
+        f"{sum(stats.get('shed', {}).values())} shed   "
+        f"{stats.get('requests_failed', 0)} failed")
+
+    rows = top_parties(stats.get("ledger"))
+    if rows:
+        lines.append("top ε       : " + "   ".join(
+            f"{name}={_fmt_eps(spent)}"
+            + (f"/{_fmt_eps(budget)}" if budget else "")
+            for name, spent, budget in rows))
+    return "\n".join(lines)
+
+
+def run_top(url: str, interval_s: float = 2.0, once: bool = False,
+            out=None, max_frames: int | None = None) -> int:
+    """The ``dpcorr obs top`` loop. Returns a process exit code: 0 on
+    any successful frame, 1 when the first scrape fails (the CI smoke
+    treats an unreachable server as a failure, not a hang)."""
+    emit = out if out is not None else print
+    frames = 0
+    while True:
+        try:
+            polled = scrape(url)
+        except (urllib.error.URLError, ValueError, OSError) as e:
+            if frames == 0:
+                emit(f"obs top: cannot scrape {url}: {e}")
+                return 1
+            emit(f"obs top: scrape failed ({e}); retrying")
+            time.sleep(interval_s)
+            continue
+        frame = render_frame(polled["stats"], polled["metrics"])
+        if once:
+            emit(frame)
+            return 0
+        emit(_CLEAR + frame)
+        frames += 1
+        if max_frames is not None and frames >= max_frames:
+            return 0
+        time.sleep(interval_s)

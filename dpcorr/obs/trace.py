@@ -116,18 +116,38 @@ _NULL_SPAN = _NullSpan()
 
 
 class Tracer:
-    """JSONL span writer. ``path=None`` disables (null spans)."""
+    """JSONL span writer. ``path=None`` disables (null spans) — unless
+    an observer attaches (:meth:`add_observer`), which enables span
+    production without a file so the flight recorder can capture spans
+    on servers that never asked for a span log."""
 
     def __init__(self, path: str | None = None):
         self.path = path
         self.enabled = path is not None
         self._lock = threading.Lock()
         self._fh = None  # guarded by: _lock
+        self._observers: list = []  # guarded by: _lock
         if self.enabled:
             d = os.path.dirname(path)
             if d:
                 os.makedirs(d, exist_ok=True)
             self._fh = open(path, "a", buffering=1)  # line-buffered
+
+    def add_observer(self, fn) -> None:
+        """Register ``fn(span_dict)`` to receive every finished span
+        (the flight recorder's span ring). Attaching enables the tracer
+        even with no span file."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers.append(fn)
+        self.enabled = True
+
+    def remove_observer(self, fn) -> None:
+        with self._lock:
+            if fn in self._observers:
+                self._observers.remove(fn)
+            if self._fh is None and not self._observers:
+                self.enabled = False
 
     def start_span(self, name: str, parent: SpanContext | Span | None = None,
                    trace_id: str | None = None, **attrs) -> Span:
@@ -167,21 +187,27 @@ class Tracer:
             sp.end()
 
     def _write(self, sp: Span, dur_s: float) -> None:
-        line = json.dumps({
+        obj = {
             "name": sp.name, "trace_id": sp.trace_id,
             "span_id": sp.span_id, "parent_id": sp.parent_id,
             "ts": sp.t_wall, "dur_s": dur_s, "thread": sp._tid,
             "attrs": sp.attrs,
-        })
+        }
         with self._lock:
             if self._fh is not None:
-                self._fh.write(line + "\n")
+                self._fh.write(json.dumps(obj) + "\n")
+            observers = list(self._observers)
+        # observers run outside the tracer lock: the recorder takes its
+        # own ring lock and must not nest under ours
+        for fn in observers:
+            fn(obj)
 
     def close(self) -> None:
         with self._lock:
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
+            if not self._observers:
                 self.enabled = False
 
 

@@ -40,6 +40,7 @@ import numpy as np
 
 from dpcorr import chaos
 from dpcorr.obs import from_wire_headers, tracer, wire_headers
+from dpcorr.obs import recorder as obs_recorder
 from dpcorr.protocol.gate import ReleaseGate
 from dpcorr.protocol.journal import SessionJournal
 from dpcorr.protocol.messages import (
@@ -603,6 +604,18 @@ class Party:
                 result = self._run_releaser()
             else:
                 result = self._run_finisher()
+        except (ProtocolError, ProtocolRefused):
+            raise  # typed protocol outcomes are expected, not dumped
+        except Exception as e:
+            # an unhandled session failure triggers a flight-recorder
+            # dump (when one is installed — obs.recorder.trigger is a
+            # no-op otherwise) so the postmortem has the span chain and
+            # recent logs without re-running the session
+            obs_recorder.trigger(
+                "party_unhandled", role=self.role,
+                session=self.spec.session, error=type(e).__name__,
+                detail=str(e))
+            raise
         finally:
             if self._span is not None:
                 self._span.end()

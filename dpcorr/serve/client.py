@@ -150,6 +150,12 @@ class RetryingClient:
                 self._count("successes")
                 if attempt > 1:
                     self._count("recovered")
+                    if isinstance(resp.cost, dict):
+                        # client-side cost annotation: the server only
+                        # sees attempts, the retry count is ours to
+                        # stamp (the dict rides the frozen dataclass)
+                        resp.cost["retries"] = \
+                            resp.cost.get("retries", 0) + attempt - 1
                 return resp
             if attempt == self.policy.max_attempts:
                 break
@@ -221,7 +227,7 @@ class HttpEstimateClient:
             rho_hat=body["rho_hat"], ci_low=body["ci_low"],
             ci_high=body["ci_high"], batched=body["batched"],
             batch_size=body["batch_size"], latency_s=body["latency_s"],
-            seed=body["seed"])
+            seed=body["seed"], cost=body.get("cost"))
 
     @staticmethod
     def _refusal(e: urllib.error.HTTPError) -> Exception:

@@ -58,13 +58,15 @@ rule (analysis.rules.budget) checks exactly this pairing.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 
 import numpy as np
 
 from dpcorr import chaos
+from dpcorr.obs import recorder as obs_recorder
 from dpcorr.obs import trace as obs_trace
 from dpcorr.serve.kernels import KernelCache
 from dpcorr.serve.overload import (
@@ -117,6 +119,12 @@ class _Pending:
     t_deadline: float | None = None
     #: what admission charged, so a pre-launch drop can refund exactly
     charges: dict | None = None
+    #: the request's CostRecord (obs.cost), opened at admission and
+    #: filled in here: queue wait at the claim boundary, compile wait
+    #: and an even share of kernel time at launch, shed events + ε
+    #: refunds on every refusal path. None when the server runs
+    #: without cost attribution.
+    cost: object = None
 
     def rank(self, now: float) -> tuple:
         """Eviction order: cancelled futures are free victims, then
@@ -158,13 +166,15 @@ class Coalescer:
 
     # -- admission -------------------------------------------------------
     def submit(self, req: EstimateRequest, key, seed: int,
-               span=None, charges: dict | None = None) -> Future:
+               span=None, charges: dict | None = None,
+               cost=None) -> Future:
         """Enqueue one admitted request; resolves to EstimateResponse.
         ``span`` is the request's root span (or None/null when
         untraced); it rides the queue so the flush thread can parent
         its spans under the same trace ID. ``charges`` is what
         admission charged the ledger — carried so any pre-launch shed
-        can refund it."""
+        can refund it. ``cost`` is the request's CostRecord, filled in
+        on the flush thread."""
         fut: Future = Future()
         now = time.perf_counter()
         t_deadline = (now + req.deadline_s if req.deadline_s is not None
@@ -172,7 +182,7 @@ class Coalescer:
         p = _Pending(req, key, seed, fut, now,
                      span if span is not None else obs_trace._NULL_SPAN,
                      priority=req.priority, t_deadline=t_deadline,
-                     charges=charges)
+                     charges=charges, cost=cost)
         victim = None
         retry_after = None
         with self._cond:
@@ -251,6 +261,10 @@ class Coalescer:
         if self.ledger is not None and p.charges:
             self.ledger.refund(p.charges, trace_id=p.span.trace_id,
                                reason=reason)
+        if p.cost is not None:
+            p.cost.event(reason)
+            if p.charges:
+                p.cost.refund(p.charges, reason)
 
     def _refuse_evicted(self, p: _Pending,
                         retry_after: float | None) -> None:
@@ -332,7 +346,35 @@ class Coalescer:
                 self._depth -= n_taken
                 self.stats.set_queue_depth(self._depth)
             for group in ready:
-                self._flush(group)
+                try:
+                    self._flush(group)
+                except Exception as e:
+                    # a bug in the flush path must not kill the flush
+                    # thread (every later request would hang): fail the
+                    # group's unresolved futures, dump the flight
+                    # recorder, keep serving. SimulatedCrash is a
+                    # BaseException on purpose — chaos kills still kill.
+                    logging.getLogger("dpcorr.serve").exception(
+                        "unhandled error flushing group of %d",
+                        len(group))
+                    obs_recorder.trigger(
+                        "coalescer_unhandled",
+                        error=type(e).__name__, detail=str(e),
+                        group_size=len(group))
+                    for p in group:
+                        if p.future.done():
+                            continue  # resolved before the error
+                        self.stats.failed()
+                        if p.cost is not None:
+                            p.cost.event(
+                                f"flush_error:{type(e).__name__}")
+                        p.future.set_running_or_notify_cancel()
+                        try:
+                            p.future.set_exception(e)
+                        except InvalidStateError:
+                            pass
+                        p.span.set(error=type(e).__name__)
+                        p.span.end()
 
     # -- execution -------------------------------------------------------
     def _claim_live(self, group: list[_Pending]) -> list[_Pending]:
@@ -349,6 +391,10 @@ class Coalescer:
             if p.t_deadline is not None and now >= p.t_deadline:
                 self._refuse_expired(p, now)
                 continue
+            if p.cost is not None:
+                # claim boundary = end of queue wait: everything after
+                # this point is compile/kernel/fetch work
+                p.cost.set_queue_wait(now - p.t_enq)
             live.append(p)
         return live
 
@@ -387,20 +433,23 @@ class Coalescer:
             if browned and len(ps) > 1:
                 # brownout: skip the batched machinery up front —
                 # small, predictable unbatched launches under pressure
-                launches.append((kkey, ps, None, fspans, None))
+                launches.append((kkey, ps, None, fspans, None, None, 0.0))
                 continue
             ksp = self.tracer.start_span(
                 "serve.kernel", parent=fspans[0],
                 family=kkey.family, n=kkey.n, batch_size=len(ps))
+            t_disp = time.perf_counter()
             try:
                 raw = self._dispatch(kkey, ps)
             except Exception:
                 # batched dispatch failed — degrade this subgroup
                 raw = None
                 ksp.set(error="dispatch")
-            launches.append((kkey, ps, raw, fspans, ksp))
+            compile_s = self.cache.last_compile_wait_s()
+            launches.append((kkey, ps, raw, fspans, ksp, t_disp,
+                             compile_s))
 
-        for kkey, ps, raw, fspans, ksp in launches:
+        for kkey, ps, raw, fspans, ksp, t_disp, compile_s in launches:
             batched = len(ps) > 1 and raw is not None
             if raw is not None:
                 try:
@@ -417,13 +466,28 @@ class Coalescer:
                 self.breaker.record_success(bucket_key(ps[0].req))
             self.stats.flushed(len(ps), batched=batched)
             t_done = time.perf_counter()
+            # kernel attribution: one histogram observation per launch
+            # (dispatch → fetch barrier, compile wait excluded), divided
+            # evenly across the riders so the sum of per-request shares
+            # equals the histogram total (serve_load --cost gate)
+            kernel_s = max(t_done - t_disp - compile_s, 0.0)
+            self.stats.observe_kernel(kernel_s)
+            share = kernel_s / len(ps)
             for j, p in enumerate(ps):
                 lat = t_done - p.t_enq
-                self.stats.observe_latency(lat)
+                self.stats.observe_latency(lat,
+                                           trace_id=p.span.trace_id)
+                if p.cost is not None:
+                    p.cost.add_kernel(share)
+                    if compile_s > 0.0:
+                        # every rider waited out the whole compile
+                        p.cost.add_compile_wait(compile_s)
                 p.future.set_result(EstimateResponse(
                     rho_hat=float(raw[0][j]), ci_low=float(raw[1][j]),
                     ci_high=float(raw[2][j]), batched=batched,
-                    batch_size=len(ps), latency_s=lat, seed=p.seed))
+                    batch_size=len(ps), latency_s=lat, seed=p.seed,
+                    cost=(p.cost.to_dict() if p.cost is not None
+                          else None)))
                 fspans[j].set(batched=batched)
                 fspans[j].end()
                 # the respond point: the request's root span closes with
@@ -472,14 +536,28 @@ class Coalescer:
             sp = fspans[idx] if fspans else obs_trace._NULL_SPAN
             sp.set(degraded=True)
             try:
+                t_disp = time.perf_counter()
                 raw = self._run_direct(kkey, p)
+                raw = tuple(np.asarray(a) for a in raw)  # fetch barrier
+                t_done = time.perf_counter()
+                compile_s = self.cache.last_compile_wait_s()
+                kernel_s = max(t_done - t_disp - compile_s, 0.0)
+                self.stats.observe_kernel(kernel_s)
                 self.stats.flushed(1, batched=False)
-                lat = time.perf_counter() - p.t_enq
-                self.stats.observe_latency(lat)
+                lat = t_done - p.t_enq
+                self.stats.observe_latency(lat,
+                                           trace_id=p.span.trace_id)
+                if p.cost is not None:
+                    p.cost.event("degraded_unbatched")
+                    p.cost.add_kernel(kernel_s)
+                    if compile_s > 0.0:
+                        p.cost.add_compile_wait(compile_s)
                 p.future.set_result(EstimateResponse(
                     rho_hat=float(raw[0][0]), ci_low=float(raw[1][0]),
                     ci_high=float(raw[2][0]), batched=False,
-                    batch_size=1, latency_s=lat, seed=p.seed))
+                    batch_size=1, latency_s=lat, seed=p.seed,
+                    cost=(p.cost.to_dict() if p.cost is not None
+                          else None)))
                 sp.end()
                 p.span.set(latency_s=lat, batch_size=1, batched=False)
                 p.span.end()
@@ -487,6 +565,8 @@ class Coalescer:
                     self.breaker.record_success(bkey)
             except Exception as e:
                 self.stats.failed()
+                if p.cost is not None:
+                    p.cost.event(f"kernel_error:{type(e).__name__}")
                 p.future.set_exception(e)
                 sp.set(error=type(e).__name__)
                 sp.end()

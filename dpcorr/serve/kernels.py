@@ -54,6 +54,7 @@ tests/test_serve.py).
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable
 
@@ -129,6 +130,16 @@ class KernelCache:
         self._compile_hook: Callable | None = None  # test seam
         self._lock = threading.Lock()
         self._fns: OrderedDict[tuple, Callable] = OrderedDict()  # guarded by: _lock
+        # per-thread compile wait of the most recent get(): zero on a
+        # hit, the blocked time on a miss (leader build OR follower
+        # wait — both are wall time the launch spent without a kernel).
+        # Thread-local so the warmup thread's gets never clobber the
+        # flush thread's cost attribution (obs.cost).
+        self._tls = threading.local()
+
+    def last_compile_wait_s(self) -> float:
+        """Compile wait of the calling thread's most recent ``get``."""
+        return getattr(self._tls, "compile_wait_s", 0.0)
 
     def _n_shards(self, b_pad: int) -> int:
         """How many mesh shards this launch uses (1 = unsharded)."""
@@ -150,6 +161,7 @@ class KernelCache:
         ``kernel_compile_dedup`` instead of compiles/hits)."""
         shards = self._n_shards(b_pad)
         cache_key = (kkey, b_pad, shards)
+        self._tls.compile_wait_s = 0.0
         with self._lock:
             fn = self._fns.get(cache_key)
             if fn is not None:
@@ -172,7 +184,9 @@ class KernelCache:
                 self.stats.set_kernel_cache_size(len(self._fns))
             return fn
 
+        t_miss = time.perf_counter()
         fn, leader = self._flight.do(cache_key, build)
+        self._tls.compile_wait_s = time.perf_counter() - t_miss
         if not leader:
             self.stats.kernel_dedup()
         return fn, shards

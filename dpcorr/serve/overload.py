@@ -91,7 +91,7 @@ class CircuitBreaker:
 
     def __init__(self, fail_threshold: int = 5,
                  reset_after_s: float = 30.0, stats=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, on_open=None):
         if fail_threshold < 1:
             raise ValueError(f"fail_threshold must be >= 1, "
                              f"got {fail_threshold}")
@@ -102,6 +102,11 @@ class CircuitBreaker:
         self.reset_after_s = float(reset_after_s)
         self.stats = stats
         self.clock = clock
+        #: ``on_open(bkey, consecutive)`` fires when a bucket trips
+        #: open — OUTSIDE the breaker lock, so the flight recorder can
+        #: dump (file I/O) without stalling concurrent admissions.
+        #: Settable after construction (server wiring).
+        self.on_open = on_open
         self._lock = threading.Lock()
         self._entries: dict[BucketKey, _Entry] = {}  # guarded by: _lock
 
@@ -155,6 +160,7 @@ class CircuitBreaker:
                 self._transition_locked(bkey, e, "closed")
 
     def record_failure(self, bkey: BucketKey) -> None:
+        tripped = None
         with self._lock:
             e = self._entries.setdefault(bkey, _Entry())
             e.consecutive += 1
@@ -164,14 +170,18 @@ class CircuitBreaker:
                 # the probe failed: straight back to another cooldown
                 e.opened_at = now
                 self._transition_locked(bkey, e, "open")
+                tripped = e.consecutive
             elif e.state == "closed" \
                     and e.consecutive >= self.fail_threshold:
                 e.opened_at = now
                 self._transition_locked(bkey, e, "open")
+                tripped = e.consecutive
             elif e.state == "open":
                 # a queued straggler failing while open: the bucket is
                 # still sick — restart the cooldown
                 e.opened_at = now
+        if tripped is not None and self.on_open is not None:
+            self.on_open(bkey, tripped)
 
     def state(self, bkey: BucketKey) -> str:
         with self._lock:
@@ -210,7 +220,7 @@ class BrownoutController:
     def __init__(self, queue_frac: float = 0.75,
                  flush_slo_s: float | None = None,
                  enter_after_s: float = 0.5, exit_after_s: float = 2.0,
-                 stats=None, clock=time.monotonic):
+                 stats=None, clock=time.monotonic, on_change=None):
         if not 0.0 <= queue_frac <= 1.0:
             raise ValueError(f"queue_frac must be in [0, 1], "
                              f"got {queue_frac}")
@@ -220,6 +230,10 @@ class BrownoutController:
         self.exit_after_s = float(exit_after_s)
         self.stats = stats
         self.clock = clock
+        #: ``on_change(active)`` fires on every enter/exit transition —
+        #: OUTSIDE the controller lock (flight-recorder dump hook).
+        #: Settable after construction (server wiring).
+        self.on_change = on_change
         self._lock = threading.Lock()
         self._active = False  # guarded by: _lock
         self._pressured_since: float | None = None  # guarded by: _lock
@@ -237,6 +251,7 @@ class BrownoutController:
         pressured = queue_fraction >= self.queue_frac or (
             self.flush_slo_s is not None
             and flush_ewma_s > self.flush_slo_s)
+        changed = None
         with self._lock:
             now = self.clock()
             if pressured:
@@ -246,6 +261,7 @@ class BrownoutController:
                 if not self._active and \
                         now - self._pressured_since >= self.enter_after_s:
                     self._set_locked(True)
+                    changed = True
             else:
                 self._pressured_since = None
                 if self._calm_since is None:
@@ -253,6 +269,9 @@ class BrownoutController:
                 if self._active and \
                         now - self._calm_since >= self.exit_after_s:
                     self._set_locked(False)
+                    changed = False
+        if changed is not None and self.on_change is not None:
+            self.on_change(changed)
 
     def active(self) -> bool:
         with self._lock:

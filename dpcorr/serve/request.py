@@ -166,3 +166,9 @@ class EstimateResponse:
     #: the request pinned it (server-assigned streams also fold in a
     #: per-boot nonce, deliberately not reproducible across restarts)
     seed: int
+    #: per-request cost attribution (obs.cost.CostRecord.to_dict():
+    #: queue/compile/kernel seconds, retries, shed events, ε charged
+    #: and refunded per party). Trailing with a default so the
+    #: pre-ISSUE-9 positional construction sites stay valid; ``None``
+    #: only for responses replayed from pre-cost idempotency caches.
+    cost: dict | None = None

@@ -62,8 +62,11 @@ from concurrent.futures import InvalidStateError
 
 import numpy as np
 
+from dpcorr import chaos
+from dpcorr.obs import recorder as obs_recorder
 from dpcorr.obs import trace as obs_trace
 from dpcorr.obs.audit import AuditTrail
+from dpcorr.obs.cost import CostRegistry
 from dpcorr.obs.metrics import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from dpcorr.serve.coalescer import Coalescer, ServerOverloadedError
 from dpcorr.serve.kernels import KernelCache
@@ -73,6 +76,7 @@ from dpcorr.serve.overload import (
     CircuitBreaker,
     CircuitOpenError,
     DeadlineExpiredError,
+    _bucket_label,
 )
 from dpcorr.serve.request import EstimateRequest, EstimateResponse, bucket_key
 from dpcorr.serve.stats import ServeStats
@@ -153,6 +157,13 @@ class DpcorrServer:
         self.tracer = tracer if tracer is not None else obs_trace.tracer()
         self.audit = AuditTrail(audit) if isinstance(audit, str) else audit
         self.stats = ServeStats()
+        # per-request cost attribution (ISSUE 9): a CostRecord per
+        # admission, filled in across the queue/compile/kernel path and
+        # returned in response metadata; the bounded registry keeps the
+        # recent window for /stats aggregation and flight-recorder dumps
+        self.costs = CostRegistry()
+        self._recorder = None  # set by attach_recorder
+        self._crash_hook = None  # set by attach_recorder
         self.ledger = PrivacyLedger(budget, path=ledger_path,
                                     per_party=per_party_budget,
                                     audit=self.audit,
@@ -395,6 +406,10 @@ class DpcorrServer:
         key = self._request_key(req, seed)
         root = self.tracer.start_span("serve.request", family=req.family,
                                       n=req.n, seed=seed)
+        # the cost record opens with the root span and shares its trace
+        # ID — refused requests keep theirs in the registry too, so the
+        # "refused ⇒ zero ε net of refunds" invariant is checkable
+        cost = self.costs.new(root.trace_id)
         try:
             with self.tracer.span("serve.admit", parent=root):
                 # inner spans parent implicitly under serve.admit (the
@@ -407,25 +422,30 @@ class DpcorrServer:
                 except CircuitOpenError:
                     self.stats.refused("breaker")
                     root.set(refused="breaker")
+                    cost.event("refused_breaker")
                     raise
                 except ServerOverloadedError:
                     self.stats.refused("brownout")
                     self.stats.shed("admission")
                     root.set(refused="brownout")
+                    cost.event("refused_brownout")
                     raise
                 try:
                     with self.tracer.span("serve.ledger.charge"):
                         charges = self.ledger.charge_request(
                             req, trace_id=root.trace_id)
+                    cost.charge(charges)
                 except BudgetExceededError:
                     self.stats.refused_budget()
                     root.set(refused="budget")
+                    cost.event("refused_budget")
                     raise
                 try:
                     with self.tracer.span("serve.enqueue"):
                         fut = self.coalescer.submit(req, key, seed,
                                                     span=root,
-                                                    charges=charges)
+                                                    charges=charges,
+                                                    cost=cost)
                 except Exception:
                     # the enqueue refused (backpressure / closed): no
                     # kernel ran and nothing was released, so reversing
@@ -433,6 +453,8 @@ class DpcorrServer:
                     # (ledger.refund)
                     self.ledger.refund(charges, trace_id=root.trace_id,
                                        reason="overload")
+                    cost.event("refused_overload")
+                    cost.refund(charges, "overload")
                     root.set(refused="overload")
                     raise
         except Exception:
@@ -479,11 +501,44 @@ class DpcorrServer:
             raise
 
     def stats_snapshot(self) -> dict:
-        snap = self.stats.snapshot(ledger_snapshot=self.ledger.snapshot())
+        snap = self.stats.snapshot(
+            ledger_snapshot=self.ledger.snapshot(),
+            cost_aggregate=self.costs.aggregate())
         snap["breaker"] = self.breaker.snapshot()
         return snap
 
+    # -- flight recorder (ISSUE 9) ---------------------------------------
+    def attach_recorder(self, rec) -> None:
+        """Wire a :class:`~dpcorr.obs.recorder.FlightRecorder` into
+        every capture point of this server: span + audit observers,
+        the metrics registry and cost registry for dump snapshots,
+        breaker-trip / brownout-transition / chaos-crash dump triggers,
+        and the ``dpcorr`` logging ring. Installs the recorder as the
+        process-wide trigger target (``dpcorr obs`` + SIGUSR2 path)."""
+        self._recorder = rec
+        self.tracer.add_observer(rec.record_span)
+        if self.audit is not None:
+            self.audit.add_observer(rec.record_audit)
+        rec.watch_registry(self.stats.registry)
+        rec.watch_costs(self.costs)
+        # dump triggers: all three callbacks fire OUTSIDE their
+        # component's lock (overload.py / chaos.py contracts), so the
+        # recorder may take its ring lock and do file I/O safely
+        self.breaker.on_open = lambda bkey, consecutive: \
+            obs_recorder.trigger(
+                "breaker_open", family=bkey.family,
+                bucket=_bucket_label(bkey), consecutive=consecutive)
+        self.brownout.on_change = lambda active: obs_recorder.trigger(
+            "brownout_enter" if active else "brownout_exit")
+        self._crash_hook = lambda point: rec.dump("chaos", point=point)
+        chaos.on_crash(self._crash_hook)
+        rec.attach_logging("dpcorr")
+        obs_recorder.install(rec)
+
     def close(self) -> None:
+        if self._crash_hook is not None:
+            chaos.remove_crash_hook(self._crash_hook)
+            self._crash_hook = None
         self.coalescer.close()
         if self._warmup_manifest:
             # persist the working set AFTER the drain: every kernel the
@@ -552,7 +607,8 @@ def _response_json(resp: EstimateResponse) -> dict:
     return {"rho_hat": resp.rho_hat, "ci_low": resp.ci_low,
             "ci_high": resp.ci_high, "batched": resp.batched,
             "batch_size": resp.batch_size,
-            "latency_s": round(resp.latency_s, 6), "seed": resp.seed}
+            "latency_s": round(resp.latency_s, 6), "seed": resp.seed,
+            "cost": resp.cost}
 
 
 def make_http_server(server: DpcorrServer, host: str = "127.0.0.1",

@@ -29,9 +29,11 @@ always means the same estimator (nearest-rank).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Iterable, Sequence
 
+from dpcorr.obs.cost import ExemplarStore
 from dpcorr.obs.metrics import LATENCY_BUCKETS, Registry
 
 #: Label vocabularies the JSON snapshot enumerates (the Prometheus side
@@ -67,7 +69,8 @@ class ServeStats:
     """
 
     def __init__(self, reservoir: int = 8192,
-                 registry: Registry | None = None):
+                 registry: Registry | None = None,
+                 slo_s: float = 0.25, slo_window_s: float = 60.0):
         self.registry = registry if registry is not None else Registry()
         r = self.registry
         self._requests = r.counter(
@@ -147,8 +150,29 @@ class ServeStats:
             "dpcorr_serve_flush_ewma_seconds",
             "Exponentially weighted moving average of flush duration "
             "— the load-shedding pressure signal")
+        # -- cost attribution + SLO burn rate (ISSUE 9) -------------------
+        self._kernel_hist = r.histogram(
+            "dpcorr_serve_kernel_seconds",
+            "Per-launch kernel wall time (dispatch through fetch "
+            "barrier) — the denominator the per-request kernel-time "
+            "attributions must sum back to (obs.cost; serve_load "
+            "--cost gates on exactly this)",
+            buckets=LATENCY_BUCKETS)
+        self._slo_burn = r.gauge(
+            "dpcorr_serve_slo_burn_rate",
+            "Fraction of requests in the rolling window whose latency "
+            "exceeded the SLO threshold — the burn-rate signal "
+            "`dpcorr obs top` renders")
+        self._slo_window_n = r.gauge(
+            "dpcorr_serve_slo_window_requests",
+            "Requests currently inside the SLO rolling window")
+        self.slo_s = float(slo_s)
+        self.slo_window_s = float(slo_window_s)
+        #: latency-histogram trace exemplars: slow bucket → trace ID
+        self.exemplars = ExemplarStore(buckets=LATENCY_BUCKETS)
         self._lock = threading.Lock()
         self._latencies: deque[float] = deque(maxlen=reservoir)  # guarded by: _lock
+        self._slo_events: deque[tuple] = deque()  # guarded by: _lock
         self._flush_ewma_val: float | None = None  # guarded by: _lock
         self._ewma_alpha = 0.2
 
@@ -300,11 +324,45 @@ class ServeStats:
         (serve.kernels) — lets an operator see eviction pressure."""
         self._cache_size.set(n)
 
-    def observe_latency(self, seconds: float) -> None:
+    def observe_kernel(self, seconds: float) -> None:
+        """One launch's dispatch-to-fetch wall time (batched launches
+        observe once; their riders' cost records carry equal shares —
+        the two views sum to the same total by construction)."""
+        self._kernel_hist.observe(float(seconds))
+
+    def observe_latency(self, seconds: float,
+                        trace_id: str | None = None) -> None:
         s = float(seconds)
         self._latency.observe(s)
+        self.exemplars.record(s, trace_id)
+        now = time.monotonic()
         with self._lock:
             self._latencies.append(s)
+            self._slo_events.append((now, s > self.slo_s))
+            self._slo_update_locked(now)
+
+    def _slo_update_locked(self, now: float) -> None:
+        """Trim the rolling window and refresh the burn-rate gauges."""
+        cutoff = now - self.slo_window_s
+        ev = self._slo_events
+        while ev and ev[0][0] < cutoff:
+            ev.popleft()
+        n = len(ev)
+        over = sum(1 for _, o in ev if o)
+        self._slo_window_n.set(n)
+        self._slo_burn.set(over / n if n else 0.0)
+
+    def slo_snapshot(self) -> dict:
+        """The ``/stats`` SLO view (also refreshes the gauges, so a
+        scrape after traffic stops sees the window drain)."""
+        now = time.monotonic()
+        with self._lock:
+            self._slo_update_locked(now)
+            n = len(self._slo_events)
+            over = sum(1 for _, o in self._slo_events if o)
+        return {"slo_s": self.slo_s, "window_s": self.slo_window_s,
+                "window_requests": n,
+                "burn_rate": over / n if n else 0.0}
 
     # -- reading ---------------------------------------------------------
     def batch_fill_ratio(self) -> float:
@@ -318,10 +376,23 @@ class ServeStats:
     def render_prometheus(self) -> str:
         """The ``GET /metrics`` body: every instrument this server
         publishes (incl. the ledger's, which registers into the same
-        registry via the server wiring)."""
-        return self.registry.render()
+        registry via the server wiring), followed by the latency
+        exemplars as comment lines — exposition 0.0.4 has no exemplar
+        syntax, and comments keep every scraper (incl. our own
+        parse_exposition) compatible while still shipping the
+        bucket→trace links in the same scrape."""
+        body = self.registry.render()
+        ex = self.exemplars.snapshot()
+        if not ex:
+            return body
+        lines = [f'# EXEMPLAR dpcorr_serve_latency_seconds_bucket'
+                 f'{{le="{le}"}} trace_id={x["trace_id"]} '
+                 f'value={x["value"]}'
+                 for le, x in sorted(ex.items())]
+        return body + "\n".join(lines) + "\n"
 
-    def snapshot(self, ledger_snapshot: dict | None = None) -> dict:
+    def snapshot(self, ledger_snapshot: dict | None = None,
+                 cost_aggregate: dict | None = None) -> dict:
         done = self.batched_requests + self.unbatched_requests
         flushes = self.batches_flushed
         with self._lock:
@@ -356,7 +427,13 @@ class ServeStats:
                           for s in ABANDONED_STAGES},
             "brownout_active": bool(self._brownout.value()),
             "flush_ewma_s": self.flush_ewma(),
+            # cost attribution + SLO burn (ISSUE 9), additive as well
+            "kernel_histogram": self._kernel_hist.snapshot(),
+            "slo": self.slo_snapshot(),
+            "exemplars": self.exemplars.snapshot(),
         }
+        if cost_aggregate is not None:
+            snap["costs"] = cost_aggregate
         if ledger_snapshot is not None:
             snap["ledger"] = ledger_snapshot
         return snap

@@ -1,0 +1,24 @@
+"""Violating fixture: both telemetry rules fire in here."""
+
+from dpcorr.obs.metrics import Counter, default_registry
+from dpcorr.obs.trace import tracer
+
+registry = default_registry()
+
+
+def publish():
+    requests = registry.counter("requests_total")  # metric-name-style
+    camel = registry.gauge("dpcorr_QueueDepth")  # metric-name-style
+    direct = Counter("serve_errors_total")  # metric-name-style
+    return requests, camel, direct
+
+
+def handle(req):
+    sp = tracer().start_span("serve.handle")  # span-no-finally
+    result = req.run()
+    sp.end()  # not in a finally: an exception above leaks the span
+    return result
+
+
+def fire_and_forget():
+    tracer().start_span("serve.orphan")  # span-no-finally (never bound)

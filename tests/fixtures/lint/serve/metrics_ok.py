@@ -1,0 +1,31 @@
+"""Clean fixture: prefixed snake_case names, spans closed on all paths."""
+
+from dpcorr.obs.metrics import Counter, default_registry
+from dpcorr.obs.trace import tracer
+
+registry = default_registry()
+
+
+def publish():
+    requests = registry.counter("dpcorr_serve_requests_total")
+    depth = registry.gauge("dpcorr_serve_queue_depth")
+    direct = Counter("dpcorr_serve_errors_total")
+    return requests, depth, direct
+
+
+def handle(req):
+    with tracer().span("serve.handle"):  # context manager: always closed
+        return req.run()
+
+
+def handle_explicit(req):
+    sp = tracer().start_span("serve.handle")
+    try:
+        return req.run()
+    finally:
+        sp.end()  # closed on every path
+
+
+def unrelated_receiver(analytics):
+    # a non-registry object's .counter(...) is not a metric declaration
+    return analytics.counter("page_views")

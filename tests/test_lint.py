@@ -181,6 +181,34 @@ def test_sync_scope_is_path_based():
         assert checker.applies_to(hot), hot
 
 
+def test_metrics_bad_fixture_fires_both_telemetry_rules():
+    vs = lint_fixture("serve/metrics_bad.py")
+    assert fired(vs) == [
+        ("metric-name-style", 10),  # unprefixed counter
+        ("metric-name-style", 11),  # camelCase gauge
+        ("metric-name-style", 12),  # direct-constructor form
+        ("span-no-finally", 17),    # .end() outside a finally
+        ("span-no-finally", 24),    # never bound at all
+    ]
+
+
+def test_metrics_ok_fixture_is_clean():
+    assert lint_fixture("serve/metrics_ok.py") == []
+
+
+def test_metrics_scope_excludes_obs_package():
+    """obs/ defines the instruments — the namespace rule polices the
+    producers, not the factory itself."""
+    from dpcorr.analysis.rules.metrics import MetricsChecker
+
+    checker = MetricsChecker()
+    assert not checker.applies_to("dpcorr/obs/metrics.py")
+    assert not checker.applies_to("dpcorr/obs/recorder.py")
+    for covered in ("dpcorr/serve/stats.py", "bench.py",
+                    "dpcorr/protocol/party.py"):
+        assert checker.applies_to(covered), covered
+
+
 # ------------------------------------------------- suppression comments ----
 def test_suppression_comment_both_placements():
     assert lint_fixture("rng_suppressed_ok.py") == []

@@ -1,0 +1,355 @@
+"""Flight recorder, cost attribution and the ops console (ISSUE 9).
+
+The recorder's contract: always-on bounded rings, atomic dumps on the
+failure triggers, and a dump from which ``dpcorr obs`` tooling rebuilds
+one request's span chain + cost record + ε trail with no jax import.
+The tests mirror that split: ring/dump mechanics (pure), trigger wiring
+(chaos raise mode, module-level install), reconstruction ordering,
+cost arithmetic, the jax-free CLI, the console renderer, and one
+end-to-end pass through a live server.
+"""
+
+import http.server
+import json
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from dpcorr import chaos
+from dpcorr.obs import trace as obs_trace
+from dpcorr.obs.audit import AuditTrail
+from dpcorr.obs.console import render_frame, run_top
+from dpcorr.obs.cost import CostRecord, CostRegistry, ExemplarStore
+from dpcorr.obs.recorder import (
+    FlightRecorder,
+    install,
+    read_dump,
+    reconstruct,
+    trigger,
+)
+
+
+def _span(name, i=0, trace="t0001", parent=None, ts=None):
+    return {"name": name, "trace_id": trace, "span_id": f"s{i:04x}",
+            "parent_id": parent, "ts": float(i if ts is None else ts),
+            "dur_s": 0.001, "thread": "main", "attrs": {}}
+
+
+# ------------------------------------------------------- rings + dumps ----
+def test_rings_are_bounded_per_kind():
+    rec = FlightRecorder("/tmp/unused.json", capacity=4)
+    for i in range(10):
+        rec.record_span(_span("s", i))
+        rec.record_audit({"seq": i})
+        rec.record_log({"message": str(i)})
+    snap = rec.snapshot("cli")
+    assert [sp["span_id"] for sp in snap["spans"]] == \
+        [f"s{i:04x}" for i in range(6, 10)]
+    assert [ev["seq"] for ev in snap["audit"]] == [6, 7, 8, 9]
+    assert len(snap["logs"]) == 4
+
+
+def test_capacity_must_be_positive():
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder("/tmp/unused.json", capacity=0)
+
+
+def test_tracer_and_audit_observers_feed_the_rings(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "d.json"))
+    tr = obs_trace.Tracer()
+    tr.add_observer(rec.record_span)
+    with tr.span("outer"):
+        with tr.span("inner"):
+            pass
+    trail = AuditTrail()
+    trail.add_observer(rec.record_audit)
+    trail.record("charge", {"px": 2.0}, trace_id="tabc")
+    snap = rec.snapshot("cli")
+    assert [sp["name"] for sp in snap["spans"]] == ["inner", "outer"]
+    assert snap["audit"][0]["charges"] == {"px": 2.0}
+
+
+def test_logging_handler_feeds_the_log_ring(tmp_path):
+    import logging
+
+    rec = FlightRecorder(str(tmp_path / "d.json"))
+    rec.attach_logging("dpcorr.test_ring")
+    try:
+        logging.getLogger("dpcorr.test_ring.sub").warning("queue %d", 7)
+    finally:
+        rec.detach_logging("dpcorr.test_ring")
+    logs = rec.snapshot("cli")["logs"]
+    assert logs and logs[-1]["message"] == "queue 7"
+    assert logs[-1]["level"] == "WARNING"
+
+
+def test_dump_roundtrip_and_reason_history(tmp_path):
+    path = str(tmp_path / "rec" / "dump.json")  # parent dir is created
+    rec = FlightRecorder(path)
+    rec.record_span(_span("serve.request"))
+    assert rec.dump("breaker_open", family="ni_sign") == path
+    rec.dump("brownout_exit")
+    doc = read_dump(path)
+    assert doc["reason"] == "brownout_exit"  # newest incident wins
+    assert rec.reasons == ["breaker_open", "brownout_exit"]
+    assert rec.last_reason == "brownout_exit"
+    assert rec.dumps == 2
+    assert doc["spans"][0]["name"] == "serve.request"
+
+
+def test_read_dump_is_strict(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text("{\"version\": 1, \"truncated")
+    with pytest.raises(json.JSONDecodeError):
+        read_dump(str(p))
+    p.write_text("[1, 2]")
+    with pytest.raises(ValueError, match="not a JSON object"):
+        read_dump(str(p))
+    p.write_text(json.dumps({"version": 99, "reason": "x"}))
+    with pytest.raises(ValueError, match="version"):
+        read_dump(str(p))
+    doc = {"version": 1, "reason": "cli", "ts": 0.0, "spans": [],
+           "audit": [], "logs": [], "metrics": {}}  # no "costs"
+    p.write_text(json.dumps(doc))
+    with pytest.raises(ValueError, match="costs"):
+        read_dump(str(p))
+
+
+# ------------------------------------------------------------- triggers ----
+def test_trigger_without_installed_recorder_is_noop():
+    install(None)
+    assert trigger("breaker_open") is None
+
+
+def test_trigger_dumps_installed_recorder_and_never_raises(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "d.json"))
+    install(rec)
+    try:
+        assert trigger("breaker_open", family="ni_sign") is not None
+        assert read_dump(str(tmp_path / "d.json"))["detail"] == \
+            {"family": "ni_sign"}
+        # an unwritable path must not let the trigger raise into the
+        # failure path that called it
+        blocker = tmp_path / "flat"
+        blocker.write_text("")
+        install(FlightRecorder(str(blocker / "x" / "d.json")))
+        assert trigger("brownout_enter") is None
+    finally:
+        install(None)
+
+
+def test_chaos_raise_mode_crash_dumps_before_propagating(tmp_path):
+    path = str(tmp_path / "chaos.json")
+    rec = FlightRecorder(path)
+    rec.record_span(_span("gate.charge"))
+    hook = lambda point: rec.dump("chaos", point=point)  # noqa: E731
+    chaos.on_crash(hook)
+    chaos.install(chaos.ChaosPlan("gate.post_charge", hit=1, mode="raise"))
+    try:
+        with pytest.raises(chaos.SimulatedCrash):
+            chaos.point("gate.post_charge")
+    finally:
+        chaos.clear()
+        chaos.remove_crash_hook(hook)
+    doc = read_dump(path)
+    assert doc["reason"] == "chaos"
+    assert doc["detail"] == {"point": "gate.post_charge"}
+    assert doc["spans"][0]["name"] == "gate.charge"
+
+
+# -------------------------------------------------------- reconstruction ----
+def test_reconstruct_orders_parents_before_children():
+    spans = [
+        _span("serve.kernel", 4, parent="s0003"),
+        _span("serve.request", 1, parent=None),
+        _span("serve.flush", 3, parent="s0001"),
+        _span("serve.admit", 2, parent="s0001"),
+        _span("other.request", 9, trace="t9999"),
+    ]
+    dump = {"spans": spans, "audit": [
+        {"kind": "charge", "charges": {"px": 2.0}, "trace_id": "t0001"},
+        {"kind": "refund", "charges": {"px": 2.0}, "trace_id": "t0001"},
+    ], "costs": {"t0001": {"kernel_s": 0.0}}}
+    story = reconstruct(dump, "t0001")
+    names = [sp["name"] for sp in story["spans"]]
+    assert names[0] == "serve.request"
+    assert names.index("serve.flush") < names.index("serve.kernel")
+    assert "other.request" not in names
+    assert story["cost"] == {"kernel_s": 0.0}
+    assert story["eps_net"] == {"px": 0.0}  # charge fully refunded
+
+
+def test_reconstruct_surfaces_orphans_last():
+    spans = [
+        _span("serve.request", 1, parent=None),
+        _span("serve.kernel", 5, parent="sFFFF"),  # parent evicted
+    ]
+    story = reconstruct({"spans": spans, "audit": [], "costs": {}},
+                        "t0001")
+    assert [sp["name"] for sp in story["spans"]] == \
+        ["serve.request", "serve.kernel"]
+
+
+# ---------------------------------------------------------------- costs ----
+def test_cost_record_arithmetic_and_clamp():
+    c = CostRecord("t0001")
+    c.charge({"px": 2.0, "py": 1.0})
+    c.refund({"px": 2.0}, "expired")
+    c.set_queue_wait(0.25)
+    c.add_kernel(0.003)
+    c.add_compile_wait(1.5)
+    d = c.to_dict()
+    assert d["eps_net"] == {"px": 0.0, "py": 1.0}
+    assert d["queue_wait_s"] == 0.25
+    assert d["kernel_s"] == 0.003
+    assert d["compile_wait_s"] == 1.5
+    assert "refund:expired" in d["events"]
+
+
+def test_cost_registry_is_bounded_lru():
+    reg = CostRegistry(capacity=3)
+    for i in range(5):
+        reg.new(f"t{i}")
+    assert reg.get("t0") is None and reg.get("t1") is None
+    assert set(reg.to_dict()) == {"t2", "t3", "t4"}
+    agg = reg.aggregate()
+    assert agg["records"] == 3
+
+
+def test_exemplar_store_links_buckets_to_traces():
+    ex = ExemplarStore(buckets=(0.1, 1.0))
+    ex.record(0.05, "tfast")
+    ex.record(0.5, "tslow")
+    ex.record(0.07, None)  # no trace: must not clobber
+    snap = ex.snapshot()
+    assert snap["0.1"]["trace_id"] == "tfast"
+    assert snap["1.0"]["trace_id"] == "tslow"
+
+
+# ------------------------------------------------------------------ CLI ----
+def test_obs_dump_cli_is_jax_free(tmp_path):
+    path = str(tmp_path / "dump.json")
+    rec = FlightRecorder(path)
+    rec.record_span(_span("serve.request", 1, parent=None))
+    rec.record_span(_span("serve.kernel", 2, parent="s0001"))
+    rec.dump("breaker_open")
+    script = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"  # any jax import now explodes
+        "sys.argv = ['dpcorr', 'obs', 'dump', %r, '--trace-id', 't0001',"
+        " '--json']\n"
+        "from dpcorr.__main__ import main\n"
+        "main()\n" % path)
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    story = json.loads(out.stdout)
+    assert [sp["name"] for sp in story["spans"]] == \
+        ["serve.request", "serve.kernel"]
+
+
+# -------------------------------------------------------------- console ----
+CANNED_STATS = {
+    "queue_depth": 3, "flush_ewma_s": 0.004,
+    "breaker": {"open": 1, "half_open": 0,
+                "tripped_buckets": {"ni_sign/n=128": "open"}},
+    "brownout_active": True,
+    "slo": {"burn_rate": 0.125, "window_requests": 64, "slo_s": 0.25,
+            "window_s": 60.0},
+    "kernel_compiles": 2, "kernel_hits": 30, "kernel_compile_dedup": 1,
+    "kernel_cache_size": 2,
+    "latency_s": {"p50": 0.003, "p99": 0.031},
+    "exemplars": {"0.05": {"trace_id": "tdead", "value": 0.031}},
+    "costs": {"records": 32, "kernel_s": 0.08, "queue_wait_s": 1.2,
+              "compile_wait_s": 4.0},
+    "requests_total": 40, "refused": {"budget": 2}, "shed": {},
+    "requests_failed": 1,
+    "ledger": {"parties": {"px": {"spent": 9.0, "budget": 100.0},
+                           "py": 3.0}},
+}
+
+
+def test_render_frame_shows_the_operator_story():
+    frame = render_frame(CANNED_STATS, {}, now=0.0)
+    assert "queue depth" in frame and "     3" in frame
+    assert "1 open" in frame and "ni_sign/n=128" in frame
+    assert "brownout    : ACTIVE" in frame
+    assert "12.50%" in frame          # slo burn
+    assert "trace=tdead" in frame     # exemplar link
+    assert "px=9" in frame            # top-ε principal
+    assert "2 refused" in frame
+
+
+class _CannedHandler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        if self.path == "/stats":
+            body = json.dumps(CANNED_STATS).encode()
+            ctype = "application/json"
+        elif self.path == "/metrics":
+            body = b"dpcorr_serve_queue_depth 3\n"
+            ctype = "text/plain"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_run_top_once_against_canned_server():
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                            _CannedHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        lines: list[str] = []
+        rc = run_top(f"http://127.0.0.1:{httpd.server_address[1]}",
+                     once=True, out=lines.append)
+        assert rc == 0
+        assert "brownout    : ACTIVE" in "\n".join(lines)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_run_top_once_unreachable_server_fails():
+    rc = run_top("http://127.0.0.1:9", once=True, out=lambda s: None)
+    assert rc == 1
+
+
+# ----------------------------------------------------------- end-to-end ----
+@pytest.mark.slow
+def test_server_cost_records_and_dump_reconstruction(tmp_path):
+    from dpcorr.serve.request import EstimateRequest
+    from dpcorr.serve.server import DpcorrServer
+
+    path = str(tmp_path / "flight.json")
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off",
+                       audit=AuditTrail())
+    rec = FlightRecorder(path)
+    srv.attach_recorder(rec)
+    try:
+        req = EstimateRequest(family="ni_sign", n=64, eps1=1.0, eps2=1.0,
+                              seed=7, parties=("e2e-x", "e2e-y"))
+        resp = srv.estimate(req, timeout=300)
+        assert resp.cost is not None
+        assert resp.cost["kernel_s"] >= 0.0
+        assert resp.cost["eps_net"] == {"e2e-x": 2.0, "e2e-y": 1.0}
+        snap = srv.stats_snapshot()
+        assert snap["costs"]["records"] == 1
+        rec.dump("cli")
+    finally:
+        srv.close()
+        install(None)
+    story = reconstruct(read_dump(path), resp.trace_id)
+    names = [sp["name"] for sp in story["spans"]]
+    assert names[0] == "serve.request" and "serve.kernel" in names
+    assert story["cost"]["trace_id"] == resp.trace_id
+    assert story["eps_net"] == {"e2e-x": 2.0, "e2e-y": 1.0}

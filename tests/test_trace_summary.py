@@ -1,0 +1,157 @@
+"""benchmarks/trace_summary.py percentile math + obs.transfer diffs.
+
+The span-JSONL half of trace_summary reuses the serving stack's
+nearest-rank percentiles so a p99 printed here means the same thing as
+the ``/stats`` p99 — these tests pin that arithmetic on known sets,
+the empty and single-span degenerate cases, and the transfer-counter
+delta logic under genuinely concurrent pipelines (the exact scenario
+the per-registry bundle exists for).
+"""
+
+import json
+import threading
+
+import pytest
+
+from benchmarks.trace_summary import summarize_spans
+from dpcorr.obs.metrics import Registry
+from dpcorr.obs.transfer import TransferCounters, diff
+from dpcorr.serve.stats import percentiles
+
+
+def _span(name, dur_s, i=0):
+    return {"name": name, "trace_id": f"t{i:04x}", "span_id": f"s{i:04x}",
+            "parent_id": None, "ts": float(i), "dur_s": float(dur_s),
+            "thread": "main", "attrs": {}}
+
+
+# ----------------------------------------------------------- percentiles ----
+def test_percentiles_known_set_1_to_100():
+    vals = [float(v) for v in range(1, 101)]
+    p = percentiles(vals)
+    # nearest-rank on n=100: p50 is the 50th value, p99 the 99th
+    assert p == {"p50": 50.0, "p99": 99.0}
+
+
+def test_percentiles_order_invariant_and_small_sets():
+    assert percentiles([3.0, 1.0, 2.0]) == percentiles([1.0, 2.0, 3.0])
+    # n=4: rank(p50) = round(0.5*4)-1 = 1 -> second value
+    assert percentiles([10.0, 20.0, 30.0, 40.0])["p50"] == 20.0
+    # n=2: p99 clamps to the last value
+    assert percentiles([5.0, 7.0])["p99"] == 7.0
+
+
+def test_percentiles_empty_is_absent_not_zero():
+    assert percentiles([]) == {}
+
+
+def test_percentiles_custom_quantiles():
+    p = percentiles([float(v) for v in range(1, 11)], qs=(0.1, 0.9))
+    assert p == {"p10": 1.0, "p90": 9.0}
+
+
+# ------------------------------------------------------- summarize_spans ----
+def test_summarize_spans_known_sets():
+    spans = [_span("serve.kernel", d, i)
+             for i, d in enumerate(float(v) for v in range(1, 101))]
+    spans += [_span("serve.admit", 0.5, 1000 + i) for i in range(3)]
+    s = summarize_spans(spans)
+    assert s["spans"] == 103
+    k = s["names"]["serve.kernel"]
+    assert (k["count"], k["p50_s"], k["p99_s"]) == (100, 50.0, 99.0)
+    assert k["total_s"] == pytest.approx(5050.0)
+    a = s["names"]["serve.admit"]
+    assert (a["count"], a["p50_s"], a["p99_s"]) == (3, 0.5, 0.5)
+    # ordered by total time descending
+    assert list(s["names"]) == ["serve.kernel", "serve.admit"]
+
+
+def test_summarize_spans_empty_input():
+    assert summarize_spans([]) == {"spans": 0, "names": {}}
+
+
+def test_summarize_spans_single_span():
+    s = summarize_spans([_span("grid.point", 0.125)])
+    r = s["names"]["grid.point"]
+    # one sample: every percentile is that sample
+    assert (r["count"], r["p50_s"], r["p99_s"]) == (1, 0.125, 0.125)
+    assert s["spans"] == 1
+
+
+def test_summarize_spans_top_truncates_by_total():
+    spans = ([_span("big", 10.0, i) for i in range(2)]
+             + [_span("small", 0.1, 10 + i) for i in range(5)])
+    s = summarize_spans(spans, top=1)
+    assert list(s["names"]) == ["big"]
+    assert s["spans"] == 7  # the span count is pre-truncation
+
+
+def test_summarize_spans_from_jsonl_file(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    with open(path, "w", encoding="utf-8") as f:
+        for i, d in enumerate((0.01, 0.02, 0.03)):
+            f.write(json.dumps(_span("serve.request", d, i)) + "\n")
+    s = summarize_spans(str(path))
+    assert s["names"]["serve.request"]["count"] == 3
+
+
+# ------------------------------------------------------ transfer counters ----
+def test_transfer_diff_basic():
+    tc = TransferCounters(Registry())
+    before = tc.snapshot()
+    tc.donated_blocks.inc(3)
+    tc.fetches.inc()
+    d = diff(tc.snapshot(), before)
+    assert d["donated_blocks"] == 3 and d["fetches"] == 1
+    assert d["reshard_mismatch"] == 0
+
+
+def test_transfer_diff_tolerates_missing_before_keys():
+    tc = TransferCounters(Registry())
+    tc.device_puts.inc(2)
+    d = diff(tc.snapshot(), {})  # an older artifact without the key
+    assert d["device_put"] == 2
+
+
+def test_transfer_counters_isolated_registries_under_concurrency():
+    """Two pipelines with their own bundles must never cross-contaminate
+    counts — the reason TransferCounters takes an explicit registry."""
+    bundles = [TransferCounters(Registry()) for _ in range(4)]
+    per_thread = 500
+
+    def run(tc):
+        for _ in range(per_thread):
+            tc.donated_blocks.inc()
+            tc.device_put_bytes.inc(128)
+        tc.fetches.inc()  # one fetch at the reduction boundary
+
+    threads = [threading.Thread(target=run, args=(tc,)) for tc in bundles]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for tc in bundles:
+        snap = tc.snapshot()
+        assert snap["donated_blocks"] == per_thread
+        assert snap["device_put_bytes"] == per_thread * 128
+        assert snap["fetches"] == 1
+
+
+def test_transfer_shared_bundle_concurrent_increments_are_exact():
+    """A shared bundle (the process-default shape) must count exactly
+    under contention — counter increments take the metric lock."""
+    tc = TransferCounters(Registry())
+    before = tc.snapshot()
+    n_threads, per_thread = 8, 400
+
+    def run():
+        for _ in range(per_thread):
+            tc.donated_blocks.inc()
+
+    threads = [threading.Thread(target=run) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert diff(tc.snapshot(), before)["donated_blocks"] \
+        == n_threads * per_thread

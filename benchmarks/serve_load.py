@@ -124,7 +124,26 @@ def main() -> int:
                     metavar="SPEC",
                     help="install a chaos fault before traffic (spec "
                          "as in `dpcorr serve --fault`; testing only)")
+    ap.add_argument("--users", type=int, default=0, metavar="N",
+                    help="run the PR 10 budget-directory scale drill "
+                         "instead of the standard load: N distinct "
+                         "synthetic users through the CompositeLedger, "
+                         "gating on EXACT ledger balance (dyadic ε), "
+                         "zero ε for refused requests, eviction + "
+                         "rehydration > 0, and recording admission "
+                         "p50/p99 (no kernels execute)")
+    ap.add_argument("--users-shards", dest="users_shards", type=int,
+                    default=64, help="--users mode: directory shards")
+    ap.add_argument("--users-max-resident", dest="users_max_resident",
+                    type=int, default=2048,
+                    help="--users mode: LRU cap per shard (small "
+                         "enough that evictions are guaranteed)")
     args = ap.parse_args()
+
+    if args.users:
+        # no kernels, no traffic — pure admission arithmetic; runs
+        # before any jax configuration on purpose
+        return run_users(args)
 
     import jax
 
@@ -511,6 +530,143 @@ def recorder_ab(args) -> dict:
             "overhead_ratio": round(p50_on / p50_off, 4)
             if p50_off > 0 else None,
             "ok": p50_on <= p50_off * 1.03 + 1e-3}
+
+
+def run_users(args) -> int:
+    """PR 10 scale drill: N distinct users (≥ 1M in CI) through one
+    :class:`~dpcorr.serve.budget_dir.CompositeLedger` admission path.
+
+    Every ε in the scenario is dyadic (party legs 2^-4 each, user leg
+    2^-3, user budget 2^-2), so every balance gate is EXACT float
+    equality, not a tolerance: spent == Σ per-user charges net of
+    refunds at both the directory and the party ledger, refused
+    requests consume zero ε at every level, and per-user spot checks
+    land on their class's exact balance. The directory runs with a
+    deliberately small residency cap so the LRU spill/rehydrate path
+    is exercised at scale (counters gated > 0). ``fsync`` is off —
+    the drill measures admission arithmetic and the journaling write
+    path, not the disk; the chaos harness owns durability proof."""
+    import shutil
+    import tempfile
+
+    from dpcorr.serve.budget_dir import BudgetDirectory, CompositeLedger
+    from dpcorr.serve.ledger import BudgetExceededError, PrivacyLedger
+    from dpcorr.serve.stats import percentiles
+
+    n_users = args.users
+    # dyadic legs: party 2^-4 per side, user leg = their sum = 2^-3,
+    # user budget 2^-2 — every user fits exactly two charges
+    leg = 0.0625
+    user_leg = 2 * leg
+    user_budget = 2 * user_leg
+    root = tempfile.mkdtemp(prefix="dpcorr-users-")
+    directory = BudgetDirectory(
+        os.path.join(root, "dir"), shards=args.users_shards,
+        user_budget=user_budget,
+        max_resident=args.users_max_resident,
+        # compaction folds the WHOLE user table per cycle — amortised
+        # fine at serving rates, pathological in a tight 1M-user loop;
+        # the WAL alone is the authoritative journal either way
+        compact_every=None, fsync=False)
+    comp = CompositeLedger(PrivacyLedger(1e9), directory)
+    charges = {"pa": leg, "pb": leg}
+
+    lat: list[float] = []
+    admitted = 0
+    refused = 0
+    refused_levels: dict[str, int] = {}
+    t0 = time.perf_counter()
+
+    def charge(i: int, k: int) -> None:
+        nonlocal admitted, refused
+        aug = comp.augment(charges, user=f"u{i:07d}")
+        t = time.perf_counter()
+        try:
+            comp.charge(aug, charge_id=f"c:{i}:{k}")
+        except BudgetExceededError as e:
+            refused += 1
+            refused_levels[e.level] = refused_levels.get(e.level, 0) + 1
+        else:
+            admitted += 1
+        lat.append(time.perf_counter() - t)
+
+    # phase 1: every user charges once; phase 2: every 8th user again
+    # (their window is now full); phase 3: every 64th user attempts a
+    # third — refused at the user level, charge-free; phase 4: every
+    # 16th user's second charge is refunded (shed-path arithmetic)
+    for i in range(n_users):
+        charge(i, 0)
+    for i in range(0, n_users, 8):
+        charge(i, 1)
+    for i in range(0, n_users, 64):
+        charge(i, 2)
+    n_refunds = 0
+    for i in range(0, n_users, 16):
+        comp.refund(comp.augment(charges, user=f"u{i:07d}"),
+                    charge_id=f"c:{i}:1", reason="shed")
+        n_refunds += 1
+    wall = time.perf_counter() - t0
+
+    expect_admitted = n_users + -(-n_users // 8)
+    expect_refused = -(-n_users // 64)
+    counters = directory.counters()
+    # EXACT: dyadic sums accumulate with no rounding
+    dir_balance = (counters["charged_eps"]
+                   == user_leg * expect_admitted
+                   and counters["refunded_eps"] == user_leg * n_refunds)
+    ledger_balance = (
+        comp.ledger.spent("pa") == leg * (expect_admitted - n_refunds)
+        and comp.ledger.spent("pb") == leg * (expect_admitted
+                                              - n_refunds))
+    # spot checks: each sampled user sits on its class's exact balance
+    spot_every = max(1, n_users // 1000)
+    spot_checked = spot_mismatches = 0
+    for i in range(0, n_users, spot_every):
+        want = (user_leg if i % 16 == 0
+                else user_budget if i % 8 == 0 else user_leg)
+        spot_checked += 1
+        if directory.spent(f"u{i:07d}") != want:
+            spot_mismatches += 1
+    pct = percentiles(lat, (0.5, 0.99))
+    ok = {
+        "admitted_expected": admitted == expect_admitted,
+        "refused_expected": refused == expect_refused
+                            and refused_levels == {"user":
+                                                   expect_refused},
+        "directory_balance_exact": dir_balance,
+        "ledger_balance_exact": ledger_balance,
+        "spot_checks_exact": spot_checked > 0 and spot_mismatches == 0,
+        "refusals_charge_free": comp.refusals_by_level()["user"]
+                                == expect_refused,
+        "evictions": counters["evictions"] > 0,
+        "rehydrations": counters["rehydrations"] > 0,
+    }
+    out = {
+        "metric": "serve_users",
+        "users": n_users,
+        "shards": directory.n_shards,
+        "max_resident_per_shard": args.users_max_resident,
+        "charges_admitted": admitted,
+        "charges_refused": refused,
+        "refused_by_level": refused_levels,
+        "refunds": n_refunds,
+        "wall_s": round(wall, 3),
+        "admissions_per_sec": round(len(lat) / wall, 1),
+        "admission_p50_s": round(pct["p50"], 9),
+        "admission_p99_s": round(pct["p99"], 9),
+        "spot_checks": spot_checked,
+        "spot_mismatches": spot_mismatches,
+        "directory": comp.directory_snapshot(),
+        "ok": ok,
+    }
+    comp.close()
+    shutil.rmtree(root)
+    blob = json.dumps(out, indent=2)
+    print(blob)
+    if args.out_json:
+        with open(args.out_json, "w") as f:
+            f.write(blob)
+    return 0 if all(ok.values()) else 1
 
 
 def run_overload(args) -> int:

@@ -272,6 +272,9 @@ def cmd_serve(args):
                                   "batch_mode": args.batch_mode,
                                   "trace": args.trace,
                                   "audit": args.audit,
+                                  "user_dir": args.user_dir,
+                                  "user_budget": args.user_budget,
+                                  "global_budget": args.global_budget,
                                   "warmup": server.readiness(),
                                   "warmup_manifest": args.warmup_manifest,
                                   "aot": args.aot,
@@ -320,32 +323,74 @@ def _build_server(args):
                      if args.flush_slo_ms is not None else None),
         brownout_enter_s=args.brownout_enter_s,
         brownout_exit_s=args.brownout_exit_s,
-        brownout_min_priority=args.brownout_min_priority)
+        brownout_min_priority=args.brownout_min_priority,
+        user_dir=args.user_dir, user_budget=args.user_budget,
+        user_shards=args.user_shards,
+        user_max_resident=args.user_max_resident,
+        user_compact_every=args.user_compact_every,
+        user_renew_period_s=args.user_renew_period_s,
+        user_burst_cap=args.user_burst_cap,
+        global_budget=args.global_budget)
 
 
 def cmd_obs_budget(args):
     """Replay a privacy-budget audit trail (docs/OBSERVABILITY.md):
     per-event ε timeline plus the replayed per-party spend table, which
-    must equal the ledger snapshot's ``spent`` values."""
+    must equal the ledger snapshot's ``spent`` values.
+
+    With ``--budget-dir`` the replay additionally folds the sharded
+    per-user trails (``user/<id>`` legs) and proves them against the
+    directory's own on-disk arithmetic: every user's replayed lifetime
+    spend must equal the lifetime the shard files reconstruct to
+    (snapshot + WAL, the exact recovery path a restart takes). All
+    jax-free — this audits a production directory from a laptop."""
     from dpcorr.obs import read_events, replay, timeline
+    from dpcorr.obs.budget_replay import USER_PREFIX, read_user_balances
 
     events = read_events(args.audit)
     rows = timeline(events, party=args.party)
     totals = replay(events)
+    dir_check = None
+    if args.budget_dir:
+        replayed_users = {p[len(USER_PREFIX):]: s
+                          for p, s in totals.items()
+                          if p.startswith(USER_PREFIX)}
+        bal = read_user_balances(args.budget_dir)
+        mismatches = []
+        for user in sorted(set(replayed_users) | set(bal)):
+            want = replayed_users.get(user, 0.0)
+            got = bal.get(user, {}).get("l", 0.0)
+            if abs(want - got) > 1e-9:
+                mismatches.append({"user": user, "replayed": want,
+                                   "directory": got})
+        dir_check = {"ok": not mismatches, "users": len(bal),
+                     "replayed_users": len(replayed_users),
+                     "mismatches": mismatches}
     if args.party is not None:
         totals = {args.party: totals.get(args.party, 0.0)}
     if args.json:
-        print(json.dumps({"events": len(events), "timeline": rows,
-                          "spent": totals}, indent=2))
-        return
-    for r in rows:
-        after = " ".join(f"{p}={s:.6g}"
-                         for p, s in sorted(r["spent_after"].items()))
-        print(f"[{r['seq']:6d}] {r['kind']:<8} "
-              f"trace={r['trace_id'] or '-':<17} {after}")
-    print(f"{len(events)} events; replayed spend:")
-    for p, s in sorted(totals.items()):
-        print(f"  {p}: {s:.6g}")
+        out = {"events": len(events), "timeline": rows, "spent": totals}
+        if dir_check is not None:
+            out["budget_dir"] = dir_check
+        print(json.dumps(out, indent=2))
+    else:
+        for r in rows:
+            after = " ".join(f"{p}={s:.6g}"
+                             for p, s in sorted(r["spent_after"].items()))
+            print(f"[{r['seq']:6d}] {r['kind']:<8} "
+                  f"trace={r['trace_id'] or '-':<17} {after}")
+        print(f"{len(events)} events; replayed spend:")
+        for p, s in sorted(totals.items()):
+            print(f"  {p}: {s:.6g}")
+        if dir_check is not None:
+            print(f"budget dir: {dir_check['users']} users on disk, "
+                  f"{dir_check['replayed_users']} in the trail — "
+                  f"{'OK' if dir_check['ok'] else 'MISMATCH'}")
+            for m in dir_check["mismatches"]:
+                print(f"  {m['user']}: replayed {m['replayed']:.6g} != "
+                      f"directory {m['directory']:.6g}")
+    if dir_check is not None and not dir_check["ok"]:
+        sys.exit(1)
 
 
 def cmd_obs_chrome(args):
@@ -534,6 +579,19 @@ def cmd_party(args):
                                timeout_s=args.connect_timeout)
     audit = AuditTrail(args.audit) if args.audit else None
     ledger = PrivacyLedger(args.budget, path=args.ledger, audit=audit)
+    if args.user_dir:
+        # per-user admission rides the gate unchanged: the composite
+        # derives the user/ leg inside the same charge/refund calls,
+        # and both stores recover their exact balances on restart
+        from dpcorr.serve.budget_dir import BudgetDirectory, CompositeLedger
+
+        directory = BudgetDirectory(
+            args.user_dir, shards=args.user_shards,
+            user_budget=args.user_budget,
+            max_resident=args.user_max_resident,
+            compact_every=args.user_compact_every, audit=audit)
+        ledger = CompositeLedger(ledger, directory,
+                                 user=args.user or f"user-{args.role}")
     channel = ReliableChannel(link, timeout_s=args.timeout,
                               max_retries=args.max_retries)
     transcript = Transcript(args.transcript)
@@ -551,6 +609,8 @@ def cmd_party(args):
         link.close()
         if srv is not None:
             srv.close()
+        if args.user_dir:
+            ledger.close()  # CompositeLedger: releases shard spill files
     print(json.dumps({"result": _result_json(res)}, indent=2))
 
 
@@ -654,6 +714,11 @@ def cmd_chaos(args):
 
     def party_argv(family: str, role: str, port: int,
                    case_dir: str) -> list[str]:
+        # every case also runs a per-user budget directory with the
+        # most hostile knobs it supports — evict after every release
+        # (max-resident 0) and compact after every charge — so each
+        # protocol send crosses ALL the directory persist windows, and
+        # the post-restart assertion proves exact per-user balances
         return [sys.executable, "-m", "dpcorr", "party",
                 "--role", role, "--host", "127.0.0.1",
                 "--port", str(port),
@@ -669,6 +734,10 @@ def cmd_chaos(args):
                 "--journal", os.path.join(case_dir, f"journal.{role}.json"),
                 "--ledger", os.path.join(case_dir, f"ledger.{role}.json"),
                 "--audit", os.path.join(case_dir, f"audit.{role}.jsonl"),
+                "--user", f"user-{role}",
+                "--user-dir", os.path.join(case_dir, f"budget-{role}"),
+                "--user-budget", "100", "--user-shards", "2",
+                "--user-max-resident", "0", "--user-compact-every", "1",
                 "--transcript",
                 os.path.join(case_dir, f"transcript.{role}.jsonl")]
 
@@ -800,6 +869,35 @@ def _run_chaos_case(args, family, role, point, case_dir, ref, spec,
                     f"role {r} spent {spent.get(party_name, 0.0)!r} for "
                     f"{party_name}, expected exactly one charge of "
                     f"{eps!r}")
+        # the per-user directory must recover to the exact same
+        # balance: every release charged the bound user once (the
+        # composite's user leg equals the send's party total), through
+        # whatever persist window the kill landed in. read_user_balances
+        # IS the restart recovery arithmetic (obs.budget_replay), so
+        # this also proves the shard files replay clean.
+        from dpcorr.obs.budget_replay import read_user_balances
+
+        budget_dir = os.path.join(case_dir, f"budget-{r}")
+        want = sum(spec.charges_for(r).values())
+        got = read_user_balances(budget_dir).get(
+            f"user-{r}", {}).get("l", 0.0)
+        if abs(got - want) > 1e-9:
+            errs.append(
+                f"role {r} user directory recovered lifetime {got!r} "
+                f"for user-{r}, expected exactly-once charges "
+                f"totalling {want!r}")
+        # and the jax-free auditor must agree end-to-end: the sharded
+        # per-user trail folded from the audit log equals the
+        # directory's own arithmetic (exit 1 on any mismatch)
+        chk = subprocess.run(
+            [sys.executable, "-m", "dpcorr", "obs", "budget",
+             "--audit", os.path.join(case_dir, f"audit.{r}.jsonl"),
+             "--budget-dir", budget_dir, "--json"],
+            capture_output=True, text=True)
+        if chk.returncode != 0:
+            errs.append(
+                f"role {r} obs budget replay disagreed with the "
+                f"directory: {chk.stdout.strip()[-400:]}")
     return errs
 
 
@@ -873,6 +971,41 @@ def main(argv=None):
     ps_.add_argument("--ledger", default=None,
                      help="ledger persistence path (JSON); restarts resume "
                           "the spend table, so budgets survive crashes")
+    ps_.add_argument("--user-dir", dest="user_dir", default=None,
+                     help="per-user budget directory root (sharded WAL + "
+                          "snapshot store, docs/SERVING.md): enables "
+                          "per-user admission for requests carrying "
+                          "'user'; restarts recover exact balances")
+    ps_.add_argument("--user-budget", dest="user_budget", type=float,
+                     default=1.0,
+                     help="per-user ε budget per renewal window")
+    ps_.add_argument("--user-shards", dest="user_shards", type=int,
+                     default=8,
+                     help="directory shard count (pinned in meta.json on "
+                          "first boot; reopens adopt the persisted count)")
+    ps_.add_argument("--user-max-resident", dest="user_max_resident",
+                     type=int, default=None,
+                     help="LRU cap on in-memory users per shard; colder "
+                          "users spill to disk and rehydrate on touch "
+                          "(default: unbounded)")
+    ps_.add_argument("--user-compact-every", dest="user_compact_every",
+                     type=int, default=256,
+                     help="fold the shard WAL into its snapshot every "
+                          "this many journal appends (None-like 0 "
+                          "disables)")
+    ps_.add_argument("--user-renew-period-s", dest="user_renew_period_s",
+                     type=float, default=86400.0,
+                     help="per-user window length: spend resets every "
+                          "period (daily ε refresh by default)")
+    ps_.add_argument("--user-burst-cap", dest="user_burst_cap",
+                     type=float, default=0.0,
+                     help="unspent window ε carried into the next window "
+                          "as burst credit, capped here (0 disables)")
+    ps_.add_argument("--global-budget", dest="global_budget", type=float,
+                     default=None,
+                     help="whole-replica ε ceiling, charged atomically "
+                          "with the per-party legs (reserved principal "
+                          "global/total)")
     ps_.add_argument("--max-batch", dest="max_batch", type=int, default=64,
                      help="flush a bucket at this many live requests")
     ps_.add_argument("--max-delay-ms", dest="max_delay_ms", type=float,
@@ -971,6 +1104,11 @@ def main(argv=None):
                      help="audit-trail JSONL path (serve --audit)")
     pob.add_argument("--party", default=None,
                      help="restrict the timeline to one party")
+    pob.add_argument("--budget-dir", dest="budget_dir", default=None,
+                     help="per-user budget directory root: fold the "
+                          "trail's sharded user/ legs and prove them "
+                          "equal to the directory's on-disk recovery "
+                          "arithmetic (exit 1 on mismatch); jax-free")
     pob.add_argument("--json", action="store_true")
     pob.set_defaults(fn=cmd_obs_budget, platform=None, jax_free=True)
     poc = obs_sub.add_parser("chrome", help="convert a span JSONL log "
@@ -1046,6 +1184,25 @@ def main(argv=None):
     pp_.add_argument("--ledger", default=None,
                      help="ledger persistence path (JSON), same format "
                           "as serve --ledger")
+    pp_.add_argument("--user", default=None,
+                     help="principal this party's releases are charged "
+                          "to in the per-user directory (default with "
+                          "--user-dir: user-<role>)")
+    pp_.add_argument("--user-dir", dest="user_dir", default=None,
+                     help="per-user budget directory root: wraps the "
+                          "ledger in a CompositeLedger so every gated "
+                          "release also charges the bound user, "
+                          "idempotently across crash-restarts")
+    pp_.add_argument("--user-budget", dest="user_budget", type=float,
+                     default=1.0, help="per-user ε budget per window")
+    pp_.add_argument("--user-shards", dest="user_shards", type=int,
+                     default=8, help="directory shard count")
+    pp_.add_argument("--user-max-resident", dest="user_max_resident",
+                     type=int, default=None,
+                     help="LRU cap on in-memory users per shard")
+    pp_.add_argument("--user-compact-every", dest="user_compact_every",
+                     type=int, default=256,
+                     help="WAL-to-snapshot compaction interval (appends)")
     pp_.add_argument("--transcript", default=None,
                      help="JSONL wire transcript path (audit it with "
                           "`dpcorr protocol scan`)")

@@ -33,6 +33,15 @@ a channel handed in by the gate) requests are charged by contract:
   *name* rather than a ledger receiver: every shed site must at least
   route through something named refund. ISSUE 8 added three such sites
   at once; this is the shape that keeps the next one honest.
+- ``budget-multi-charge-missing-refund`` — a function charges two
+  *distinct* budget receivers (``ledger`` and the per-user
+  ``directory``, serve.budget_dir) and any charge after the first
+  receiver's is not inside a ``try`` whose handler reaches a refund:
+  a refusal from the second store would leave the first one charged —
+  the exact partial-spend the CompositeLedger's compensation path
+  exists to prevent. The directory is itself a budget receiver for
+  every rule here: ``directory.charge`` dominates an enqueue the same
+  way ``ledger.charge`` does.
 """
 
 from __future__ import annotations
@@ -57,7 +66,9 @@ ENQUEUE_RECEIVERS = frozenset({"coalescer", "cache", "channel",
 
 CHARGE_FNS = frozenset({"charge", "charge_request"})
 REFUND_FNS = frozenset({"refund"})
-LEDGER_NAMES = frozenset({"ledger"})
+#: budget receivers: the per-party ledger and the per-user budget
+#: directory (serve.budget_dir) are both charge/refund sinks.
+LEDGER_NAMES = frozenset({"ledger", "directory"})
 
 #: exception classes that refuse an ALREADY-ADMITTED (hence charged)
 #: request — settling a future with one of these is a shed site.
@@ -88,6 +99,11 @@ class BudgetChecker(Checker):
         "budget-shed-missing-refund": "future settled with a refusal "
                                       "exception in a function with no "
                                       "refund call",
+        "budget-multi-charge-missing-refund": "charges two budget "
+                                              "receivers without a "
+                                              "compensating refund "
+                                              "handler on the later "
+                                              "charge",
     }
 
     def applies_to(self, relpath: str) -> bool:
@@ -152,12 +168,48 @@ class BudgetChecker(Checker):
                 return True
         return False
 
+    @staticmethod
+    def _charge_receiver(call: ast.Call) -> str:
+        """Which budget receiver a charge call hits (``ledger`` /
+        ``directory``) — the first chain part that names one."""
+        for part in attr_chain(call.func):
+            if part in LEDGER_NAMES:
+                return part
+        return "?"
+
+    def _check_multi_charge(self, module: Module, fn,
+                            charges: list[ast.Call]) -> Iterator[Violation]:
+        """``budget-multi-charge-missing-refund``: once a function has
+        charged one receiver, every charge against a *different*
+        receiver is a partial-spend hazard — a refusal there must be
+        able to compensate the first store, so the later charge has to
+        sit in a ``try`` whose handler reaches a refund."""
+        if len({self._charge_receiver(c) for c in charges}) < 2:
+            return
+        first = min(charges, key=lambda c: c.lineno)
+        for call in charges:
+            if self._charge_receiver(call) == \
+                    self._charge_receiver(first):
+                continue
+            if not self._refund_guarded(fn, call):
+                yield Violation(
+                    "budget-multi-charge-missing-refund", module.relpath,
+                    call.lineno,
+                    f"{'.'.join(attr_chain(call.func))} charges a "
+                    f"second budget receiver after "
+                    f"{self._charge_receiver(first)} was charged — a "
+                    f"refusal here would leave the first store spent; "
+                    f"wrap it in a try whose handler refunds the "
+                    f"applied legs")
+
     def _check_fn(self, module: Module, fn) -> Iterator[Violation]:
-        charge_lines = []
+        charge_calls = []
         for node in walk_same_scope(fn):
             if isinstance(node, ast.Call) and _is_ledger_call(node,
                                                               CHARGE_FNS):
-                charge_lines.append(node.lineno)
+                charge_calls.append(node)
+        yield from self._check_multi_charge(module, fn, charge_calls)
+        charge_lines = [c.lineno for c in charge_calls]
         first_charge = min(charge_lines) if charge_lines else None
         for node in walk_same_scope(fn):
             if not (isinstance(node, ast.Call) and _is_enqueue_call(node)):

@@ -60,6 +60,12 @@ KNOWN_POINTS = (
     # serve flush pipeline (serve/coalescer.py)
     "coalescer.pre_flush",    # batch popped, kernel not dispatched
     "coalescer.post_flush",   # responses resolved, stats published
+    # budget-directory persist windows (serve/budget_dir.py) — every
+    # durability boundary of a sharded per-user charge
+    "budget.pre_journal",     # admitted, WAL line not yet appended
+    "budget.post_journal",    # WAL line fsynced, not applied in memory
+    "budget.mid_compaction",  # snapshot gen+1 renamed, WAL still gen
+    "budget.mid_eviction",    # cold spill appended, user still resident
 )
 
 #: The step-kill matrix `dpcorr chaos` sweeps: the points every protocol
@@ -73,6 +79,14 @@ MATRIX_POINTS = (
     "ledger.post_persist",
     "gate.post_send",
     "party.post_gated",
+    # budget-directory windows: traversed once per gated charge when
+    # the party wraps its ledger in a CompositeLedger (the chaos driver
+    # arms the directory with compact-every=1 / max-resident=0 so the
+    # compaction and eviction windows fire on that same charge)
+    "budget.pre_journal",
+    "budget.post_journal",
+    "budget.mid_compaction",
+    "budget.mid_eviction",
 )
 
 _MODES = ("exit", "raise")

@@ -168,6 +168,18 @@ def replay(events: Iterable[dict]) -> dict[str, float]:
     return spent
 
 
+def replay_levels(events: Iterable[dict]) -> dict[str, dict]:
+    """Replay split by budget level: ``{"party", "user", "global"}``
+    spend tables (user keys are bare ids, ``user/`` prefix stripped).
+    This is how ``obs budget --budget-dir`` folds a sharded per-user
+    trail back to the budget directory's arithmetic — the ``user``
+    table must equal each user's directory *lifetime* spend (renewals
+    reset only the admission window and draw no audit event)."""
+    from dpcorr.obs.budget_replay import fold_levels
+
+    return fold_levels(replay(events))
+
+
 def timeline(events: Iterable[dict], party: str | None = None) -> list[dict]:
     """Per-event cumulative view: each row is one event with the
     running post-event spend of every party it touched — the ε-spend

@@ -140,6 +140,20 @@ def render_frame(stats: dict, metrics: dict,
             f"{name}={_fmt_eps(spent)}"
             + (f"/{_fmt_eps(budget)}" if budget else "")
             for name, spent, budget in rows))
+
+    bd = stats.get("budget_dir")
+    if bd:
+        c = bd.get("counters", {})
+        lines.append(
+            f"budget dir  : {bd.get('shards', 0)} shards   "
+            f"{bd.get('resident_users', 0)} resident / "
+            f"{bd.get('evicted_users', 0)} evicted users   "
+            f"{c.get('rehydrations', 0)} rehydrations")
+        refusals = bd.get("refusals_by_level", {})
+        if any(refusals.values()):
+            lines.append("  refusals  : " + "   ".join(
+                f"{lvl}={refusals.get(lvl, 0)}"
+                for lvl in ("user", "party", "global")))
     return "\n".join(lines)
 
 

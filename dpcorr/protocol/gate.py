@@ -37,7 +37,16 @@ from dpcorr.serve.ledger import PrivacyLedger
 class ReleaseGate:
     """Charges ``ledger`` before any gated send; refunds on transport
     failure. The party runtime holds its ledger only through this gate,
-    so every path from estimator output to the wire passes here."""
+    so every path from estimator output to the wire passes here.
+
+    ``ledger`` may be a plain :class:`PrivacyLedger` or a
+    :class:`~dpcorr.serve.budget_dir.CompositeLedger` bound to a user:
+    the gate always passes the *party* charges, and the composite
+    derives its ``user/`` / ``global/`` legs inside the same
+    ``charge``/``refund`` calls — so per-user accounting rides the
+    gate's charge-before-send and refund-on-transport-failure
+    discipline unchanged, and the receipt's ``eps`` (the transcript
+    column) stays party-leg-only by construction."""
 
     def __init__(self, ledger: PrivacyLedger):
         self.ledger = ledger

@@ -32,6 +32,7 @@ from __future__ import annotations
 import math
 
 from dpcorr.obs.audit import replay
+from dpcorr.obs.budget_replay import RESERVED_PREFIXES
 from dpcorr.protocol.messages import (
     MSG_TYPES,
     PROTOCOL_VERSION,
@@ -215,7 +216,14 @@ def ledger_balance(transcript, audit_events: list[dict]) -> dict:
     standing in for an original line lost between ledger persist and
     audit append); a refund forgets the id so a genuinely new charge
     may reuse it; transcript send lines sharing a charge_id (an
-    original plus its journal-replayed duplicate) collapse to one."""
+    original plus its journal-replayed duplicate) collapse to one.
+
+    Reserved directory legs (``user/``, ``global/`` — serve.budget_dir)
+    are bookkeeping principals, not wire spend: the transcript's ``eps``
+    is party-leg-only by construction, so matching sums only the party
+    legs of each event, and events consisting *only* of reserved legs
+    (the directory's own per-user trail lines) are accounted by the
+    replay but never expected to match a send."""
     entries = (read_transcript(transcript) if isinstance(transcript, str)
                else list(transcript))
     sends = []
@@ -247,8 +255,14 @@ def ledger_balance(transcript, audit_events: list[dict]) -> dict:
                 applied.pop(cid, None)
             else:
                 refunded_tids.add(ev.get("trace_id"))
-    charges = list(applied.values()) + [
-        ev for ev in anon if ev.get("trace_id") not in refunded_tids]
+    def _party_eps(ev: dict) -> float:
+        return sum(float(e) for p, e in ev["charges"].items()
+                   if not p.startswith(RESERVED_PREFIXES))
+
+    charges = [ev for ev in list(applied.values()) +
+               [ev for ev in anon
+                if ev.get("trace_id") not in refunded_tids]
+               if _party_eps(ev) > 0.0]
 
     unmatched_sends = []
     pool = list(charges)
@@ -260,11 +274,11 @@ def ledger_balance(transcript, audit_events: list[dict]) -> dict:
         for ev in pool:
             if cid is not None:
                 if ev.get("charge_id") == cid \
-                        and abs(sum(ev["charges"].values()) - eps) < 1e-9:
+                        and abs(_party_eps(ev) - eps) < 1e-9:
                     hit = ev
                     break
             elif ev.get("trace_id") == tid \
-                    and abs(sum(ev["charges"].values()) - eps) < 1e-9:
+                    and abs(_party_eps(ev) - eps) < 1e-9:
                 hit = ev
                 break
         if hit is None:
@@ -273,7 +287,7 @@ def ledger_balance(transcript, audit_events: list[dict]) -> dict:
         else:
             pool.remove(hit)
     unmatched_charges = [{"seq": ev.get("seq"),
-                          "eps": sum(ev["charges"].values()),
+                          "eps": _party_eps(ev),
                           "trace_id": ev.get("trace_id"),
                           "charge_id": ev.get("charge_id")}
                          for ev in pool]

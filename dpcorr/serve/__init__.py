@@ -11,6 +11,11 @@ up — each module's docstring carries its own contract:
 - :mod:`ledger`    — per-party ε accounting under basic composition:
   refusal before execution, write-ahead persistence (no double-spend
   across restarts).
+- :mod:`budget_dir` — sharded per-user budget directory (millions of
+  principals): per-shard write-ahead journal + snapshot compaction,
+  LRU cold-user eviction, renewal/burst policies, and the
+  CompositeLedger folding per-user + per-party + global admission into
+  one atomic charge with one refund path.
 - :mod:`kernels`   — compiled-kernel cache keyed on (signature, padded
   batch width); optional mesh sharding of wide flushes.
 - :mod:`stats`     — live counters: queue depth, flush sizes,
@@ -51,6 +56,14 @@ from dpcorr.serve.overload import (  # noqa: F401
     CircuitBreaker,
     CircuitOpenError,
     DeadlineExpiredError,
+)
+from dpcorr.serve.budget_dir import (  # noqa: F401
+    BudgetDirectory,
+    CompositeLedger,
+    DirectoryCorruptError,
+    RenewalPolicy,
+    party_view,
+    user_view,
 )
 from dpcorr.serve.ledger import (  # noqa: F401
     BudgetExceededError,

@@ -53,8 +53,15 @@ from typing import Mapping
 
 from dpcorr import chaos
 from dpcorr.obs.audit import AuditTrail
+from dpcorr.obs.budget_replay import quarantine_corrupt, sweep_stale_tmp
 from dpcorr.obs.metrics import Registry
 from dpcorr.serve.request import EstimateRequest
+
+__all__ = [
+    "BudgetExceededError", "LedgerCorruptError", "PrivacyLedger",
+    "quarantine_corrupt", "release_factor", "request_charges",
+    "sweep_stale_tmp",
+]
 
 _STATE_VERSION = 1
 
@@ -70,8 +77,17 @@ class LedgerCorruptError(ValueError):
     exactly what to do next."""
 
 
+# sweep_stale_tmp / quarantine_corrupt live in obs.budget_replay (the
+# jax-free layer) so the budget directory's shard reader shares them;
+# re-exported here because they are ledger durability idioms first.
+
+
 class BudgetExceededError(Exception):
-    """Admission refused: the query would overdraw a party's ε budget."""
+    """Admission refused: the query would overdraw a principal's ε
+    budget. ``level`` names which budget refused — ``party`` for data
+    owners, ``user`` / ``global`` for the reserved directory
+    namespaces (serve.budget_dir) — so refusal stats and cost events
+    can attribute the refusing level without parsing principal names."""
 
     def __init__(self, party: str, spent: float, charge: float,
                  budget: float):
@@ -79,6 +95,9 @@ class BudgetExceededError(Exception):
         self.spent = spent
         self.charge = charge
         self.budget = budget
+        self.level = ("user" if party.startswith("user/")
+                      else "global" if party.startswith("global/")
+                      else "party")
         super().__init__(
             f"party {party!r}: spent {spent:.6g} + charge {charge:.6g} "
             f"> budget {budget:.6g}")
@@ -154,8 +173,7 @@ class PrivacyLedger:
                 with open(path) as f:
                     state = json.load(f)
             except (json.JSONDecodeError, UnicodeDecodeError) as e:
-                quarantine = path + ".corrupt"
-                os.replace(path, quarantine)
+                quarantine = quarantine_corrupt(path)
                 raise LedgerCorruptError(
                     f"ledger snapshot {path!r} is corrupt ({e}); the bad "
                     f"file was moved to {quarantine!r}. To recover, "
@@ -175,23 +193,9 @@ class PrivacyLedger:
                                 for c in state.get("charge_ids", [])}
             self._publish_locked()
 
-    @staticmethod
-    def _sweep_stale_tmp(path: str) -> None:
-        """Remove ``{path}.tmp.*`` crash artifacts: a tmp file that was
-        never renamed belongs to a write that never committed, and a
-        dead writer will never finish it."""
-        d = os.path.dirname(path) or "."
-        prefix = os.path.basename(path) + ".tmp."
-        try:
-            names = os.listdir(d)
-        except OSError:
-            return
-        for name in names:
-            if name.startswith(prefix):
-                try:
-                    os.unlink(os.path.join(d, name))
-                except OSError:
-                    pass
+    # kept as a staticmethod alias — external callers use the module
+    # function; the constructor predates it
+    _sweep_stale_tmp = staticmethod(sweep_stale_tmp)
 
     def _publish_locked(self) -> None:
         """Mirror the spend table into the per-party gauge (caller holds

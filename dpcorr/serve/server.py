@@ -68,6 +68,12 @@ from dpcorr.obs import trace as obs_trace
 from dpcorr.obs.audit import AuditTrail
 from dpcorr.obs.cost import CostRegistry
 from dpcorr.obs.metrics import CONTENT_TYPE as _PROM_CONTENT_TYPE
+from dpcorr.serve.budget_dir import (
+    BudgetDirectory,
+    CompositeLedger,
+    RenewalPolicy,
+    party_view,
+)
 from dpcorr.serve.coalescer import Coalescer, ServerOverloadedError
 from dpcorr.serve.kernels import KernelCache
 from dpcorr.serve.ledger import BudgetExceededError, PrivacyLedger
@@ -147,7 +153,16 @@ class DpcorrServer:
                  flush_slo_s: float | None = None,
                  brownout_enter_s: float = 0.5,
                  brownout_exit_s: float = 2.0,
-                 brownout_min_priority: int = 0):
+                 brownout_min_priority: int = 0,
+                 user_dir: str | None = None,
+                 user_budget: float = 1.0,
+                 user_shards: int = 8,
+                 user_max_resident: int | None = None,
+                 user_compact_every: int | None = 256,
+                 user_renew_period_s: float = 86400.0,
+                 user_burst_cap: float = 0.0,
+                 user_fsync: bool = True,
+                 global_budget: float | None = None):
         self.seed = seed
         # obs wiring (ISSUE 2): one tracer spans the request lifecycle
         # (admit → charge → enqueue → flush → respond; default is the
@@ -168,6 +183,24 @@ class DpcorrServer:
                                     per_party=per_party_budget,
                                     audit=self.audit,
                                     registry=self.stats.registry)
+        # per-user budget directory (ISSUE 10): with --user-dir the
+        # ledger becomes a CompositeLedger — per-user + per-party +
+        # global admission as one atomic charge with one refund path.
+        # Drop-in: the coalescer's shed-refund and the overload refund
+        # below reverse every leg through the same refund() call.
+        if user_dir is not None or global_budget is not None:
+            directory = None
+            if user_dir is not None:
+                directory = BudgetDirectory(
+                    user_dir, shards=user_shards,
+                    user_budget=user_budget,
+                    renewal=RenewalPolicy(period_s=user_renew_period_s,
+                                          burst_cap=user_burst_cap),
+                    max_resident=user_max_resident,
+                    compact_every=user_compact_every,
+                    fsync=user_fsync, audit=self.audit)
+            self.ledger = CompositeLedger(self.ledger, directory,
+                                          global_budget=global_budget)
         self.cache = KernelCache(stats=self.stats, shard=shard,
                                  mode=batch_mode, max_kernels=max_kernels,
                                  aot=aot, export_dir=export_dir,
@@ -325,6 +358,15 @@ class DpcorrServer:
                 raw = party.encode()
                 h.update(len(raw).to_bytes(4, "big"))
                 h.update(raw)
+            if req.user is not None:
+                # same reasoning as the party names: the user routes a
+                # budget leg (serve.budget_dir), so two users submitting
+                # identical content are different ledger operations.
+                # Folded only when set, so pre-user keys stay identical.
+                raw = req.user.encode()
+                h.update(b"user")
+                h.update(len(raw).to_bytes(4, "big"))
+                h.update(raw)
             return f"pinned:{req.seed}:{h.hexdigest()}"
         return None
 
@@ -434,11 +476,17 @@ class DpcorrServer:
                     with self.tracer.span("serve.ledger.charge"):
                         charges = self.ledger.charge_request(
                             req, trace_id=root.trace_id)
-                    cost.charge(charges)
-                except BudgetExceededError:
+                    # cost attribution is party ε (what crossed into a
+                    # kernel) — the directory's derived user/global
+                    # legs are bookkeeping views of the same spend
+                    cost.charge(party_view(charges))
+                except BudgetExceededError as e:
                     self.stats.refused_budget()
-                    root.set(refused="budget")
-                    cost.event("refused_budget")
+                    root.set(refused="budget", refused_level=e.level)
+                    # the event names WHICH budget level refused
+                    # (user | party | global) — obs top / flight
+                    # recorder attribution without parsing principals
+                    cost.event(f"refused_budget_{e.level}")
                     raise
                 try:
                     with self.tracer.span("serve.enqueue"):
@@ -454,7 +502,7 @@ class DpcorrServer:
                     self.ledger.refund(charges, trace_id=root.trace_id,
                                        reason="overload")
                     cost.event("refused_overload")
-                    cost.refund(charges, "overload")
+                    cost.refund(party_view(charges), "overload")
                     root.set(refused="overload")
                     raise
         except Exception:
@@ -503,7 +551,10 @@ class DpcorrServer:
     def stats_snapshot(self) -> dict:
         snap = self.stats.snapshot(
             ledger_snapshot=self.ledger.snapshot(),
-            cost_aggregate=self.costs.aggregate())
+            cost_aggregate=self.costs.aggregate(),
+            budget_dir=(self.ledger.directory_snapshot()
+                        if isinstance(self.ledger, CompositeLedger)
+                        else None))
         snap["breaker"] = self.breaker.snapshot()
         return snap
 
@@ -540,6 +591,8 @@ class DpcorrServer:
             chaos.remove_crash_hook(self._crash_hook)
             self._crash_hook = None
         self.coalescer.close()
+        if isinstance(self.ledger, CompositeLedger):
+            self.ledger.close()
         if self._warmup_manifest:
             # persist the working set AFTER the drain: every kernel the
             # final flushes compiled is in the manifest the next boot
@@ -598,7 +651,9 @@ def _request_from_json(body: dict) -> EstimateRequest:
             priority=int(body.get("priority", 0)),
             deadline_s=(float(body["deadline_s"])
                         if body.get("deadline_s") is not None
-                        else None))
+                        else None),
+            user=(str(body["user"]) if body.get("user") is not None
+                  else None))
     except KeyError as e:
         raise ValueError(f"missing required field {e.args[0]!r}") from e
 
@@ -686,7 +741,8 @@ def make_http_server(server: DpcorrServer, host: str = "127.0.0.1",
                 # refusal is terminal, retrying it is never right
                 self._send(403, {"error": str(e), "refused": "budget",
                                  "party": e.party, "spent": e.spent,
-                                 "charge": e.charge, "budget": e.budget})
+                                 "charge": e.charge, "budget": e.budget,
+                                 "level": e.level})
             except DeadlineExpiredError as e:
                 self._send(504, {"error": str(e), "refused": "expired"},
                            headers=self._retry_after(e))

@@ -1,0 +1,15 @@
+"""Violating fixture: multi-level admission charging two budget
+receivers with no compensation path, plus a directory-receiver enqueue
+with no dominating charge (the `serve/` path puts this in scope)."""
+
+
+class Composite:
+    def charge(self, user, charges):
+        self.directory.charge(user, sum(charges.values()))
+        self.ledger.charge(charges)  # budget-multi-charge-missing-refund
+
+
+class Admission:
+    def admit(self, req):
+        self.coalescer.submit(req)  # budget-uncharged-noise
+        self.directory.charge(req.user, req.eps)

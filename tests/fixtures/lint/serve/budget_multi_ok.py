@@ -1,0 +1,24 @@
+"""Clean fixture: the CompositeLedger shape — the later receiver's
+charge sits in a try whose handler compensates the first store, and a
+directory charge dominates the enqueue like a ledger charge does."""
+
+
+class Composite:
+    def charge(self, user, charges):
+        total = sum(charges.values())
+        try:
+            self.directory.charge(user, total)
+            self.ledger.charge(charges)
+        except OverflowError:
+            self.directory.refund(user, total)
+            raise
+
+
+class Admission:
+    def admit(self, req):
+        self.directory.charge(req.user, req.eps)
+        try:
+            self.coalescer.submit(req)
+        except OverflowError:
+            self.directory.refund(req.user, req.eps)
+            raise

@@ -1,0 +1,528 @@
+"""Sharded per-user budget directory (ISSUE 10): WAL-journaled shard
+accounting, renewal under a scripted clock, LRU eviction/rehydration,
+the four registered crash windows, corrupt-file quarantine, and the
+CompositeLedger's one-atomic-charge / one-refund-path contract.
+
+Crash windows here use raise-mode chaos plans in the current thread —
+the durable state left behind is byte-identical to a process kill at
+the same point (the fsynced WAL line either landed whole or not at
+all); the genuine kill-and-restart proof over real processes is the
+``dpcorr chaos`` sweep and test_chaos.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dpcorr import chaos
+from dpcorr.chaos import ChaosPlan, SimulatedCrash
+from dpcorr.obs.audit import AuditTrail, read_events, replay
+from dpcorr.obs.budget_replay import (
+    GLOBAL_KEY,
+    USER_PREFIX,
+    DirectoryCorruptError,
+    apply_wal_entry,
+    fold_levels,
+    read_user_balances,
+)
+from dpcorr.serve.budget_dir import (
+    BudgetDirectory,
+    CompositeLedger,
+    RenewalPolicy,
+    is_reserved,
+    party_view,
+    user_view,
+)
+from dpcorr.serve.ledger import BudgetExceededError, PrivacyLedger
+from dpcorr.serve.request import EstimateRequest
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _dir(tmp_path, **kw):
+    kw.setdefault("shards", 1)
+    kw.setdefault("fsync", False)
+    return BudgetDirectory(str(tmp_path / "dir"), **kw)
+
+
+# ------------------------------------------------------ accounting ----
+def test_charge_spent_lifetime_headroom(tmp_path):
+    d = _dir(tmp_path, user_budget=1.0)
+    d.charge("alice", 0.25)
+    d.charge("alice", 0.25)
+    d.charge("bob", 0.5)
+    assert d.spent("alice") == pytest.approx(0.5)
+    assert d.lifetime("alice") == pytest.approx(0.5)
+    assert d.headroom("alice") == pytest.approx(0.5)
+    assert d.spent("bob") == pytest.approx(0.5)
+    assert d.spent("nobody") == 0.0
+    assert d.headroom("nobody") == 1.0
+    c = d.counters()
+    assert c["charges"] == 3
+    assert c["charged_eps"] == pytest.approx(1.0)
+
+
+def test_charge_id_dedup_and_refund_forgets(tmp_path):
+    d = _dir(tmp_path)
+    d.charge("u", 0.25, charge_id="c1")
+    d.charge("u", 0.25, charge_id="c1")  # resumed re-run: no-op
+    assert d.spent("u") == pytest.approx(0.25)
+    assert d.counters()["dedups"] == 1
+    d.refund("u", 0.25, charge_id="c1")  # forgets the id
+    assert d.spent("u") == 0.0
+    d.charge("u", 0.25, charge_id="c1")  # genuinely new charge
+    assert d.spent("u") == pytest.approx(0.25)
+
+
+def test_refund_clamps_at_zero(tmp_path):
+    d = _dir(tmp_path)
+    d.charge("u", 0.25)
+    d.refund("u", 9.0)  # stray refund over-counts, never under-counts
+    assert d.spent("u") == 0.0
+    assert d.lifetime("u") == 0.0
+
+
+def test_negative_amounts_refused(tmp_path):
+    d = _dir(tmp_path)
+    with pytest.raises(ValueError):
+        d.charge("u", -0.1)
+    with pytest.raises(ValueError):
+        d.refund("u", -0.1)
+
+
+def test_refusal_is_charge_free_and_not_journaled(tmp_path):
+    d = _dir(tmp_path, user_budget=0.5)
+    d.charge("u", 0.5)  # landing exactly on the cap is admitted
+    with pytest.raises(BudgetExceededError) as ei:
+        d.charge("u", 0.25)
+    assert ei.value.level == "user"
+    assert ei.value.party == USER_PREFIX + "u"
+    assert d.spent("u") == pytest.approx(0.5)
+    assert d.counters()["refusals"] == 1
+    d.close()
+    # nothing about the refusal reached disk: reopen sees the admitted
+    # spend only
+    d2 = _dir(tmp_path, user_budget=0.5)
+    assert d2.spent("u") == pytest.approx(0.5)
+
+
+# --------------------------------------------------------- renewal ----
+def test_renewal_resets_window_and_carries_burst(tmp_path):
+    now = {"t": 1000.0}
+    d = _dir(tmp_path, user_budget=0.5,
+             renewal=RenewalPolicy(period_s=100.0, burst_cap=0.3),
+             clock=lambda: now["t"])
+    d.charge("u", 0.2)
+    now["t"] = 1100.0  # one period later: window resets, 0.3 unused
+    d.charge("u", 0.0)  # zero-ε touch triggers the renewal
+    assert d.spent("u") == 0.0
+    assert d.headroom("u") == pytest.approx(0.8)  # budget + burst
+    assert d.lifetime("u") == pytest.approx(0.2)  # lifetime untouched
+    d.charge("u", 0.7)  # admitted only thanks to the burst credit
+    now["t"] = 1200.0
+    d.charge("u", 0.0)
+    # carry = min(cap, budget + burst - spend) = min(0.3, 0.1)
+    assert d.headroom("u") == pytest.approx(0.6)
+    assert d.counters()["renewals"] == 2
+
+
+def test_renewal_long_idle_reaches_fixed_point(tmp_path):
+    now = {"t": 0.0}
+    d = _dir(tmp_path, user_budget=0.5,
+             renewal=RenewalPolicy(period_s=100.0, burst_cap=0.3),
+             clock=lambda: now["t"])
+    d.charge("u", 0.4)
+    now["t"] = 100.0 * 50  # 50 idle periods collapse to the fixed point
+    d.charge("u", 0.0)
+    assert d.spent("u") == 0.0
+    assert d.headroom("u") == pytest.approx(0.8)
+    assert d.counters()["renewals"] == 1
+
+
+def test_renewal_survives_reopen(tmp_path):
+    now = {"t": 1000.0}
+    clock = lambda: now["t"]  # noqa: E731
+    d = _dir(tmp_path, user_budget=0.5,
+             renewal=RenewalPolicy(period_s=100.0, burst_cap=0.3),
+             clock=clock)
+    d.charge("u", 0.2)
+    now["t"] = 1100.0
+    d.charge("u", 0.0)
+    d.close()
+    # the "n" journal line carried the absolute renewed state
+    d2 = _dir(tmp_path, user_budget=0.5,
+              renewal=RenewalPolicy(period_s=100.0, burst_cap=0.3),
+              clock=clock)
+    assert d2.spent("u") == 0.0
+    assert d2.headroom("u") == pytest.approx(0.8)
+    assert d2.lifetime("u") == pytest.approx(0.2)
+
+
+def test_renewal_policy_validation():
+    with pytest.raises(ValueError):
+        RenewalPolicy(period_s=0.0)
+    with pytest.raises(ValueError):
+        RenewalPolicy(burst_cap=-1.0)
+
+
+# ------------------------------------------- persistence / routing ----
+def test_reopen_recovers_exact_balances(tmp_path):
+    d = _dir(tmp_path, shards=4)
+    for i in range(40):
+        d.charge(f"u{i}", 0.125, charge_id=f"c{i}")
+    d.refund("u3", 0.125, charge_id="c3")
+    d.close()
+    d2 = _dir(tmp_path, shards=4)
+    assert d2.spent("u3") == 0.0
+    for i in [0, 1, 7, 39]:
+        if i != 3:
+            assert d2.spent(f"u{i}") == pytest.approx(0.125)
+    bal = read_user_balances(str(tmp_path / "dir"))
+    assert len(bal) == 40
+    assert bal["u7"]["l"] == pytest.approx(0.125)
+
+
+def test_shard_count_pinned_in_meta(tmp_path):
+    d = _dir(tmp_path, shards=4)
+    d.charge("alice", 0.1)
+    idx = d.shard_index("alice")
+    d.close()
+    # a reopen asking for a different count adopts the pinned one —
+    # re-hashing users onto a different ring would split balances
+    d2 = _dir(tmp_path, shards=16)
+    assert d2.n_shards == 4
+    assert d2.shard_index("alice") == idx
+    assert d2.spent("alice") == pytest.approx(0.1)
+
+
+def test_compaction_folds_wal_into_snapshot(tmp_path):
+    d = _dir(tmp_path, compact_every=1)
+    d.charge("u", 0.25, charge_id="c1")
+    d.charge("u", 0.25, charge_id="c2")
+    assert d.counters()["compactions"] == 2
+    d.close()
+    snap = json.load(open(tmp_path / "dir" / "shard-0000.json"))
+    assert snap["gen"] == 2
+    assert snap["users"]["u"]["s"] == pytest.approx(0.5)
+    assert "c2" in snap["charge_ids"]
+    wal = (tmp_path / "dir" / "shard-0000.wal").read_text().splitlines()
+    assert json.loads(wal[0])["gen"] == 2
+    assert len(wal) == 1  # fresh after the fold
+    d2 = _dir(tmp_path, compact_every=1)
+    assert d2.spent("u") == pytest.approx(0.5)
+    d2.charge("u", 0.25, charge_id="c2")  # snapshot kept the id
+    assert d2.spent("u") == pytest.approx(0.5)
+
+
+def test_eviction_and_rehydration_preserve_balances(tmp_path):
+    d = _dir(tmp_path, max_resident=2)
+    for i in range(8):
+        d.charge(f"u{i}", 0.125)
+    c = d.counters()
+    assert c["evictions"] >= 6
+    assert c["resident_users"] == 2
+    assert c["evicted_users"] == 6
+    # peek reads the spill without rehydration churn
+    assert d.spent("u0") == pytest.approx(0.125)
+    d.charge("u0", 0.125)  # rehydrates, then evicts someone else
+    assert d.counters()["rehydrations"] == 1
+    assert d.spent("u0") == pytest.approx(0.25)
+    d.close()
+    d2 = _dir(tmp_path, max_resident=2)  # spill is non-authoritative
+    for i in range(8):
+        assert d2.spent(f"u{i}") == pytest.approx(
+            0.25 if i == 0 else 0.125)
+
+
+# --------------------------------------------------- crash windows ----
+def test_matrix_registers_budget_points():
+    for p in ("budget.pre_journal", "budget.post_journal",
+              "budget.mid_compaction", "budget.mid_eviction"):
+        assert p in chaos.MATRIX_POINTS
+
+
+@pytest.mark.parametrize("point,on_disk", [
+    # killed before the WAL append: nothing durable, the re-charge
+    # applies once; killed after: the line is durable, the re-charge
+    # dedups — either way recovery lands on exactly one application
+    ("budget.pre_journal", 0.0),
+    ("budget.post_journal", 0.25),
+    ("budget.mid_compaction", 0.25),
+    ("budget.mid_eviction", 0.25),
+])
+def test_crash_window_recovers_charge_once(tmp_path, point, on_disk):
+    knobs = {"compact_every": 1 if point == "budget.mid_compaction"
+             else None,
+             "max_resident": 0 if point == "budget.mid_eviction"
+             else None}
+    d = _dir(tmp_path, **knobs)
+    chaos.install(ChaosPlan(point=point, hit=1, mode="raise"))
+    with pytest.raises(SimulatedCrash):
+        d.charge("u", 0.25, charge_id="victim")
+    chaos.clear()
+    assert read_user_balances(str(tmp_path / "dir")) \
+        .get("u", {}).get("l", 0.0) == pytest.approx(on_disk)
+    # the restart: reopen and re-issue the interrupted charge under
+    # its charge_id — exactly once regardless of where the kill hit
+    d2 = _dir(tmp_path, **knobs)
+    d2.charge("u", 0.25, charge_id="victim")
+    assert d2.spent("u") == pytest.approx(0.25)
+    assert d2.lifetime("u") == pytest.approx(0.25)
+
+
+def test_crash_mid_compaction_discards_stale_wal(tmp_path):
+    d = _dir(tmp_path, compact_every=2)
+    d.charge("u", 0.25, charge_id="c1")
+    chaos.install(ChaosPlan(point="budget.mid_compaction", hit=1,
+                            mode="raise"))
+    with pytest.raises(SimulatedCrash):
+        d.charge("u", 0.25, charge_id="c2")
+    chaos.clear()
+    # torn window: snapshot says gen 1, WAL still says gen 0 and holds
+    # both charge lines the snapshot already folded in
+    snap = json.load(open(tmp_path / "dir" / "shard-0000.json"))
+    assert snap["gen"] == 1
+    wal = (tmp_path / "dir" / "shard-0000.wal").read_text().splitlines()
+    assert json.loads(wal[0])["gen"] == 0 and len(wal) == 3
+    d2 = _dir(tmp_path, compact_every=2)  # discards, never double-applies
+    assert d2.spent("u") == pytest.approx(0.5)
+    d2.charge("u", 0.25, charge_id="c2")  # snapshot kept the ids too
+    assert d2.spent("u") == pytest.approx(0.5)
+
+
+# ---------------------------------------------- corrupt quarantine ----
+def test_corrupt_snapshot_quarantined_loudly(tmp_path):
+    d = _dir(tmp_path, compact_every=1)
+    d.charge("u", 0.25)
+    d.close()
+    snap = tmp_path / "dir" / "shard-0000.json"
+    snap.write_text("{not json")
+    with pytest.raises(DirectoryCorruptError) as ei:
+        _dir(tmp_path, compact_every=1)
+    msg = str(ei.value)
+    assert "corrupt" in msg and "obs budget" in msg  # actionable
+    assert os.path.exists(str(snap) + ".corrupt")
+    assert not os.path.exists(str(snap))
+
+
+def test_truncated_wal_quarantined_loudly(tmp_path):
+    d = _dir(tmp_path)
+    d.charge("u", 0.25)
+    d.close()
+    wal = tmp_path / "dir" / "shard-0000.wal"
+    with open(wal, "a") as fh:
+        fh.write('{"k": "c", "u": "u", "e"')  # torn mid-line
+    with pytest.raises(DirectoryCorruptError):
+        _dir(tmp_path)
+    assert os.path.exists(str(wal) + ".corrupt")
+    assert not os.path.exists(str(wal))
+
+
+def test_wal_generation_ahead_of_snapshot_is_corrupt(tmp_path):
+    root = tmp_path / "dir"
+    root.mkdir()
+    (root / "meta.json").write_text('{"version": 1, "shards": 1}')
+    (root / "shard-0000.wal").write_text('{"k": "wal", "gen": 5}\n')
+    with pytest.raises(DirectoryCorruptError):
+        _dir(tmp_path)
+
+
+def test_stale_tmp_swept_on_open(tmp_path):
+    d = _dir(tmp_path, compact_every=1)
+    d.charge("u", 0.25)
+    d.close()
+    stale = tmp_path / "dir" / "shard-0000.json.tmp.12345"
+    stale.write_text("half a snapshot that never committed")
+    d2 = _dir(tmp_path, compact_every=1)
+    assert not stale.exists()
+    assert d2.spent("u") == pytest.approx(0.25)
+
+
+def test_corrupt_meta_quarantined(tmp_path):
+    root = tmp_path / "dir"
+    root.mkdir()
+    (root / "meta.json").write_text("{garbage")
+    with pytest.raises(DirectoryCorruptError):
+        _dir(tmp_path)
+    assert (root / "meta.json.corrupt").exists()
+
+
+# ------------------------------------------------- replay helpers ----
+def test_apply_wal_entry_semantics(tmp_path):
+    users, ids = {}, {}
+    apply_wal_entry({"k": "c", "u": "u", "e": 0.5, "id": "a"},
+                    users, ids, "wal")
+    apply_wal_entry({"k": "c", "u": "u", "e": 0.5, "id": "a"},
+                    users, ids, "wal")  # dedup
+    assert users["u"]["s"] == pytest.approx(0.5)
+    apply_wal_entry({"k": "r", "u": "u", "e": 9.0, "id": "a"},
+                    users, ids, "wal")  # clamps, forgets the id
+    assert users["u"]["s"] == 0.0 and "a" not in ids
+    apply_wal_entry({"k": "n", "u": "u", "w": 7.0, "b": 0.3},
+                    users, ids, "wal")
+    assert users["u"] == {"s": 0.0, "l": 0.0, "b": 0.3, "w": 7.0}
+    bad_wal = tmp_path / "w.wal"
+    bad_wal.write_text('{"k": "??", "u": "u"}\n')
+    with pytest.raises(DirectoryCorruptError):
+        apply_wal_entry({"k": "??", "u": "u"}, users, ids,
+                        str(bad_wal))
+    assert not bad_wal.exists()  # quarantined whole
+    assert (tmp_path / "w.wal.corrupt").exists()
+
+
+def test_views_and_fold_levels():
+    aug = {"pa": 0.5, "pb": 0.25, USER_PREFIX + "alice": 0.75,
+           GLOBAL_KEY: 0.75}
+    assert party_view(aug) == {"pa": 0.5, "pb": 0.25}
+    assert user_view(aug) == {"alice": 0.75}
+    assert is_reserved(GLOBAL_KEY) and is_reserved(USER_PREFIX + "x")
+    assert not is_reserved("party-x")
+    lv = fold_levels(aug)
+    assert lv["party"] == {"pa": 0.5, "pb": 0.25}
+    assert lv["user"] == {"alice": 0.75}
+    assert lv["global"] == {GLOBAL_KEY: 0.75}
+
+
+# ------------------------------------------------ composite ledger ----
+def _composite(tmp_path, budget=100.0, user_budget=1.0,
+               global_budget=None, audit=None):
+    led = PrivacyLedger(budget, audit=audit)
+    d = BudgetDirectory(str(tmp_path / "dir"), shards=2,
+                        user_budget=user_budget, fsync=False,
+                        audit=audit)
+    return CompositeLedger(led, d, user="alice",
+                           global_budget=global_budget)
+
+
+def test_augment_adds_legs_and_is_idempotent(tmp_path):
+    comp = _composite(tmp_path, global_budget=10.0)
+    aug = comp.augment({"pa": 0.5, "pb": 0.25})
+    assert aug[USER_PREFIX + "alice"] == pytest.approx(0.75)
+    assert aug[GLOBAL_KEY] == pytest.approx(0.75)
+    assert comp.augment(aug) == aug  # round-trips unchanged
+    assert comp.augment({"pa": 0.5}, user="bob") == {
+        "pa": 0.5, USER_PREFIX + "bob": 0.5, GLOBAL_KEY: 0.5}
+
+
+def test_composite_charge_lands_every_leg(tmp_path):
+    comp = _composite(tmp_path, global_budget=10.0)
+    comp.charge({"pa": 0.5, "pb": 0.25}, charge_id="c1")
+    assert comp.ledger.spent("pa") == pytest.approx(0.5)
+    assert comp.directory.spent("alice") == pytest.approx(0.75)
+    assert comp.spent(USER_PREFIX + "alice") == pytest.approx(0.75)
+    assert comp.ledger.spent(GLOBAL_KEY) == pytest.approx(0.75)
+    comp.charge({"pa": 0.5, "pb": 0.25}, charge_id="c1")  # dedups whole
+    assert comp.directory.spent("alice") == pytest.approx(0.75)
+
+
+@pytest.mark.parametrize("level,kw,charges", [
+    # party cap refuses: the user leg already applied is compensated
+    ("party", dict(budget=0.5, user_budget=100.0), {"pa": 0.75}),
+    # global cap refuses: each party leg fits, their sum does not
+    ("global", dict(global_budget=0.5, user_budget=100.0),
+     {"pa": 0.4, "pb": 0.4}),
+    # user cap refuses before anything reaches the party ledger
+    ("user", dict(user_budget=0.5), {"pa": 0.75}),
+])
+def test_refusal_consumes_zero_everywhere(tmp_path, level, kw, charges):
+    comp = _composite(tmp_path, **kw)
+    with pytest.raises(BudgetExceededError) as ei:
+        comp.charge(charges, charge_id="c1")
+    assert ei.value.level == level
+    assert comp.directory.spent("alice") == 0.0
+    for p in charges:
+        assert comp.ledger.spent(p) == 0.0
+    assert comp.refusals_by_level()[level] == 1
+    comp.charge({"pa": 0.1}, charge_id="c1")  # compensation freed the id
+    assert comp.directory.spent("alice") == pytest.approx(0.1)
+
+
+def test_refund_reverses_every_leg_from_bare_dict(tmp_path):
+    comp = _composite(tmp_path, global_budget=10.0)
+    comp.charge({"pa": 0.5, "pb": 0.25}, charge_id="c1")
+    # the gate's transport-failure path holds only the per-party dict;
+    # the one refund path re-derives the directory and global legs
+    comp.refund({"pa": 0.5, "pb": 0.25}, charge_id="c1", reason="shed")
+    assert comp.ledger.spent("pa") == 0.0
+    assert comp.ledger.spent(GLOBAL_KEY) == 0.0
+    assert comp.directory.spent("alice") == 0.0
+
+
+def test_charge_request_returns_augmented_dict(tmp_path):
+    comp = _composite(tmp_path)
+    r = np.random.default_rng(0)
+    req = EstimateRequest(family="ni_sign", x=r.normal(size=32),
+                          y=r.normal(size=32), eps1=0.25, eps2=0.125,
+                          party_x="pa", party_y="pb", normalise=False,
+                          user="bob")
+    aug = comp.charge_request(req)
+    total = aug["pa"] + aug["pb"]
+    assert aug[USER_PREFIX + "bob"] == pytest.approx(total)
+    assert comp.directory.spent("bob") == pytest.approx(total)
+    comp.refund(aug, reason="deadline")  # the coalescer's shed path
+    assert comp.directory.spent("bob") == 0.0
+    assert comp.ledger.spent("pa") == 0.0
+
+
+def test_directory_snapshot_shape(tmp_path):
+    comp = _composite(tmp_path, user_budget=0.5)
+    comp.charge({"pa": 0.25})
+    with pytest.raises(BudgetExceededError):
+        comp.charge({"pa": 0.5})
+    snap = comp.directory_snapshot()
+    assert snap["shards"] == 2
+    assert snap["resident_users"] == 1
+    assert snap["refusals_by_level"] == {"user": 1, "party": 0,
+                                         "global": 0}
+    assert snap["counters"]["charged_eps"] == pytest.approx(0.25)
+
+
+# ------------------------------------------------ audit / obs CLI ----
+def test_audit_replay_matches_disk_balances(tmp_path):
+    audit = AuditTrail(str(tmp_path / "audit.jsonl"))
+    comp = _composite(tmp_path, audit=audit)
+    comp.charge({"pa": 0.5}, charge_id="c1")
+    comp.charge({"pa": 0.25}, charge_id="c2")
+    comp.refund({"pa": 0.25}, charge_id="c2", reason="shed")
+    comp.close()
+    spent = replay(read_events(str(tmp_path / "audit.jsonl")))
+    lv = fold_levels(spent)
+    assert lv["user"]["alice"] == pytest.approx(0.5)
+    assert lv["party"]["pa"] == pytest.approx(0.5)
+    bal = read_user_balances(str(tmp_path / "dir"))
+    assert bal["alice"]["l"] == pytest.approx(lv["user"]["alice"])
+
+
+def test_obs_budget_cli_checks_directory(tmp_path):
+    audit_path = str(tmp_path / "audit.jsonl")
+    audit = AuditTrail(audit_path)
+    comp = _composite(tmp_path, audit=audit)
+    comp.charge({"pa": 0.5}, charge_id="c1")
+    comp.close()
+    cmd = [sys.executable, "-m", "dpcorr", "obs", "budget",
+           "--audit", audit_path,
+           "--budget-dir", str(tmp_path / "dir"), "--json"]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["budget_dir"]["ok"]
+    assert out["budget_dir"]["users"] == 1
+    # a trail line with no matching disk spend is a MISMATCH, rc 1
+    audit.record("charge", {USER_PREFIX + "ghost": 1.0})
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout)
+    assert not out["budget_dir"]["ok"]
+    assert any(m["user"] == "ghost"
+               for m in out["budget_dir"]["mismatches"])

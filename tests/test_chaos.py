@@ -39,6 +39,8 @@ from dpcorr.protocol import (
 from dpcorr.protocol.messages import Transcript
 from dpcorr.protocol.party import Party
 from dpcorr.protocol.transport import tcp_accept, tcp_connect, tcp_listen
+from dpcorr.obs.budget_replay import read_user_balances
+from dpcorr.serve.budget_dir import BudgetDirectory, CompositeLedger
 from dpcorr.serve.ledger import LedgerCorruptError, PrivacyLedger
 
 FAMILIES = ("ni_sign", "int_sign", "ni_subg", "int_subg")
@@ -377,8 +379,19 @@ def _crash_resume(family, victim, point, tmp_path, n=512):
         chan = ReliableChannel(links[role], timeout_s=0.1,
                                max_retries=400, backoff_base_s=0.02,
                                backoff_max_s=0.1)
-        ledger = PrivacyLedger(100.0, path=paths[role]["ledger"],
-                               audit=AuditTrail(paths[role]["audit"]))
+        audit = AuditTrail(paths[role]["audit"])
+        inner = PrivacyLedger(100.0, path=paths[role]["ledger"],
+                              audit=audit)
+        # per-user admission rides every gate charge, with the most
+        # hostile directory knobs — evict after every touch, compact
+        # after every mutation — so each release crosses ALL budget
+        # crash windows (the budget.* MATRIX points fire here)
+        directory = BudgetDirectory(
+            str(tmp_path / f"budget-{role}"), shards=2,
+            user_budget=100.0, max_resident=0, compact_every=1,
+            audit=audit)
+        ledger = CompositeLedger(inner, directory,
+                                 user=f"user-{role}")
         return Party(role, cols[role], spec, chan, ledger,
                      transcript=Transcript(paths[role]["transcript"]),
                      recv_timeout_s=120.0,
@@ -434,6 +447,14 @@ def _crash_resume(family, victim, point, tmp_path, n=512):
         for party_name, eps in spec.charges_for(role).items():
             assert spent[party_name] == pytest.approx(eps), \
                 f"role {role} eps not spent exactly once"
+        # the user directory recovered to the exact per-user balance:
+        # one user leg per gate charge, never double-applied across
+        # the crash (jax-free recovery arithmetic, same as the driver)
+        want_user = sum(spec.charges_for(role).values())
+        bal = read_user_balances(str(tmp_path / f"budget-{role}"))
+        got_user = bal.get(f"user-{role}", {}).get("l", 0.0)
+        assert got_user == pytest.approx(want_user), \
+            f"role {role} user-leg balance {got_user} != {want_user}"
 
 
 @pytest.mark.parametrize("victim", ["x", "y"])

@@ -73,6 +73,23 @@ def test_budget_shed_ok_fixture_is_clean():
     assert lint_fixture("serve/budget_shed_ok.py") == []
 
 
+def test_budget_multi_bad_fixture_fires_directory_rules():
+    """The per-user directory is a budget receiver: charging it plus
+    the ledger without a compensating handler is a partial-spend
+    hazard, and a directory charge is expected to dominate enqueues."""
+    vs = lint_fixture("serve/budget_multi_bad.py")
+    assert fired(vs) == [
+        ("budget-multi-charge-missing-refund", 9),
+        ("budget-uncharged-noise", 14),
+    ]
+
+
+def test_budget_multi_ok_fixture_is_clean():
+    """The CompositeLedger shape lints clean: later-receiver charge in
+    a try whose handler refunds the first store."""
+    assert lint_fixture("serve/budget_multi_ok.py") == []
+
+
 def test_locks_bad_fixture_fires_reads_and_writes():
     vs = lint_fixture("serve/locks_bad.py")
     assert fired(vs) == [

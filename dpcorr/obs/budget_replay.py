@@ -92,17 +92,26 @@ def apply_wal_entry(entry: dict, users: dict,
     """Apply one WAL entry to a user table — the single definition of
     what a journal line *means*, shared by live recovery and the
     jax-free reader. Charges dedup on ``charge_id`` exactly like the
-    live path; refunds clamp at zero and forget the id; renewals carry
-    absolute resulting state, so replay is idempotent."""
+    live path (before creating the user, also like the live path);
+    refunds clamp at zero and forget the id; renewals carry absolute
+    resulting state, so replay is idempotent. ``c``/``r`` entries
+    carry the user's window start ``w`` and burst ``b``, consulted
+    only when the entry has to *create* the user (state still
+    WAL-only, no snapshot line yet): recreating with ``w=0.0`` would
+    make the first post-restart charge see billions of elapsed
+    periods and fire a spurious renewal that zeroes the window spend,
+    letting the user overspend their window budget."""
     kind = entry["k"]
     user = str(entry["u"])
-    st = users.get(user)
-    if st is None:
-        st = users[user] = fresh_user(float(entry.get("w", 0.0)))
     if kind == "c":
         cid = entry.get("id")
         if cid is not None and cid in charge_ids:
             return
+    st = users.get(user)
+    if st is None:
+        st = users[user] = fresh_user(float(entry.get("w", 0.0)))
+        st["b"] = float(entry.get("b", 0.0))
+    if kind == "c":
         eps = float(entry["e"])
         st["s"] += eps
         st["l"] += eps

@@ -14,21 +14,31 @@ violation, not just a bug. Three pieces:
   line per mutation, folded periodically into a tmp+fsync+rename
   snapshot (compaction), with the snapshot/WAL pair versioned by a
   generation number so a crash *between* the snapshot rename and the
-  WAL reset can never replay already-folded entries. Cold users are
-  LRU-evicted to a per-shard spill file that is only a within-process
-  memory-relief cache — restart recovery is always snapshot + WAL, so
-  a crash mid-eviction loses nothing. Charges carry idempotent
-  ``charge_id``s exactly like protocol/journal.py: a resumed session's
-  re-charge is a durable no-op.
+  WAL reset can never replay already-folded entries. Charge/refund
+  lines carry the user's window start and burst so a recovery that
+  must re-create a user from the WAL alone (not yet compacted into a
+  snapshot) restores the true window — never ``w=0.0``, which would
+  fire a spurious renewal on the first post-restart charge. Cold
+  users are LRU-evicted to a per-shard spill file that is only a
+  within-process memory-relief cache — restart recovery is always
+  snapshot + WAL, so a crash mid-eviction loses nothing; the spill is
+  rewritten compactly at compaction and whenever dead (rehydrated)
+  lines outnumber live ones, and an unparseable spill fails the whole
+  shard loudly (every later call re-raises the quarantine error)
+  rather than silently forgetting evicted users' spend. Charges carry
+  idempotent ``charge_id``s exactly like protocol/journal.py: a
+  resumed session's re-charge is a durable no-op.
 - **Renewal/decay** — :class:`RenewalPolicy`: each user's window spend
   resets every ``period_s`` (daily ε refresh), carrying unused
   headroom forward as burst credit up to ``burst_cap``. The clock is
   injectable, so policies are testable under a scripted clock.
   Renewals are journaled as absolute resulting state (idempotent to
-  replay) and draw **no** audit event: the audit trail tracks the
-  monotone *lifetime* spend, which renewal does not touch — that is
-  what keeps the jax-free ``obs budget`` replay an exact equality over
-  the sharded trails.
+  replay), riding the **same fsynced append** as the charge they
+  admit — a refused charge journals nothing, renewal included — and
+  draw **no** audit event: the audit trail tracks the monotone
+  *lifetime* spend, which renewal does not touch — that is what keeps
+  the jax-free ``obs budget`` replay an exact equality over the
+  sharded trails.
 - :class:`CompositeLedger` — composes per-user + per-party + global
   budgets into **one atomic charge with one refund path**. User legs
   live under the reserved ``user/`` principal namespace, the global
@@ -192,6 +202,8 @@ class _Shard:
         self._gen = 0  # guarded by: _lock
         self._dirty = 0  # guarded by: _lock
         self._cold_end = 0  # guarded by: _lock
+        self._cold_dead = 0  # dead (superseded) spill lines, guarded by: _lock
+        self._failed: DirectoryCorruptError | None = None  # guarded by: _lock
         self.counters = {  # guarded by: _lock
             "charges": 0, "refunds": 0, "dedups": 0, "refusals": 0,
             "renewals": 0, "evictions": 0, "rehydrations": 0,
@@ -237,6 +249,10 @@ class _Shard:
 
     # -- residency ---------------------------------------------------
 
+    def _check_failed_locked(self) -> None:
+        if self._failed is not None:
+            raise self._failed
+
     def _touch_locked(self, user: str) -> dict:
         st = self._users.get(user)
         if st is not None:
@@ -246,6 +262,9 @@ class _Shard:
         if off is not None:
             st = self._read_cold_locked(user, off)
             self.counters["rehydrations"] += 1
+            # the user's spill line is now dead; reclaimed once dead
+            # lines outnumber live ones (_evict_down_locked)
+            self._cold_dead += 1
         else:
             st = _fresh_user(float(self.clock()))
         self._users[user] = st
@@ -263,8 +282,14 @@ class _Shard:
                     "b": float(st["b"]), "w": float(st["w"])}
         except (json.JSONDecodeError, OSError, KeyError, TypeError,
                 ValueError) as e:
+            # fail the whole shard, not just this read: evicted users'
+            # in-memory state lived only in the spill, so continuing
+            # would silently forget their spend. Every later mutation
+            # or read re-raises the same loud quarantine error; a
+            # restart recovers from the authoritative snapshot + WAL.
             self._cold.close()
-            raise _corrupt(self.cold_path, str(e)) from e
+            self._failed = _corrupt(self.cold_path, str(e))
+            raise self._failed from e
 
     def _peek_locked(self, user: str) -> dict | None:
         """Read-only view: no LRU touch, no rehydration churn."""
@@ -296,23 +321,51 @@ class _Shard:
             del self._users[user]
             self._cold_index[user] = off
             self.counters["evictions"] += 1
+        # rehydration leaves the old spill line behind and _cold_end
+        # only advances, so under residency churn dead lines would
+        # otherwise grow the file forever; rewriting once they
+        # outnumber live ones bounds it at ~2x the live set
+        if self._cold_dead > max(16, len(self._cold_index)):
+            self._write_cold_locked(
+                {u: self._read_cold_locked(u, off)
+                 for u, off in self._cold_index.items()})
+
+    def _write_cold_locked(self, states: dict[str, dict]) -> None:
+        """Rewrite the spill to hold exactly ``states``, compactly."""
+        self._cold.seek(0)
+        self._cold.truncate()
+        self._cold_end = 0
+        self._cold_dead = 0
+        self._cold_index = {}
+        for user, st in states.items():
+            line = json.dumps({"u": user, "st": st}) + "\n"
+            self._cold.write(line)
+            self._cold_index[user] = self._cold_end
+            self._cold_end += len(line)
+        self._cold.flush()
 
     # -- renewal -----------------------------------------------------
 
-    def _renew_locked(self, user: str, st: dict) -> list[dict]:
+    def _pending_renewal_locked(self, st: dict
+                                ) -> tuple[float, float] | None:
+        """The post-renewal ``(window_start, burst)`` for ``st`` when a
+        window refresh is due, else None — computed WITHOUT mutating
+        anything: admission is checked against this view first, and
+        the renewal is journaled together with the charge it admits in
+        one fsynced append, so a refused request leaves no durable
+        trace at all (not even the renewal)."""
         now = float(self.clock())
         if now < st["w"] + self.renewal.period_s:
-            return []
+            return None
         periods = int((now - st["w"]) // self.renewal.period_s)
+        s, b = st["s"], st["b"]
         # after two spend-free iterations the carry is at a fixed
         # point, so a long-idle user needs at most a few steps
         for _ in range(min(periods, 4)):
-            st["b"] = min(self.renewal.burst_cap,
-                          max(0.0, self.user_budget + st["b"] - st["s"]))
-            st["s"] = 0.0
-        st["w"] += self.renewal.period_s * periods
-        self.counters["renewals"] += 1
-        return [{"k": "n", "u": user, "w": st["w"], "b": st["b"]}]
+            b = min(self.renewal.burst_cap,
+                    max(0.0, self.user_budget + b - s))
+            s = 0.0
+        return st["w"] + self.renewal.period_s * periods, b
 
     # -- mutations ---------------------------------------------------
 
@@ -322,36 +375,55 @@ class _Shard:
         charge applied, False when ``charge_id`` dedup'd it; raises
         :class:`~dpcorr.serve.ledger.BudgetExceededError` (level
         ``user``) when the window budget + burst would be overdrawn —
-        without journaling or applying anything."""
+        without journaling or applying anything (a due renewal is
+        checked against, but journaled and applied only together with
+        an admitted charge, so refusals are trace-free exactly)."""
         if eps < 0.0:
             raise ValueError(f"negative charge {eps} for user {user!r}")
         with self._lock:
+            self._check_failed_locked()
             if charge_id is not None and charge_id in self._charge_ids:
                 self.counters["dedups"] += 1
                 return False
             st = self._touch_locked(user)
-            renew_lines = self._renew_locked(user, st)
-            if renew_lines:
-                self._wal_append_locked(renew_lines)
-                self._dirty += len(renew_lines)
-            cap = self.user_budget + st["b"]
+            renewed = self._pending_renewal_locked(st)
+            win_s = 0.0 if renewed is not None else st["s"]
+            win_b = renewed[1] if renewed is not None else st["b"]
+            cap = self.user_budget + win_b
             # strict > with tolerance, matching the party ledger: a
             # charge landing exactly on the cap is admitted
-            if st["s"] + eps > cap + 1e-12:
+            if win_s + eps > cap + 1e-12:
                 self.counters["refusals"] += 1
-                raise BudgetExceededError(USER_PREFIX + user, st["s"],
+                raise BudgetExceededError(USER_PREFIX + user, win_s,
                                           eps, cap)
+            lines = []
+            if renewed is not None:
+                lines.append({"k": "n", "u": user, "w": renewed[0],
+                              "b": renewed[1]})
+            # the entry carries the (post-renewal) window state: a
+            # recovery that has to re-CREATE this user from the WAL
+            # (no snapshot line yet) must restore the true window
+            # start — rebuilding with w=0.0 would fire a spurious
+            # renewal on the first post-restart charge and let the
+            # window budget be overspent
+            lines.append({"k": "c", "u": user, "e": eps,
+                          "id": charge_id,
+                          "w": renewed[0] if renewed is not None
+                          else st["w"], "b": win_b})
             chaos.point("budget.pre_journal")
-            self._wal_append_locked(
-                [{"k": "c", "u": user, "e": eps, "id": charge_id}])
+            self._wal_append_locked(lines)
             chaos.point("budget.post_journal")
+            if renewed is not None:
+                st["w"], st["b"] = renewed
+                st["s"] = 0.0
+                self.counters["renewals"] += 1
             st["s"] += eps
             st["l"] += eps
             if charge_id is not None:
                 self._remember_locked(charge_id)
             self.counters["charges"] += 1
             self.counters["charged_eps"] += eps
-            self._dirty += 1
+            self._dirty += len(lines)
             self._evict_down_locked()
             self._maybe_compact_locked()
             return True
@@ -365,9 +437,13 @@ class _Shard:
         if eps < 0.0:
             raise ValueError(f"negative refund {eps} for user {user!r}")
         with self._lock:
+            self._check_failed_locked()
             st = self._touch_locked(user)
+            # w/b carried for the same WAL-only re-creation case as
+            # charge entries
             self._wal_append_locked(
-                [{"k": "r", "u": user, "e": eps, "id": charge_id}])
+                [{"k": "r", "u": user, "e": eps, "id": charge_id,
+                  "w": st["w"], "b": st["b"]}])
             st["s"] = max(0.0, st["s"] - eps)
             st["l"] = max(0.0, st["l"] - eps)
             if charge_id is not None:
@@ -387,8 +463,9 @@ class _Shard:
 
     def _compact_locked(self) -> None:
         users = dict(self._users)
-        for user, off in self._cold_index.items():
-            users[user] = self._read_cold_locked(user, off)
+        cold_states = {user: self._read_cold_locked(user, off)
+                       for user, off in self._cold_index.items()}
+        users.update(cold_states)
         gen = self._gen + 1
         state = {"version": _DIR_VERSION, "gen": gen, "users": users,
                  "charge_ids": list(self._charge_ids)}
@@ -402,21 +479,27 @@ class _Shard:
         self._write_fresh_wal_locked()
         self._dirty = 0
         self.counters["compactions"] += 1
+        # every spilled state was just read anyway — rewrite the spill
+        # compactly so dead bytes from rehydration churn are reclaimed
+        self._write_cold_locked(cold_states)
 
     # -- views -------------------------------------------------------
 
     def spent(self, user: str) -> float:
         with self._lock:
+            self._check_failed_locked()
             st = self._peek_locked(user)
             return st["s"] if st is not None else 0.0
 
     def lifetime(self, user: str) -> float:
         with self._lock:
+            self._check_failed_locked()
             st = self._peek_locked(user)
             return st["l"] if st is not None else 0.0
 
     def headroom(self, user: str) -> float:
         with self._lock:
+            self._check_failed_locked()
             st = self._peek_locked(user)
             if st is None:
                 return self.user_budget
@@ -430,7 +513,8 @@ class _Shard:
 
     def close(self) -> None:
         with self._lock:
-            self._cold.close()
+            if not self._cold.closed:  # quarantine already closed it
+                self._cold.close()
 
 
 class BudgetDirectory:
@@ -642,12 +726,17 @@ class CompositeLedger:
         """All-or-nothing across every level. User legs charge the
         directory first (idempotent per-leg charge_ids derived from
         ``charge_id``); the party+global legs then charge the wrapped
-        ledger atomically. Any refusal compensates the already-applied
-        directory legs and re-raises — zero ε consumed by a refused
-        request, at every level. A crash between the two stores is
-        recovered by the idempotent re-charge (the applied leg dedups)
-        and can only err toward over-counting, the privacy-safe
-        direction."""
+        ledger atomically. ANY in-process failure of a later leg — a
+        budget refusal, but equally an OSError or corruption error
+        persisting the party snapshot — compensates the already-applied
+        directory legs and re-raises, so no exception path leaves a
+        user leg charged for a query that never executed (server
+        requests carry no ``charge_id``, so nothing else would ever
+        reverse it). Only a hard process death between the two stores
+        escapes compensation (``SimulatedCrash`` is a BaseException
+        for exactly this reason): that is recovered by the idempotent
+        re-charge when a ``charge_id`` is present, and otherwise errs
+        toward over-counting, the privacy-safe direction."""
         aug = self.augment(charges)
         user_legs = [(k, v) for k, v in aug.items()
                      if k.startswith(USER_PREFIX)]
@@ -664,22 +753,30 @@ class CompositeLedger:
                     done.append((key, eps))
             self.ledger.charge(rest, trace_id=trace_id,
                                charge_id=charge_id)
-        except BudgetExceededError as e:
-            with self._lock:
-                self._refusals[e.level] = self._refusals.get(e.level,
-                                                             0) + 1
+        except Exception as e:
+            if isinstance(e, BudgetExceededError):
+                with self._lock:
+                    self._refusals[e.level] = \
+                        self._refusals.get(e.level, 0) + 1
+                reason = f"refused_{e.level}"
+            else:
+                reason = "charge_failed"
             for key, eps in done:
                 self.directory.refund(key[len(USER_PREFIX):], eps,
                                       trace_id=trace_id,
                                       charge_id=_leg_id(charge_id, key),
-                                      reason=f"refused_{e.level}")
+                                      reason=reason)
             raise
 
     def charge_request(self, req, trace_id: str | None = None,
                        ) -> dict[str, float]:
         """Charge one request's spend across every level; returns the
         AUGMENTED charge dict — the server carries it through the
-        coalescer so a shed refund reverses every leg."""
+        coalescer so a shed refund reverses every leg. Server requests
+        carry no ``charge_id`` (the serve idempotency cache dedups
+        retries before any charge), so an in-process failure of the
+        party leg relies on :meth:`charge`'s compensation, and a hard
+        kill between the stores can only over-count — privacy-safe."""
         from dpcorr.serve.ledger import request_charges
 
         charges = self.augment(request_charges(req),

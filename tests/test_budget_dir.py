@@ -299,6 +299,83 @@ def test_crash_mid_compaction_discards_stale_wal(tmp_path):
     assert d2.spent("u") == pytest.approx(0.5)
 
 
+def test_wal_only_user_keeps_window_start_across_reopen(tmp_path):
+    # the 'c' line carries the window start: a user whose state lives
+    # only in the WAL (never compacted, no 'n' line) must not be
+    # rebuilt with w=0.0 — the first post-restart charge would see
+    # ~10k elapsed periods, fire a spurious renewal that zeroes the
+    # window spend, and the user could overspend the window budget
+    now = {"t": 1_000_000.0}
+    kw = dict(user_budget=0.5, compact_every=None,
+              renewal=RenewalPolicy(period_s=100.0),
+              clock=lambda: now["t"])
+    d = _dir(tmp_path, **kw)
+    d.charge("u", 0.4)
+    d.close()
+    bal = read_user_balances(str(tmp_path / "dir"))
+    assert bal["u"]["w"] == pytest.approx(1_000_000.0)
+    now["t"] = 1_000_050.0  # still inside the same window
+    d2 = _dir(tmp_path, **kw)
+    assert d2.spent("u") == pytest.approx(0.4)
+    with pytest.raises(BudgetExceededError):  # 0.4 + 0.2 > 0.5
+        d2.charge("u", 0.2)
+    assert d2.spent("u") == pytest.approx(0.4)
+    assert d2.counters()["renewals"] == 0
+
+
+def test_refund_created_user_carries_window_start(tmp_path):
+    now = {"t": 5000.0}
+    d = _dir(tmp_path, clock=lambda: now["t"])
+    d.refund("u", 1.0)  # clamps to zero, creates the user
+    d.close()
+    bal = read_user_balances(str(tmp_path / "dir"))
+    assert bal["u"]["w"] == pytest.approx(5000.0)
+
+
+def test_refused_renewal_is_trace_free(tmp_path):
+    now = {"t": 1000.0}
+    d = _dir(tmp_path, user_budget=0.5,
+             renewal=RenewalPolicy(period_s=100.0),
+             clock=lambda: now["t"])
+    d.charge("u", 0.4)
+    wal = tmp_path / "dir" / "shard-0000.wal"
+    before = wal.read_text()
+    now["t"] = 1100.0  # a renewal is due, but the charge must refuse
+    with pytest.raises(BudgetExceededError) as ei:
+        d.charge("u", 0.6)  # over the renewed cap of 0.5
+    assert ei.value.spent == 0.0  # checked against the renewed view
+    assert wal.read_text() == before  # nothing journaled, not even 'n'
+    assert d.counters()["renewals"] == 0
+    d.charge("u", 0.3)  # admitted: renewal rides the same append
+    assert d.spent("u") == pytest.approx(0.3)
+    assert d.counters()["renewals"] == 1
+
+
+def test_cold_spill_dead_lines_reclaimed(tmp_path):
+    d = _dir(tmp_path, max_resident=0, compact_every=None)
+    for _ in range(200):  # every charge rehydrates + re-evicts "u"
+        d.charge("u", 0.001)
+    cold = tmp_path / "dir" / "shard-0000.cold"
+    lines = cold.read_text().splitlines()
+    assert len(lines) <= 40  # bounded, not one dead line per charge
+    assert d.spent("u") == pytest.approx(0.2)
+    assert d.counters()["rehydrations"] == 199
+
+
+def test_compaction_truncates_spill(tmp_path):
+    d = _dir(tmp_path, max_resident=0, compact_every=5)
+    for i in range(5):
+        d.charge(f"u{i}", 0.1)  # the 5th mutation compacts
+    cold = tmp_path / "dir" / "shard-0000.cold"
+    lines = [json.loads(ln) for ln in cold.read_text().splitlines()]
+    assert len(lines) == 5  # exactly the live evicted set, no dead bytes
+    assert {e["u"] for e in lines} == {f"u{i}" for i in range(5)}
+    d.close()
+    d2 = _dir(tmp_path, max_resident=0, compact_every=5)
+    for i in range(5):
+        assert d2.spent(f"u{i}") == pytest.approx(0.1)
+
+
 # ---------------------------------------------- corrupt quarantine ----
 def test_corrupt_snapshot_quarantined_loudly(tmp_path):
     d = _dir(tmp_path, compact_every=1)
@@ -347,6 +424,28 @@ def test_stale_tmp_swept_on_open(tmp_path):
     assert d2.spent("u") == pytest.approx(0.25)
 
 
+def test_corrupt_spill_fails_shard_loudly_then_reopen_recovers(tmp_path):
+    d = _dir(tmp_path, max_resident=0)
+    d.charge("u", 0.25)
+    cold = tmp_path / "dir" / "shard-0000.cold"
+    cold.write_text("{torn garbage\n")
+    with pytest.raises(DirectoryCorruptError):
+        d.spent("u")  # the peek reads the spill
+    assert os.path.exists(str(cold) + ".corrupt")
+    # the shard is failed, not limping on a closed file handle: every
+    # later operation re-raises the same loud quarantine error, never
+    # a raw "I/O operation on closed file" ValueError
+    with pytest.raises(DirectoryCorruptError):
+        d.charge("v", 0.1)
+    with pytest.raises(DirectoryCorruptError):
+        d.headroom("u")
+    d.close()  # must not raise on the already-closed spill handle
+    # evicted users' authoritative state is snapshot + WAL, so a
+    # restart recovers exact balances from a fresh (reset) spill
+    d2 = _dir(tmp_path, max_resident=0)
+    assert d2.spent("u") == pytest.approx(0.25)
+
+
 def test_corrupt_meta_quarantined(tmp_path):
     root = tmp_path / "dir"
     root.mkdir()
@@ -370,6 +469,16 @@ def test_apply_wal_entry_semantics(tmp_path):
     apply_wal_entry({"k": "n", "u": "u", "w": 7.0, "b": 0.3},
                     users, ids, "wal")
     assert users["u"] == {"s": 0.0, "l": 0.0, "b": 0.3, "w": 7.0}
+    # creation-state-carrying entries: a WAL-only user is re-created
+    # with the journaled window start and burst, not w=0, b=0
+    apply_wal_entry({"k": "c", "u": "v", "e": 0.1, "id": "b",
+                     "w": 50.0, "b": 0.2}, users, ids, "wal")
+    assert users["v"]["w"] == 50.0
+    assert users["v"]["b"] == pytest.approx(0.2)
+    # a dedup'd charge does not create the user (live-path parity)
+    apply_wal_entry({"k": "c", "u": "ghost", "e": 0.1, "id": "b"},
+                    users, ids, "wal")
+    assert "ghost" not in users
     bad_wal = tmp_path / "w.wal"
     bad_wal.write_text('{"k": "??", "u": "u"}\n')
     with pytest.raises(DirectoryCorruptError):
@@ -444,6 +553,40 @@ def test_refusal_consumes_zero_everywhere(tmp_path, level, kw, charges):
     assert comp.refusals_by_level()[level] == 1
     comp.charge({"pa": 0.1}, charge_id="c1")  # compensation freed the id
     assert comp.directory.spent("alice") == pytest.approx(0.1)
+
+
+def test_composite_compensates_on_non_budget_ledger_failure(tmp_path):
+    comp = _composite(tmp_path)
+
+    def boom(*a, **kw):
+        raise OSError("disk full persisting the party snapshot")
+
+    comp.ledger.charge = boom
+    with pytest.raises(OSError):
+        comp.charge({"pa": 0.5})
+    # the user leg must not stay charged for a query that never ran —
+    # server requests carry no charge_id, so nothing else would ever
+    # reverse it
+    assert comp.directory.spent("alice") == 0.0
+    c = comp.directory.counters()
+    assert c["charges"] == 1 and c["refunds"] == 1
+
+
+def test_composite_simulated_crash_skips_compensation(tmp_path):
+    # SimulatedCrash stands in for a process KILL: compensating after
+    # it would journal refunds a real kill could never have written,
+    # and the chaos exact-balance assertions rely on that fidelity.
+    # The recovery story is the idempotent re-charge instead.
+    comp = _composite(tmp_path)
+    chaos.install(ChaosPlan(point="ledger.pre_persist", hit=1,
+                            mode="raise"))
+    with pytest.raises(SimulatedCrash):
+        comp.charge({"pa": 0.5}, charge_id="c1")
+    chaos.clear()
+    assert comp.directory.spent("alice") == pytest.approx(0.5)
+    comp.charge({"pa": 0.5}, charge_id="c1")  # the restart's re-issue
+    assert comp.directory.spent("alice") == pytest.approx(0.5)  # dedup
+    assert comp.ledger.spent("pa") == pytest.approx(0.5)
 
 
 def test_refund_reverses_every_leg_from_bare_dict(tmp_path):

@@ -56,7 +56,9 @@ autotuner (``dpcorr.utils.geometry``; cached per device/family/n/dtype,
 second sampler path ``xla_bm`` (Box–Muller, ``dpcorr.ops.fastnorm``)
 races the threefry+erf⁻¹ path under the same ``_sane`` statistical gate
 the rbg/pallas paths use. The worker stamps geometry, device_kind,
-loadavg and the transfer-counter deltas into ``detail``.
+loadavg, the transfer-counter deltas and — where the backend exposes
+memory introspection — per-device watermarks (``obs.devicemon``) into
+``detail``.
 
 ``--gate`` turns the run into a CI regression gate: the measured value is
 compared against ``benchmarks/results/last_known_good.json`` (same
@@ -491,6 +493,13 @@ def worker_main(mode: str, budget_s: float) -> None:
         "geometry": best_geo.as_detail(),
         "transfer": transfer_mod.diff(counters.snapshot(), before),
     }
+    # per-device memory watermarks (ISSUE 11): absent — not zero — when
+    # the backend exposes no introspection (CPU allocators usually don't)
+    from dpcorr.obs import devicemon
+
+    device_wm = devicemon.watermarks_detail(transfer_counters=counters)
+    if any(device_wm.values()):
+        detail["devices"] = device_wm
     if loadavg_1m is not None:
         detail["loadavg_1m"] = loadavg_1m
     print(json.dumps({

@@ -138,12 +138,40 @@ def main() -> int:
                     type=int, default=2048,
                     help="--users mode: LRU cap per shard (small "
                          "enough that evictions are guaranteed)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the ISSUE 11 fleet-telemetry scenario: "
+                         "N real `dpcorr serve` subprocesses (one with "
+                         "a slow-kernel chaos fault), driven over HTTP "
+                         "and scraped by the fleet collector; gates on "
+                         "exact aggregate==Σ per-instance counts out "
+                         "of the merged registry, fleet ε conservation "
+                         "via merged audit replay, and the burn-rate "
+                         "page firing for exactly the faulted instance "
+                         "and dumping its flight recorder (reason "
+                         "slo_page, reconstructed jax-free)")
+    ap.add_argument("--fleet-instances", dest="fleet_instances",
+                    type=int, default=3,
+                    help="--fleet mode: serve subprocesses to launch")
+    ap.add_argument("--fleet-requests", dest="fleet_requests",
+                    type=int, default=24,
+                    help="--fleet mode: requests per healthy instance "
+                         "(the faulted one gets fewer — its point is "
+                         "latency, not volume)")
+    ap.add_argument("--fleet-dir", dest="fleet_dir",
+                    default="fleet_artifacts",
+                    help="--fleet mode: artifact directory (span "
+                         "spools, audit spools, recorder dumps, the "
+                         "merged trace + fleet snapshot)")
     args = ap.parse_args()
 
     if args.users:
         # no kernels, no traffic — pure admission arithmetic; runs
         # before any jax configuration on purpose
         return run_users(args)
+    if args.fleet:
+        # the driver itself never needs jax: the kernels run inside
+        # the serve subprocesses, the collector speaks HTTP + stdlib
+        return run_fleet(args)
 
     import jax
 
@@ -661,6 +689,305 @@ def run_users(args) -> int:
     }
     comp.close()
     shutil.rmtree(root)
+    blob = json.dumps(out, indent=2)
+    print(blob)
+    if args.out_json:
+        with open(args.out_json, "w") as f:
+            f.write(blob)
+    return 0 if all(ok.values()) else 1
+
+
+def run_fleet(args) -> int:
+    """ISSUE 11 acceptance: the fleet telemetry plane against REAL
+    processes. Launches ``--fleet-instances`` serve subprocesses on
+    ephemeral ports (port discovery via the boot banner), installs the
+    ``serve.kernel_slow`` chaos fault in the last one, drives HTTP
+    traffic at all of them, and proves the whole plane end to end:
+
+    - **federated counting** — the aggregate series out of the merged
+      registry equals the sorted-instance sum of every per-instance
+      series AND the client-side success count, exactly (integer
+      counters, no tolerance);
+    - **fleet ε conservation** — replaying the union of the audit
+      spools reproduces the fold of the per-instance ledger snapshots,
+      binary-exact (same sorted-instance addition order on both sides);
+    - **deterministic paging** — the multi-window burn-rate engine,
+      fed the two scrapes under a scripted clock, pages exactly the
+      faulted instance (every healthy one stays ``ok``), and the page
+      arms THAT instance's flight recorder over ``POST /obs/trigger``:
+      the dump lands with reason ``slo_page`` and reconstructs in a
+      jax-free subprocess;
+    - **span union** — the merged Chrome trace carries one pid per
+      instance.
+
+    Artifacts (``--fleet-dir``): per-instance span/audit spools and
+    recorder dumps, ``fleet_snapshot.json`` (the collector document),
+    ``fleet_trace.json`` (the merged Chrome trace) — what CI uploads.
+    """
+    import subprocess
+    import urllib.request
+
+    from dpcorr.obs import fleet as obs_fleet
+    from dpcorr.obs import slo as obs_slo
+
+    n_inst = args.fleet_instances
+    if n_inst < 2:
+        print("--fleet needs at least 2 instances (one healthy, one "
+              "faulted)", file=sys.stderr)
+        return 2
+    fdir = os.path.abspath(args.fleet_dir)
+    os.makedirs(fdir, exist_ok=True)
+    names = [f"fleet-{i}" for i in range(n_inst)]
+    faulted = names[-1]
+    spools = {n: os.path.join(fdir, f"{n}_spans.jsonl") for n in names}
+    audits = {n: os.path.join(fdir, f"{n}_audit.jsonl") for n in names}
+    recs = {n: os.path.join(fdir, f"{n}_flightrec.json") for n in names}
+    for path in (*spools.values(), *audits.values(), *recs.values()):
+        if os.path.exists(path):
+            os.remove(path)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs: dict[str, subprocess.Popen] = {}
+    logs = {}
+    urls: dict[str, str] = {}
+    parties = {n: (f"{n}-x", f"{n}-y") for n in names}
+    #: every request >= 600 ms on the faulted instance — strictly above
+    #: the 0.5 s bucket bound the latency objective pins
+    fault_spec = "point=serve.kernel_slow,mode=sleep,delay_ms=600"
+    try:
+        for name in names:
+            cmd = [sys.executable, "-m", "dpcorr", "serve",
+                   "--port", "0", "--instance", name,
+                   "--platform", "cpu", "--budget", "1e9",
+                   "--span-spool", spools[name],
+                   "--audit", audits[name],
+                   "--flight-recorder", recs[name],
+                   "--aot", "off", "--max-delay-ms", "5"]
+            if name == faulted:
+                cmd += ["--fault", fault_spec]
+            logs[name] = open(os.path.join(fdir, f"{name}.log"), "w")
+            procs[name] = subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=logs[name],
+                text=True, env=env, cwd=os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))))
+        # ---- port discovery: the boot banner prints AFTER bind --------
+        deadline = time.monotonic() + 300
+        for name in names:
+            line = ""
+            while time.monotonic() < deadline:
+                line = procs[name].stdout.readline()
+                if line.strip() or procs[name].poll() is not None:
+                    break
+            if not line.strip():
+                raise RuntimeError(
+                    f"{name}: no boot banner (rc="
+                    f"{procs[name].poll()}; see {name}.log)")
+            banner = json.loads(line)["serving"]
+            urls[name] = f"http://127.0.0.1:{banner['port']}"
+
+        def post_estimate(name: str, seed: int,
+                          timeout: float = 120.0) -> dict:
+            import random as _random
+
+            px, py = parties[name]
+            rs = _random.Random(seed)
+            x = [rs.gauss(0.0, 1.0) for _ in range(64)]
+            y = [xi * 0.5 + rs.gauss(0.0, 1.0) for xi in x]
+            blob = json.dumps({
+                "family": args.family, "x": x, "y": y,
+                "eps1": args.eps1, "eps2": args.eps2,
+                "party_x": px, "party_y": py, "seed": seed}).encode()
+            req = urllib.request.Request(
+                f"{urls[name]}/estimate", data=blob,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return json.load(r)
+
+        # ---- warm-up: compile latency lands BEFORE the t0 scrape ------
+        def warm(name: str) -> None:
+            for k in range(2):
+                post_estimate(name, seed=900_000 + k, timeout=600)
+
+        warm_threads = [threading.Thread(target=warm, args=(n,))
+                        for n in names]
+        for t in warm_threads:
+            t.start()
+        for t in warm_threads:
+            t.join()
+
+        collector = obs_fleet.FleetCollector(
+            [(n, urls[n]) for n in names])
+        snap0 = collector.scrape(timeout_s=30)
+        if snap0.errors():
+            raise RuntimeError(f"t0 scrape errors: {snap0.errors()}")
+
+        # ---- traffic --------------------------------------------------
+        plan = {n: (args.fleet_requests if n != faulted
+                    else max(4, args.fleet_requests // 4))
+                for n in names}
+        successes: dict[str, int] = {n: 0 for n in names}
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def drive(name: str) -> None:
+            for k in range(plan[name]):
+                try:
+                    post_estimate(name, seed=1000 * names.index(name) + k)
+                    with lock:
+                        successes[name] += 1
+                except Exception as e:
+                    with lock:
+                        errors.append(
+                            f"{name}#{k}: {type(e).__name__}: {e}")
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=drive, args=(n,))
+                   for n in names]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+
+        snap1 = collector.scrape(timeout_s=30)
+        if snap1.errors():
+            raise RuntimeError(f"t1 scrape errors: {snap1.errors()}")
+
+        # ---- gate 1: aggregate == Σ per-instance == client count ------
+        fams1 = snap1.families()
+        agg = obs_fleet.families_to_flat(snap1.aggregate())
+        merged = obs_fleet.families_to_flat(snap1.merged())
+        total_series = "dpcorr_serve_requests_total"
+        per_inst = {
+            n: merged.get(f'{total_series}{{instance="{n}"}}', 0.0)
+            for n in names}
+        expected = {n: plan[n] + 2 for n in names}  # +2 warm-ups
+        stats1 = snap1.stats()
+        counts_exact = (
+            agg.get(total_series) == sum(per_inst[n] for n in sorted(names))
+            and all(per_inst[n] == expected[n] == successes[n] + 2
+                    for n in names)
+            and all(stats1[n]["requests_total"] == expected[n]
+                    for n in names))
+
+        # ---- gate 2: burn-rate page, exactly the faulted instance -----
+        paged: list = []
+        objective = obs_slo.Objective(
+            name="latency-slo", kind="latency", target=0.05,
+            threshold_s=0.5)
+        hook = obs_slo.http_trigger_hook(urls, timeout_s=30)
+        engine = obs_slo.BurnRateEngine(
+            [objective],
+            on_page=lambda alert: (paged.append(alert), hook(alert)))
+        # scripted clock: the two scrapes ARE the burn window — the
+        # engine's arithmetic is a pure function of (deltas, clock)
+        fams0 = snap0.families()
+        engine.observe(fams0, at=0.0)
+        engine.observe(fams1, at=60.0)
+        alerts = engine.evaluate(at=60.0)
+        paged_instances = sorted({a.instance for a in paged})
+        page_exact = (paged_instances == [faulted]
+                      and all(engine.state("latency-slo", n) == "ok"
+                              for n in names if n != faulted))
+
+        # ---- gate 3: the page dumped the faulted recorder, jax-free ---
+        dump_doc = None
+        dump_jax_free = False
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not os.path.exists(
+                recs[faulted]):
+            time.sleep(0.2)
+        if os.path.exists(recs[faulted]):
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import json, sys\n"
+                 "from dpcorr.obs.recorder import read_dump\n"
+                 "d = read_dump(sys.argv[1])\n"
+                 "assert 'jax' not in sys.modules, 'jax leaked'\n"
+                 "print(json.dumps({'reason': d['reason'],"
+                 " 'detail': d.get('detail'),"
+                 " 'spans': len(d['spans'])}))",
+                 recs[faulted]],
+                capture_output=True, text=True, env=env,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))
+            if probe.returncode == 0:
+                dump_doc = json.loads(probe.stdout)
+                dump_jax_free = True
+            else:
+                errors.append(f"dump probe: {probe.stderr.strip()}")
+        recorder_ok = (dump_jax_free and dump_doc is not None
+                       and dump_doc["reason"] == "slo_page"
+                       and dump_doc["detail"].get("instance") == faulted)
+
+        # ---- gate 4: fleet ε conservation via merged audit replay -----
+        # the serve subprocesses must flush their audit spools; they do
+        # so synchronously per event, so the files are already complete
+        ledgers = {n: obs_fleet.ledger_parties(stats1[n]) for n in names}
+        cons = obs_fleet.conservation(audits, ledgers)
+        eps_positive = all(
+            cons["fleet"].get(p, 0.0) > 0.0
+            for n in names for p in parties[n])
+
+        # ---- gate 5: span union — one pid per instance ----------------
+        trace_doc = obs_fleet.fleet_chrome_trace(spools)
+        pids = {ev["pid"] for ev in trace_doc["traceEvents"]}
+        trace_ok = len(pids) == n_inst
+
+        # ---- artifacts ------------------------------------------------
+        snap_path = os.path.join(fdir, "fleet_snapshot.json")
+        with open(snap_path, "w") as f:
+            json.dump(snap1.to_doc(), f, indent=2)
+        trace_path = os.path.join(fdir, "fleet_trace.json")
+        obs_fleet.write_fleet_chrome_trace(spools, trace_path)
+
+        ok = {
+            "fleet_up": not snap1.errors() and not errors,
+            "aggregate_counts_exact": counts_exact,
+            "burn_rate_page_exact": page_exact,
+            "recorder_armed_jax_free": recorder_ok,
+            "eps_conservation": cons["ok"] and eps_positive,
+            "trace_union": trace_ok,
+        }
+        out = {
+            "metric": "serve_fleet",
+            "instances": n_inst,
+            "faulted": faulted,
+            "fault": fault_spec,
+            "requests_per_instance": plan,
+            "successes": successes,
+            "wall_s": round(wall, 3),
+            "aggregate_qps": round(
+                sum(successes.values()) / wall, 2) if wall else None,
+            "per_instance_requests_total": per_inst,
+            "aggregate_requests_total": agg.get(total_series),
+            "alerts": [a.to_dict() for a in engine.alerts],
+            "paged_instances": paged_instances,
+            "slo_states": engine.states(),
+            "flight_recorder": {"path": recs[faulted],
+                                "dump": dump_doc,
+                                "jax_free": dump_jax_free},
+            "conservation": cons,
+            "trace_pids": sorted(pids),
+            "artifacts": {"snapshot": snap_path, "trace": trace_path,
+                          "spools": spools, "audits": audits},
+            "ok": ok,
+            "errors": errors[:5],
+        }
+    finally:
+        for name, p in procs.items():
+            p.terminate()
+        for name, p in procs.items():
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+            if p.stdout is not None:
+                p.stdout.close()
+        for fh in logs.values():
+            fh.close()
+
     blob = json.dumps(out, indent=2)
     print(blob)
     if args.out_json:

@@ -234,7 +234,7 @@ def cmd_serve(args):
     """Online serving: micro-batched DP-correlation queries behind a
     per-party ε-budget ledger (dpcorr.serve; docs/SERVING.md)."""
     from dpcorr.obs import trace as obs_trace
-    from dpcorr.serve import serve_http
+    from dpcorr.serve.server import make_http_server
 
     if args.trace:
         # the process tracer, so grid/profiling spans from in-server
@@ -264,7 +264,12 @@ def cmd_serve(args):
     server = _build_server(args)
     if rec is not None:
         server.attach_recorder(rec)
-    print(json.dumps({"serving": {"host": args.host, "port": args.port,
+    # bind BEFORE the banner so --port 0 (ephemeral) is discoverable:
+    # the fleet harness reads the bound port out of the banner line
+    httpd = make_http_server(server, host=args.host, port=args.port)
+    bound_port = httpd.server_address[1]
+    print(json.dumps({"serving": {"host": args.host, "port": bound_port,
+                                  "instance": args.instance,
                                   "budget": args.budget,
                                   "ledger": args.ledger,
                                   "max_batch": args.max_batch,
@@ -291,7 +296,12 @@ def cmd_serve(args):
                                           args.brownout_min_priority},
                                   "faults": args.fault}}),
           flush=True)
-    serve_http(server, host=args.host, port=args.port)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
 
 
 def _build_server(args):
@@ -330,7 +340,8 @@ def _build_server(args):
         user_compact_every=args.user_compact_every,
         user_renew_period_s=args.user_renew_period_s,
         user_burst_cap=args.user_burst_cap,
-        global_budget=args.global_budget)
+        global_budget=args.global_budget,
+        instance=args.instance)
 
 
 def cmd_obs_budget(args):
@@ -455,11 +466,77 @@ def cmd_obs_dump(args):
 
 
 def cmd_obs_top(args):
-    """Live ops console over a serve replica's /metrics + /stats."""
+    """Live ops console over a serve replica's /metrics + /stats —
+    or, with --fleet, over every replica in a target map at once."""
+    if args.fleet:
+        from dpcorr.obs.console import run_fleet_top
+
+        raise SystemExit(run_fleet_top(args.fleet,
+                                       interval_s=args.interval,
+                                       once=args.once))
     from dpcorr.obs.console import run_top
 
     raise SystemExit(run_top(args.url, interval_s=args.interval,
                              once=args.once))
+
+
+def cmd_obs_fleet_snapshot(args):
+    """One scrape of the whole fleet → one JSON artifact: per-instance
+    stats, the merged (instance-labelled) exposition, the exact
+    aggregate. jax-free — the operator story must not need an
+    accelerator stack."""
+    from dpcorr.obs.fleet import FleetCollector
+
+    snap = FleetCollector(args.targets).scrape(timeout_s=args.timeout)
+    doc = snap.to_doc()
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    errors = snap.errors()
+    if args.json or not args.out:
+        print(json.dumps(doc if args.json else {
+            "instances": sorted(snap.instances),
+            "live": sorted(snap.live()),
+            "errors": errors,
+            "out": args.out,
+        }, indent=2))
+    else:
+        print(f"fleet snapshot: {len(snap.live())}/"
+              f"{len(snap.instances)} instances live -> {args.out}")
+        for name, err in sorted(errors.items()):
+            print(f"  DOWN {name}: {err}")
+    raise SystemExit(1 if errors and not snap.live() else 0)
+
+
+def cmd_obs_fleet_chrome(args):
+    """Union many instances' span spools into ONE Chrome trace (one
+    pid per instance) — the fleet postmortem timeline."""
+    from dpcorr.obs.fleet import parse_targets, write_fleet_chrome_trace
+
+    spools = parse_targets(args.spool)
+    out = write_fleet_chrome_trace(spools, args.out)
+    print(f"wrote fleet chrome trace for {len(spools)} instances "
+          f"-> {out}")
+
+
+def cmd_obs_fleet_replay(args):
+    """Fleet-wide audit replay: per-instance ε tables plus the fleet
+    fold (the sum of per-instance ledgers, binary-exact)."""
+    from dpcorr.obs.fleet import fleet_replay, parse_targets
+
+    spools = parse_targets(args.audit)
+    doc = fleet_replay(spools)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    for inst in sorted(doc["per_instance"]):
+        table = doc["per_instance"][inst]
+        spent = ", ".join(f"{p}={e:.6g}"
+                          for p, e in sorted(table.items()))
+        print(f"{inst}: {spent or '(no spend)'}")
+    print("fleet: " + ", ".join(f"{p}={e:.6g}" for p, e in
+                                sorted(doc["fleet"].items())))
 
 
 def _party_columns(args, n: int):
@@ -549,6 +626,7 @@ def cmd_party(args):
     if args.role == "y":
         srv, bound = tcp_listen(args.host, args.port)
         print(json.dumps({"party": {"role": "y", "session": spec.session,
+                                    "instance": args.instance,
                                     "listening": [args.host, bound]}}),
               flush=True)
         if args.journal:
@@ -565,6 +643,7 @@ def cmd_party(args):
             srv = None
     else:
         print(json.dumps({"party": {"role": "x", "session": spec.session,
+                                    "instance": args.instance,
                                     "connecting": [args.host, args.port]}}),
               flush=True)
         if args.journal:
@@ -595,6 +674,10 @@ def cmd_party(args):
     channel = ReliableChannel(link, timeout_s=args.timeout,
                               max_retries=args.max_retries)
     transcript = Transcript(args.transcript)
+    if args.instance:
+        # fleet identity (ISSUE 11): the union layer maps spools by
+        # instance name; the transcript records which one this was
+        transcript.meta(instance=args.instance)
     if plan is not None:
         # reproducibility-from-the-artifact: the kill plan is in the
         # transcript header, so any chaos run replays from its own log
@@ -965,7 +1048,15 @@ def main(argv=None):
                          "service with a per-party privacy-budget ledger "
                          "(docs/SERVING.md)")
     ps_.add_argument("--host", default="127.0.0.1")
-    ps_.add_argument("--port", type=int, default=8321)
+    ps_.add_argument("--port", type=int, default=8321,
+                     help="HTTP port (0 = ephemeral; the bound port is "
+                          "printed in the banner line, which is how "
+                          "the fleet harness discovers replicas)")
+    ps_.add_argument("--instance", default=None,
+                     help="fleet instance name: labels this process in "
+                          "/stats, the instance_info gauge, and the "
+                          "banner, so the fleet collector (obs fleet) "
+                          "can cross-check its target map")
     ps_.add_argument("--budget", type=float, default=100.0,
                      help="default per-party ε budget (basic composition)")
     ps_.add_argument("--ledger", default=None,
@@ -1029,9 +1120,11 @@ def main(argv=None):
                           "otherwise grow compilations without limit)")
     ps_.add_argument("--seed", type=int, default=2025)
     ps_.add_argument("--platform", default=None, choices=["cpu", "tpu"])
-    ps_.add_argument("--trace", default=None,
-                     help="span-trace JSONL path (docs/OBSERVABILITY.md); "
-                          "also settable via DPCORR_TRACE")
+    ps_.add_argument("--trace", "--span-spool", dest="trace", default=None,
+                     help="span-spool JSONL path (docs/OBSERVABILITY.md); "
+                          "also settable via DPCORR_TRACE. The fleet "
+                          "plane unions many instances' spools into one "
+                          "Chrome trace (`dpcorr obs fleet chrome`)")
     ps_.add_argument("--audit", default=None,
                      help="privacy-budget audit-trail JSONL path; replay "
                           "it with `dpcorr obs budget --audit PATH`")
@@ -1133,9 +1226,51 @@ def main(argv=None):
                      help="serve base URL")
     pot.add_argument("--interval", type=float, default=2.0,
                      help="refresh seconds")
+    pot.add_argument("--fleet", default=None, metavar="TARGETS",
+                     help="multi-instance view: comma-separated "
+                          "name=url targets (bare urls get positional "
+                          "names); overrides --url")
     pot.add_argument("--once", action="store_true",
                      help="render one frame and exit (scripting/CI)")
     pot.set_defaults(fn=cmd_obs_top, platform=None, jax_free=True)
+    pof = obs_sub.add_parser("fleet", help="fleet telemetry plane "
+                             "(ISSUE 11): scrape + merge N instances, "
+                             "union spools, replay the fleet ε table; "
+                             "all jax-free")
+    fleet_sub = pof.add_subparsers(dest="fleet_cmd", required=True)
+    pofs = fleet_sub.add_parser("snapshot", help="scrape every target's "
+                                "/metrics + /stats into one artifact: "
+                                "merged instance-labelled exposition + "
+                                "exact aggregate + per-instance stats")
+    pofs.add_argument("--targets", required=True,
+                      help="comma-separated name=url (bare urls get "
+                           "positional instance-N names; duplicate "
+                           "names are refused)")
+    pofs.add_argument("--out", default=None,
+                      help="write the snapshot JSON here")
+    pofs.add_argument("--timeout", type=float, default=5.0)
+    pofs.add_argument("--json", action="store_true",
+                      help="print the full snapshot document")
+    pofs.set_defaults(fn=cmd_obs_fleet_snapshot, platform=None,
+                      jax_free=True)
+    pofc = fleet_sub.add_parser("chrome", help="union many span spools "
+                                "into ONE Chrome trace, one pid per "
+                                "instance (Perfetto-viewable)")
+    pofc.add_argument("--spool", action="append", required=True,
+                      metavar="NAME=PATH",
+                      help="instance span spool (repeatable)")
+    pofc.add_argument("--out", required=True)
+    pofc.set_defaults(fn=cmd_obs_fleet_chrome, platform=None,
+                      jax_free=True)
+    pofr = fleet_sub.add_parser("replay", help="fleet-wide audit "
+                                "replay: per-instance ε tables + the "
+                                "binary-exact fleet fold")
+    pofr.add_argument("--audit", action="append", required=True,
+                      metavar="NAME=PATH",
+                      help="instance audit spool (repeatable)")
+    pofr.add_argument("--json", action="store_true")
+    pofr.set_defaults(fn=cmd_obs_fleet_replay, platform=None,
+                      jax_free=True)
     def _add_spec_flags(p):
         p.add_argument("--family", default="ni_sign",
                        choices=["ni_sign", "int_sign", "ni_subg",
@@ -1170,6 +1305,11 @@ def main(argv=None):
                          "connects; each process holds one column "
                          "(docs/PROTOCOL.md)")
     pp_.add_argument("--role", required=True, choices=["x", "y"])
+    pp_.add_argument("--instance", default=None,
+                     help="fleet instance name: stamped into the "
+                          "banner and the transcript header so this "
+                          "party's span/audit spools can be unioned "
+                          "into the fleet view (`dpcorr obs fleet`)")
     pp_.add_argument("--host", default="127.0.0.1")
     pp_.add_argument("--port", type=int, required=True)
     pp_.add_argument("--connect-timeout", dest="connect_timeout",

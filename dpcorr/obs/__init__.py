@@ -28,6 +28,18 @@ package is the one spine they now share:
   links latency-histogram buckets to trace IDs.
 - :mod:`console` — the live ops console behind ``dpcorr obs top``:
   a jax-free terminal view over ``/metrics`` + ``/stats``.
+- :mod:`fleet`   — the fleet telemetry plane (ISSUE 11): a pull-based
+  collector over N instances, kind-aware exposition merging under
+  ``instance`` labels (counters sum, histogram buckets add, collisions
+  refuse loudly), span-spool union into one Chrome trace and audit
+  union into one binary-exact fleet ε replay.
+- :mod:`slo`     — declarative latency/error/ε-burn objectives
+  evaluated as deterministic multi-window burn-rate alerts over the
+  scraped series; the ``page`` transition arms the offending
+  instance's flight recorder through its existing trigger hook.
+- :mod:`devicemon` — per-device memory watermarks + transfer counters
+  split per device, published as ``dpcorr_device_*`` gauges and
+  stamped into bench artifacts.
 
 See docs/OBSERVABILITY.md for the span model, metric names and the
 audit-trail format.
@@ -44,6 +56,17 @@ from dpcorr.obs.cost import (  # noqa: F401
     CostRegistry,
     ExemplarStore,
 )
+from dpcorr.obs.fleet import (  # noqa: F401
+    FleetCollector,
+    FleetSnapshot,
+    MetricFamily,
+    aggregate_families,
+    fleet_chrome_trace,
+    fleet_replay,
+    merge_families,
+    parse_families,
+    render_families,
+)
 from dpcorr.obs.metrics import (  # noqa: F401
     CONTENT_TYPE,
     LATENCY_BUCKETS,
@@ -58,6 +81,13 @@ from dpcorr.obs.recorder import (  # noqa: F401
     FlightRecorder,
     read_dump,
     reconstruct,
+)
+from dpcorr.obs.slo import (  # noqa: F401
+    Alert,
+    BurnRateEngine,
+    Objective,
+    http_trigger_hook,
+    recorder_trigger_hook,
 )
 from dpcorr.obs.trace import (  # noqa: F401
     Span,

@@ -157,6 +157,90 @@ def render_frame(stats: dict, metrics: dict,
     return "\n".join(lines)
 
 
+def render_fleet_frame(snapshot, now: float | None = None) -> str:
+    """One fleet frame from a :class:`dpcorr.obs.fleet.FleetSnapshot` —
+    one row per instance (dead instances marked DOWN with their scrape
+    error) plus an aggregate line computed from the merged registry, so
+    the totals the console shows are exactly what the federated
+    exposition would report."""
+    lines = []
+    ts = time.strftime("%H:%M:%S",
+                       time.localtime(now if now is not None
+                                      else time.time()))
+    n_live = len(snapshot.live())
+    n_all = len(snapshot.instances)
+    lines.append(f"dpcorr obs top --fleet  ·  {ts}  ·  "
+                 f"{n_live}/{n_all} instances up")
+    lines.append("-" * 72)
+    lines.append(f"{'instance':<14} {'done':>7} {'refused':>7} "
+                 f"{'queue':>5} {'p50 ms':>8} {'p99 ms':>8}  top ε")
+    for name in sorted(snapshot.instances):
+        rec = snapshot.instances[name]
+        if rec.get("error") is not None:
+            lines.append(f"{name:<14} DOWN  {rec['error']}")
+            continue
+        stats = rec.get("stats") or {}
+        lat = stats.get("latency_s", {})
+        rows = top_parties(stats.get("ledger"), k=1)
+        top = (f"{rows[0][0]}={_fmt_eps(rows[0][1])}" if rows else "-")
+        done = (stats.get("batched_requests", 0)
+                + stats.get("unbatched_requests", 0))
+        lines.append(
+            f"{name:<14} {done:>7} "
+            f"{sum(stats.get('refused', {}).values()):>7} "
+            f"{stats.get('queue_depth', 0):>5} "
+            f"{lat.get('p50', 0.0) * 1e3:>8.2f} "
+            f"{lat.get('p99', 0.0) * 1e3:>8.2f}  {top}")
+    lines.append("-" * 72)
+    if n_live:
+        agg = snapshot.aggregate()
+
+        def total(name: str) -> float:
+            # sum every child of the family (completed_total is
+            # labelled by mode; refused_total by reason)
+            fam = agg.get(name)
+            if fam is None:
+                return 0.0
+            return sum(v for s, _, v in fam.samples if s == name)
+
+        lines.append(
+            "fleet       : "
+            f"{total('dpcorr_serve_requests_completed_total'):g} done   "
+            f"{total('dpcorr_serve_requests_refused_total'):g} refused   "
+            f"{total('dpcorr_serve_requests_failed_total'):g} failed   "
+            f"queue {total('dpcorr_serve_queue_depth'):g}")
+    else:
+        lines.append("fleet       : no live instances")
+    return "\n".join(lines)
+
+
+def run_fleet_top(targets, interval_s: float = 2.0, once: bool = False,
+                  out=None, max_frames: int | None = None) -> int:
+    """The ``dpcorr obs top --fleet`` loop. Exit 0 after any frame with
+    at least one live instance; 1 when the first scrape reaches nobody
+    (mirrors :func:`run_top`'s unreachable-server contract)."""
+    from dpcorr.obs.fleet import FleetCollector
+    emit = out if out is not None else print
+    collector = FleetCollector(targets)
+    frames = 0
+    while True:
+        snapshot = collector.scrape()
+        if not snapshot.live() and frames == 0:
+            emit("obs top --fleet: no live instances:")
+            for name, err in sorted(snapshot.errors().items()):
+                emit(f"  {name}: {err}")
+            return 1
+        frame = render_fleet_frame(snapshot)
+        if once:
+            emit(frame)
+            return 0
+        emit(_CLEAR + frame)
+        frames += 1
+        if max_frames is not None and frames >= max_frames:
+            return 0
+        time.sleep(interval_s)
+
+
 def run_top(url: str, interval_s: float = 2.0, once: bool = False,
             out=None, max_frames: int | None = None) -> int:
     """The ``dpcorr obs top`` loop. Returns a process exit code: 0 on

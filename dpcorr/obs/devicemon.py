@@ -1,0 +1,207 @@
+"""Per-device memory watermarks and per-device transfer attribution.
+
+The bench/roofline artifacts record *how fast* the device went; nothing
+records *how full* it was — and the multi-device scaling work the
+ROADMAP names will be memory-bound long before it is FLOP-bound (HBM
+per chip is the scarce resource; see the accelerator guide's memory
+hierarchy). This module samples what the backend exposes and publishes
+it as ``dpcorr_device_*`` gauges, degrading gracefully by design:
+
+- ``device.memory_stats()`` where the runtime implements it (TPU/GPU
+  backends: ``bytes_in_use``, ``peak_bytes_in_use``, ``bytes_limit``);
+  CPU backends typically return nothing — those fields are simply
+  absent, never faked as zero.
+- live-buffer sampling via ``jax.live_arrays()`` where available:
+  buffer count and bytes per device — the "what is actually resident"
+  view that catches a leaked donation or an accidental replication.
+- the process-wide transfer counters (:mod:`dpcorr.obs.transfer`)
+  split per device: today's pipelines place on one device, so the
+  split attributes the totals to each dispatch's placement device
+  (callers pass it; the default is the backend's device 0, which is
+  exact for every single-device pipeline in the tree).
+
+Everything is jax-gated at call time: importing this module costs
+nothing and never pulls jax; on a jax-free box every probe returns
+``{}`` and the gauges stay unpublished. ``bench.py`` stamps
+:func:`watermarks_detail` into its artifact next to the transfer
+deltas, and the serve/fleet plane scrapes the gauges like any other.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Mapping
+
+from dpcorr.obs.metrics import Registry, default_registry
+
+#: memory_stats() keys we publish when the backend reports them,
+#: mapped to gauge names (all bytes)
+_MEM_KEYS = (
+    ("bytes_in_use", "dpcorr_device_mem_bytes_in_use",
+     "Device allocator bytes currently in use"),
+    ("peak_bytes_in_use", "dpcorr_device_mem_peak_bytes",
+     "Device allocator high-water mark (backend-reported)"),
+    ("bytes_limit", "dpcorr_device_mem_limit_bytes",
+     "Device allocator capacity"),
+)
+
+
+def _jax():
+    try:
+        import jax
+
+        return jax
+    except Exception:  # jax-free box: every probe degrades to empty
+        return None
+
+
+def device_label(device) -> str:
+    """Stable per-device label: ``platform:id`` (matches how the
+    compile cache and geometry autotuner key devices)."""
+    return f"{getattr(device, 'platform', 'unknown')}:" \
+           f"{getattr(device, 'id', 0)}"
+
+
+def probe() -> dict[str, dict]:
+    """One sample of every visible device: ``{device_label: stats}``.
+    Fields appear only when the backend reports them; a jax-free
+    process (or a backend with no memory introspection) yields ``{}``
+    entries rather than invented zeros. Never raises."""
+    jax = _jax()
+    if jax is None:
+        return {}
+    out: dict[str, dict] = {}
+    try:
+        devices = list(jax.devices())
+    except Exception:
+        return {}
+    for d in devices:
+        stats: dict = {}
+        ms = getattr(d, "memory_stats", None)
+        if callable(ms):
+            try:
+                reported = ms() or {}
+            except Exception:
+                reported = {}
+            for key, _, _ in _MEM_KEYS:
+                if key in reported:
+                    stats[key] = int(reported[key])
+        out[device_label(d)] = stats
+    # live buffers: version-gated (jax.live_arrays is the modern
+    # spelling); arrays may be multi-device — attribute to each shard's
+    # device so replication shows up as replication
+    live = getattr(jax, "live_arrays", None)
+    if callable(live):
+        try:
+            arrays = live()
+        except Exception:
+            arrays = []
+        counts: dict[str, int] = {}
+        nbytes: dict[str, int] = {}
+        for a in arrays:
+            for d in _array_devices(a):
+                label = device_label(d)
+                counts[label] = counts.get(label, 0) + 1
+                nbytes[label] = nbytes.get(label, 0) + int(
+                    getattr(a, "nbytes", 0))
+        for label, rec in out.items():
+            if label in counts:
+                rec["live_buffers"] = counts[label]
+                rec["live_buffer_bytes"] = nbytes[label]
+    return out
+
+
+def _array_devices(a) -> list:
+    try:
+        devs = a.devices()  # modern jax.Array
+        return list(devs)
+    except Exception:
+        d = getattr(a, "device", None)
+        if callable(d):
+            try:
+                return [d()]
+            except Exception:
+                return []
+        return [d] if d is not None else []
+
+
+class DeviceMonitor:
+    """Samples device memory + splits transfer counters per device,
+    publishing ``dpcorr_device_*`` gauges into ``registry`` and keeping
+    its own high-water marks across samples (the backend peak resets
+    with the allocator; the monitor's watermark survives for the bench
+    artifact)."""
+
+    def __init__(self, registry: Registry | None = None,
+                 transfer_counters=None):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        r = self.registry
+        self._mem_gauges = {
+            key: r.gauge(gname, ghelp, labelnames=("device",))
+            for key, gname, ghelp in _MEM_KEYS}
+        self._live_count = r.gauge(
+            "dpcorr_device_live_buffers",
+            "Live jax buffers resident on the device",
+            labelnames=("device",))
+        self._live_bytes = r.gauge(
+            "dpcorr_device_live_buffer_bytes",
+            "Bytes held by live jax buffers on the device",
+            labelnames=("device",))
+        self._transfer = r.gauge(
+            "dpcorr_device_transfer",
+            "Process transfer counters (obs.transfer) attributed to "
+            "the dispatch placement device",
+            labelnames=("device", "counter"))
+        self._counters = transfer_counters
+        self._lock = threading.Lock()
+        self._watermarks: dict[str, dict] = {}  # guarded by: _lock
+
+    def sample(self, transfer_device: str | None = None) -> dict:
+        """One sample: probe devices, publish gauges, fold watermarks.
+        ``transfer_device`` names the device the process's transfer
+        counters belong to; default is the first probed device (exact
+        for single-device pipelines — multi-device callers say which)."""
+        snap = probe()
+        with self._lock:
+            for label, stats in snap.items():
+                for key, _, _ in _MEM_KEYS:
+                    if key in stats:
+                        self._mem_gauges[key].set(stats[key],
+                                                  device=label)
+                if "live_buffers" in stats:
+                    self._live_count.set(stats["live_buffers"],
+                                         device=label)
+                    self._live_bytes.set(stats["live_buffer_bytes"],
+                                         device=label)
+                wm = self._watermarks.setdefault(label, {})
+                for key in ("bytes_in_use", "peak_bytes_in_use",
+                            "live_buffer_bytes", "live_buffers"):
+                    if key in stats:
+                        wm[key] = max(wm.get(key, 0), stats[key])
+                if "bytes_limit" in stats:
+                    wm["bytes_limit"] = stats["bytes_limit"]
+        if self._counters is not None and snap:
+            label = transfer_device if transfer_device is not None \
+                else sorted(snap)[0]
+            for counter, value in self._counters.snapshot().items():
+                self._transfer.set(value, device=label, counter=counter)
+        return snap
+
+    def watermarks(self) -> dict[str, dict]:
+        """Per-device high-water marks over this monitor's lifetime —
+        what the bench artifact stamps."""
+        with self._lock:
+            return {label: dict(wm)
+                    for label, wm in sorted(self._watermarks.items())}
+
+
+def watermarks_detail(transfer_counters=None) -> dict[str, dict]:
+    """One-shot probe for artifact stamping: a private registry (no
+    cross-contamination with the process default), one sample, the
+    watermark dict. Empty on a jax-free or introspection-free box —
+    callers stamp it gated (``if devices: detail["devices"] = ...``)."""
+    mon = DeviceMonitor(registry=Registry(),
+                        transfer_counters=transfer_counters)
+    mon.sample()
+    return mon.watermarks()

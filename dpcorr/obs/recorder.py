@@ -56,6 +56,7 @@ TRIGGER_REASONS = (
     "sigusr2",             # operator asked (kill -USR2)
     "cli",                 # dpcorr obs dump --live / tests
     "shutdown",            # orderly close with --flight-recorder armed
+    "slo_page",            # a burn-rate page armed this instance (obs.slo)
 )
 
 

@@ -162,8 +162,12 @@ class DpcorrServer:
                  user_renew_period_s: float = 86400.0,
                  user_burst_cap: float = 0.0,
                  user_fsync: bool = True,
-                 global_budget: float | None = None):
+                 global_budget: float | None = None,
+                 instance: str | None = None):
         self.seed = seed
+        #: fleet identity (ISSUE 11): label on /stats + /metrics so the
+        #: fleet collector can cross-check its target map
+        self.instance = instance
         # obs wiring (ISSUE 2): one tracer spans the request lifecycle
         # (admit → charge → enqueue → flush → respond; default is the
         # process tracer, disabled unless configured), one per-server
@@ -171,7 +175,7 @@ class DpcorrServer:
         # ledger's audit trail stamps budget events with trace IDs
         self.tracer = tracer if tracer is not None else obs_trace.tracer()
         self.audit = AuditTrail(audit) if isinstance(audit, str) else audit
-        self.stats = ServeStats()
+        self.stats = ServeStats(instance=instance)
         # per-request cost attribution (ISSUE 9): a CostRecord per
         # admission, filled in across the queue/compile/kernel path and
         # returned in response metadata; the bounded registry keeps the
@@ -724,6 +728,32 @@ def make_http_server(server: DpcorrServer, host: str = "127.0.0.1",
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):  # noqa: N802
+            if self.path == "/obs/trigger":
+                # fleet SLO plane (ISSUE 11): a burn-rate page arms
+                # THIS instance's flight recorder through its existing
+                # trigger hook — the dump happens here, next to the
+                # rings, not in the collector process. Reasons are
+                # validated against the recorder's append-only registry
+                # so a typo'd page cannot mint an unknown reason.
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length))
+                    reason = body.get("reason")
+                    detail = body.get("detail") or {}
+                    if reason not in obs_recorder.TRIGGER_REASONS:
+                        raise ValueError(
+                            f"unknown trigger reason {reason!r}")
+                    if not isinstance(detail, dict):
+                        raise ValueError("detail must be an object")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                path = obs_recorder.trigger(
+                    reason, **{str(k): v for k, v in detail.items()})
+                self._send(200, {"dumped": path,
+                                 "armed": obs_recorder.active()
+                                 is not None})
+                return
             if self.path != "/estimate":
                 self._send(404, {"error": f"no route {self.path}"})
                 return

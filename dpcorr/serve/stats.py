@@ -70,9 +70,20 @@ class ServeStats:
 
     def __init__(self, reservoir: int = 8192,
                  registry: Registry | None = None,
-                 slo_s: float = 0.25, slo_window_s: float = 60.0):
+                 slo_s: float = 0.25, slo_window_s: float = 60.0,
+                 instance: str | None = None):
         self.registry = registry if registry is not None else Registry()
         r = self.registry
+        # fleet identity (ISSUE 11): the instance name rides every
+        # snapshot and an info-style gauge, so the fleet collector can
+        # cross-check its target map against what the process claims
+        self.instance = instance
+        self._instance_info = r.gauge(
+            "dpcorr_serve_instance_info",
+            "Constant 1; the label carries this process's fleet "
+            "instance name", labelnames=("instance",))
+        if instance is not None:
+            self._instance_info.set(1, instance=str(instance))
         self._requests = r.counter(
             "dpcorr_serve_requests_total",
             "Requests admitted (charged and enqueued)")
@@ -432,6 +443,8 @@ class ServeStats:
             "kernel_histogram": self._kernel_hist.snapshot(),
             "slo": self.slo_snapshot(),
             "exemplars": self.exemplars.snapshot(),
+            # fleet identity (ISSUE 11): None for a standalone server
+            "instance": self.instance,
         }
         if cost_aggregate is not None:
             snap["costs"] = cost_aggregate

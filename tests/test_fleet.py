@@ -1,0 +1,422 @@
+"""Unit tests for the fleet telemetry plane (ISSUE 11): kind-aware
+exposition parsing + federated merge (dpcorr.obs.fleet), the
+multi-window burn-rate SLO engine under a scripted clock
+(dpcorr.obs.slo), and the jax-free ``dpcorr obs fleet snapshot`` CLI
+against a canned in-thread HTTP fleet."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dpcorr.obs import Registry
+from dpcorr.obs.fleet import (
+    FleetCollector,
+    MetricFamily,
+    aggregate_families,
+    conservation,
+    families_to_flat,
+    fleet_chrome_trace,
+    fleet_replay,
+    merge_expositions,
+    merge_families,
+    parse_families,
+    parse_targets,
+    render_families,
+)
+from dpcorr.obs.slo import (
+    DEFAULT_WINDOWS,
+    BurnRateEngine,
+    Objective,
+    http_trigger_hook,
+)
+
+BUCKETS = (0.1, 0.5, 1.0)
+
+
+def _instance_registry(completed: int, slow: int, refused: int,
+                       spent: float) -> Registry:
+    """One synthetic serve-shaped instance: counters, a labelled
+    counter, a latency histogram and a per-party spend gauge."""
+    r = Registry()
+    c = r.counter("dpcorr_serve_requests_total", "admitted")
+    c.inc(completed + refused)
+    ref = r.counter("dpcorr_serve_requests_refused_total", "refused",
+                    labelnames=("reason",))
+    if refused:
+        ref.inc(refused, reason="budget")
+    done = r.counter("dpcorr_serve_requests_completed_total",
+                     "completed", labelnames=("mode",))
+    if completed:
+        done.inc(completed, mode="batched")
+    h = r.histogram("dpcorr_serve_latency_seconds", "latency",
+                    buckets=BUCKETS)
+    for _ in range(completed - slow):
+        h.observe(0.05)
+    for _ in range(slow):
+        h.observe(0.75)  # > 0.5: bad under the 0.5 s objective
+    g = r.gauge("dpcorr_ledger_spent_eps", "spend",
+                labelnames=("party",))
+    g.set(spent, party="px")
+    return r
+
+
+# ------------------------------------------------- parse / round-trip ----
+
+def test_parse_render_round_trip_is_exact():
+    text = _instance_registry(10, 2, 1, 2.5).render()
+    fams = parse_families(text)
+    assert parse_families(render_families(fams)) == fams
+    # the flat view agrees with the metrics-module parser's shape
+    flat = families_to_flat(fams)
+    assert flat["dpcorr_serve_requests_total"] == 11.0
+    assert flat['dpcorr_serve_latency_seconds_bucket{le="0.5"}'] == 8.0
+
+
+def test_parse_families_attaches_histogram_series():
+    fams = parse_families(_instance_registry(4, 0, 0, 1.0).render())
+    h = fams["dpcorr_serve_latency_seconds"]
+    assert h.kind == "histogram"
+    names = {s for s, _, _ in h.samples}
+    assert names == {"dpcorr_serve_latency_seconds_bucket",
+                     "dpcorr_serve_latency_seconds_sum",
+                     "dpcorr_serve_latency_seconds_count"}
+
+
+def test_parse_families_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_families("dpcorr_x{unclosed 1\n")
+
+
+# --------------------------------------------------------------- merge ----
+
+def _three_instances() -> dict[str, dict[str, MetricFamily]]:
+    return {
+        "a": parse_families(_instance_registry(10, 0, 1, 1.5).render()),
+        "b": parse_families(_instance_registry(20, 0, 2, 2.5).render()),
+        "c": parse_families(_instance_registry(5, 5, 0, 0.25).render()),
+    }
+
+
+def test_merge_labels_every_sample_and_aggregate_sums_exactly():
+    merged = merge_families(_three_instances())
+    flat = families_to_flat(merged)
+    assert flat['dpcorr_serve_requests_total{instance="a"}'] == 11.0
+    assert flat['dpcorr_serve_requests_total{instance="b"}'] == 22.0
+    assert flat['dpcorr_serve_requests_total{instance="c"}'] == 5.0
+    agg = families_to_flat(aggregate_families(merged))
+    # counters sum exactly (integers — no tolerance)
+    assert agg["dpcorr_serve_requests_total"] == 38.0
+    assert agg['dpcorr_serve_requests_refused_total{reason="budget"}'] \
+        == 3.0
+    # cumulative histogram buckets add bucket-wise: a and b's 30 fast
+    # observations land ≤ 0.5, c's 5 slow ones only at ≤ 1.0
+    assert agg['dpcorr_serve_latency_seconds_bucket{le="0.5"}'] == 30.0
+    assert agg['dpcorr_serve_latency_seconds_bucket{le="1"}'] == 35.0
+    assert agg["dpcorr_serve_latency_seconds_count"] == 35.0
+    # re-exposing the merged registry round-trips
+    assert parse_families(render_families(merged)) == merged
+
+
+def test_merged_exposition_is_itself_scrapeable():
+    merged = merge_families(_three_instances())
+    again = parse_families(render_families(merged))
+    assert families_to_flat(again) == families_to_flat(merged)
+
+
+def test_matching_instance_self_report_passes():
+    r = Registry()
+    r.gauge("dpcorr_serve_instance_info", "id",
+            labelnames=("instance",)).set(1, instance="a")
+    merged = merge_families(
+        {"a": parse_families(r.render())})
+    flat = families_to_flat(merged)
+    assert flat['dpcorr_serve_instance_info{instance="a"}'] == 1.0
+
+
+def test_colliding_instance_claim_refuses_loudly():
+    r = Registry()
+    r.gauge("dpcorr_serve_instance_info", "id",
+            labelnames=("instance",)).set(1, instance="imposter")
+    with pytest.raises(ValueError, match="imposter"):
+        merge_families({"a": parse_families(r.render())})
+
+
+def test_duplicate_instance_names_refuse():
+    text = _instance_registry(1, 0, 0, 0.5).render()
+    with pytest.raises(ValueError, match="duplicate"):
+        merge_expositions([("a", text), ("a", text)])
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_targets("a=http://h:1,a=http://h:2")
+
+
+def test_kind_clash_across_instances_refuses():
+    ra, rb = Registry(), Registry()
+    ra.counter("dpcorr_thing", "as counter").inc()
+    rb.gauge("dpcorr_thing", "as gauge").set(2)
+    with pytest.raises(ValueError, match="already merged"):
+        merge_families({"a": parse_families(ra.render()),
+                        "b": parse_families(rb.render())})
+
+
+# ------------------------------------------------------------ audit ε ----
+
+def _events(n_charges: int, eps: float, refund_last: bool) -> list[dict]:
+    evs = [{"kind": "charge", "charges": {"px": eps, "py": eps / 2},
+            "charge_id": f"c{i}"} for i in range(n_charges)]
+    if refund_last:
+        evs.append({"kind": "refund",
+                    "charges": {"px": eps, "py": eps / 2},
+                    "charge_id": f"c{n_charges - 1}"})
+    return evs
+
+
+def test_fleet_replay_folds_in_sorted_instance_order():
+    spools = {"b": _events(3, 0.25, False),
+              "a": _events(2, 0.25, True)}
+    doc = fleet_replay(spools)
+    assert doc["per_instance"]["a"] == {"px": 0.25, "py": 0.125}
+    assert doc["per_instance"]["b"] == {"px": 0.75, "py": 0.375}
+    # the fleet fold IS the sum of the per-instance ledgers, exactly
+    assert doc["fleet"] == {"px": 1.0, "py": 0.5}
+
+
+def test_conservation_verdict_binary_exact():
+    spools = {"a": _events(2, 0.25, False), "b": _events(4, 0.25, False)}
+    ledgers = {"a": {"px": 0.5, "py": 0.25},
+               "b": {"px": 1.0, "py": 0.5}}
+    doc = conservation(spools, ledgers)
+    assert doc["ok"] and doc["fleet_ok"]
+    assert doc["fleet"] == doc["ledger_fleet"] == {"px": 1.5, "py": 0.75}
+    # one instance lying by one ulp-scale epsilon breaks the gate
+    ledgers["b"] = {"px": 1.0 + 2**-40, "py": 0.5}
+    bad = conservation(spools, ledgers)
+    assert not bad["ok"] and bad["mismatches"][0]["instance"] == "b"
+
+
+# ---------------------------------------------------------- span union ----
+
+def test_fleet_chrome_trace_one_pid_per_instance():
+    def span(trace, name, ts):
+        return {"trace_id": trace, "span_id": "s1", "parent_id": None,
+                "name": name, "ts": ts, "dur_s": 0.01, "attrs": {}}
+    doc = fleet_chrome_trace({
+        "b": [span("t1", "serve.request", 2.0)],
+        "a": [span("t0", "serve.request", 1.0)],
+    })
+    evs = doc["traceEvents"]
+    meta = {e["args"]["name"]: e["pid"] for e in evs
+            if e.get("name") == "process_name"}
+    assert meta == {"a": 1, "b": 2}  # sorted instances, stable pids
+    spans = [e for e in evs if e.get("ph") == "X"]
+    assert {e["pid"] for e in spans} == {1, 2}
+    assert all(e["args"]["instance"] in ("a", "b") for e in spans)
+
+
+# --------------------------------------------------------- SLO engine ----
+
+def _fams(completed: int, slow: int) -> dict[str, MetricFamily]:
+    return parse_families(
+        _instance_registry(completed, slow, 0, 1.0).render())
+
+
+def test_latency_objective_requires_exact_bucket_bound():
+    with pytest.raises(ValueError, match="bucket bound"):
+        Objective(name="lat", kind="latency", target=0.05,
+                  threshold_s=0.3).cumulative(_fams(4, 0))
+    bad, total = Objective(
+        name="lat", kind="latency", target=0.05,
+        threshold_s=0.5).cumulative(_fams(10, 3))
+    assert (bad, total) == (3.0, 10.0)
+
+
+def test_burn_rate_engine_pages_offender_exactly_once():
+    obj = Objective(name="lat", kind="latency", target=0.05,
+                    threshold_s=0.5)
+    paged = []
+    eng = BurnRateEngine([obj], on_page=paged.append)
+    eng.observe({"good": _fams(10, 0), "bad": _fams(10, 0)}, at=0.0)
+    # bad instance: every new request lands slow → burn 20 > 14.4
+    eng.observe({"good": _fams(40, 0), "bad": _fams(20, 10)}, at=60.0)
+    fired = eng.evaluate(at=60.0)
+    assert [a.instance for a in fired] == ["bad"]
+    assert fired[0].severity == "page" and fired[0].previous == "ok"
+    assert fired[0].burn_short == pytest.approx(20.0)
+    assert eng.state("lat", "good") == "ok"
+    assert [a.instance for a in paged] == ["bad"]
+    # exactly-once: re-evaluating the unchanged world fires nothing
+    assert eng.evaluate(at=61.0) == []
+    assert [a.instance for a in paged] == ["bad"]
+
+
+def test_burn_rate_engine_recovers_to_ok():
+    obj = Objective(name="lat", kind="latency", target=0.05,
+                    threshold_s=0.5)
+    eng = BurnRateEngine([obj])
+    eng.observe({"i": _fams(10, 0)}, at=0.0)
+    eng.observe({"i": _fams(20, 10)}, at=60.0)
+    assert [a.severity for a in eng.evaluate(at=60.0)] == ["page"]
+    # a long healthy stretch: the short window's anchor moves past the
+    # incident and the burn drops to ~0
+    eng.observe({"i": _fams(520, 10)}, at=400.0)
+    eng.observe({"i": _fams(1020, 10)}, at=800.0)
+    fired = eng.evaluate(at=800.0)
+    assert [a.severity for a in fired] == ["ok"]
+    assert eng.state("lat", "i") == "ok"
+    # transition log keeps the whole story, oldest first
+    assert [a.severity for a in eng.alerts] == ["page", "ok"]
+
+
+def test_error_objective_and_scripted_windows():
+    obj = Objective(name="err", kind="error", target=0.1)
+    eng = BurnRateEngine([obj], windows=(("page", 60.0, 120.0, 2.0),))
+    r0 = parse_families(_instance_registry(10, 0, 0, 1.0).render())
+    r1 = parse_families(_instance_registry(10, 0, 5, 1.0).render())
+    eng.observe({"i": r0}, at=0.0)
+    eng.observe({"i": r1}, at=30.0)
+    fired = eng.evaluate(at=30.0)
+    # 5 bad / 5 total new → burn 10 > 2 on both (partial) windows
+    assert [a.severity for a in fired] == ["page"]
+
+
+def test_eps_burn_objective():
+    obj = Objective(name="eps", kind="eps_burn", target=1.0,
+                    eps_per_s=0.01)
+    eng = BurnRateEngine([obj], windows=DEFAULT_WINDOWS)
+    r0 = parse_families(_instance_registry(10, 0, 0, 1.0).render())
+    r1 = parse_families(_instance_registry(10, 0, 0, 100.0).render())
+    eng.observe({"i": r0}, at=0.0)
+    eng.observe({"i": r1}, at=60.0)
+    fired = eng.evaluate(at=60.0)
+    # 99 ε in 60 s against a 0.01 ε/s schedule → burn 165 ≫ 14.4
+    assert [a.severity for a in fired] == ["page"]
+    assert fired[0].burn_short == pytest.approx(99.0 / 0.6)
+
+
+def test_http_trigger_hook_never_raises_on_dead_instance():
+    hook = http_trigger_hook({"i": "http://127.0.0.1:1"}, timeout_s=0.2)
+    obj = Objective(name="lat", kind="latency", target=0.05,
+                    threshold_s=0.5)
+    eng = BurnRateEngine([obj], on_page=hook)
+    eng.observe({"i": _fams(10, 0)}, at=0.0)
+    eng.observe({"i": _fams(20, 10)}, at=60.0)
+    assert [a.severity for a in eng.evaluate(at=60.0)] == ["page"]
+
+
+# ------------------------------------------------ collector + CLI ----
+
+def _canned_fleet_server(exposition: str, stats: dict):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path == "/metrics":
+                blob = exposition.encode()
+                ctype = "text/plain"
+            elif self.path == "/stats":
+                blob = json.dumps(stats).encode()
+                ctype = "application/json"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def log_message(self, *a):  # keep pytest output clean
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def test_collector_scrapes_and_survives_dead_instances():
+    httpd = _canned_fleet_server(
+        _instance_registry(7, 0, 0, 0.5).render(),
+        {"requests_total": 7, "ledger": {"parties": {}}})
+    try:
+        port = httpd.server_address[1]
+        snap = FleetCollector(
+            {"up": f"http://127.0.0.1:{port}",
+             "down": "http://127.0.0.1:1"}).scrape(timeout_s=5)
+        assert set(snap.live()) == {"up"}
+        assert "down" in snap.errors()
+        flat = families_to_flat(snap.aggregate())
+        assert flat["dpcorr_serve_requests_total"] == 7.0
+        doc = snap.to_doc()
+        assert doc["instances"]["up"]["stats"]["requests_total"] == 7
+        assert doc["instances"]["down"]["error"]
+    finally:
+        httpd.shutdown()
+
+
+def test_obs_fleet_snapshot_cli_is_jax_free(tmp_path):
+    httpd = _canned_fleet_server(
+        _instance_registry(3, 1, 0, 0.25).render(),
+        {"requests_total": 3, "ledger": {"parties": {}}})
+    out_path = str(tmp_path / "snap.json")
+    try:
+        port = httpd.server_address[1]
+        script = (
+            "import sys\n"
+            "sys.modules['jax'] = None\n"  # any jax import explodes
+            "sys.argv = ['dpcorr', 'obs', 'fleet', 'snapshot',"
+            " '--targets', 'solo=http://127.0.0.1:%d',"
+            " '--out', %r, '--json']\n"
+            "from dpcorr.__main__ import main\n"
+            "main()\n" % (port, out_path))
+        run = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=120)
+        assert run.returncode == 0, run.stderr
+        doc = json.loads(run.stdout)
+        assert doc["version"] == 1
+        assert doc["instances"]["solo"]["error"] is None
+        assert doc["aggregate"]["dpcorr_serve_requests_total"] == 3.0
+        # --out wrote the identical artifact
+        assert json.load(open(out_path)) == doc
+    finally:
+        httpd.shutdown()
+
+
+def test_obs_fleet_snapshot_cli_exits_1_when_all_dead(tmp_path):
+    script = (
+        "import sys\n"
+        "sys.argv = ['dpcorr', 'obs', 'fleet', 'snapshot',"
+        " '--targets', 'x=http://127.0.0.1:1', '--timeout', '0.2']\n"
+        "from dpcorr.__main__ import main\n"
+        "main()\n")
+    run = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=120)
+    assert run.returncode == 1
+
+
+# -------------------------------------------------------- fleet console ----
+
+def test_render_fleet_frame_rows_and_aggregate():
+    from dpcorr.obs.console import render_fleet_frame
+    from dpcorr.obs.fleet import FleetSnapshot
+
+    text = _instance_registry(6, 0, 0, 0.5).render()
+    snap = FleetSnapshot({
+        "a": {"url": "http://h:1", "error": None, "exposition": text,
+              "stats": {"batched_requests": 4, "unbatched_requests": 2,
+                        "queue_depth": 1, "refused": {"budget": 1},
+                        "latency_s": {"p50": 0.01, "p99": 0.02},
+                        "ledger": {"parties": {"px": {
+                            "spent": 0.5, "budget": 2.0}}}}},
+        "dead": {"url": "http://h:2", "error": "URLError: refused",
+                 "exposition": None, "stats": None},
+    })
+    frame = render_fleet_frame(snap, now=0.0)
+    assert "1/2 instances up" in frame
+    assert "dead" in frame and "DOWN" in frame
+    assert "px=0.5" in frame
+    # the aggregate line reads the merged registry, not the stats blobs
+    assert "6 done" in frame

@@ -119,8 +119,205 @@ def _audit_arm(spec, x, y, transcript_dir: str) -> dict:
     return out
 
 
+MATRIX_PARTIES = [("p0", ["a", "b"]), ("p1", ["c"]), ("p2", ["d"])]
+
+
+def _merged_cells(results: dict) -> dict:
+    """Union of every party's cell view; parties sharing a cell must
+    agree bitwise (the wire result IS the finisher's result)."""
+    cells: dict = {}
+    for res in results.values():
+        for key, val in res.cells.items():
+            if key in cells:
+                assert cells[key] == val, f"parties disagree on {key}"
+            cells.setdefault(key, val)
+    return cells
+
+
+def _matrix_resume_verdict(plan, data, workdir: str) -> dict:
+    """Raise-mode kill of p0 at ``federation.pre_release``, then resume
+    on the same endpoints/journals/persistent ledgers — the benchmark's
+    in-process form of the chaos CLI's kill-any-party case. Verdict:
+    the resumed matrix is bit-identical to the clean one and every
+    party's ε was spent exactly once."""
+    import threading
+
+    from dpcorr import chaos
+    from dpcorr.protocol.federation import make_federation_parties
+    from dpcorr.serve.ledger import PrivacyLedger
+
+    def ledgers():
+        return {name: PrivacyLedger(
+            1e6, path=os.path.join(workdir, f"ledger.{name}.json"))
+            for name, _ in plan.parties}
+
+    from dpcorr.protocol import InProcTransport
+
+    endpoints = {lk: InProcTransport() for lk in plan.links()}
+    parties = make_federation_parties(plan, data, ledgers=ledgers(),
+                                      endpoints=endpoints,
+                                      journal_dir=workdir)
+    chaos.install(chaos.ChaosPlan("federation.pre_release", hit=1,
+                                  mode="raise",
+                                  thread_name="party-p0"))
+    results: dict = {}
+    errors: dict = {}
+
+    def drive(name, party):
+        try:
+            results[name] = party.run()
+        except BaseException as e:  # SimulatedCrash is a BaseException
+            errors[name] = e
+
+    threads = {name: threading.Thread(target=drive, args=(name, p),
+                                      name=f"party-{name}")
+               for name, p in parties.items()}
+    for t in threads.values():
+        t.start()
+    threads["p0"].join()
+    chaos.install(None)
+    crashed = isinstance(errors.get("p0"), chaos.SimulatedCrash)
+    # restart: fresh party objects on the surviving queue pairs, same
+    # journals, ledgers reloaded from their files — the exact manual
+    # form of "rerun the identical command"
+    fresh = make_federation_parties(plan, data, ledgers=ledgers(),
+                                    endpoints=endpoints,
+                                    journal_dir=workdir)
+    rerun = threading.Thread(
+        target=drive, args=("p0", fresh["p0"]), name="party-p0")
+    rerun.start()
+    rerun.join()
+    for name in ("p1", "p2"):
+        threads[name].join()
+    resumed_ok = crashed and "p0" in results and not (
+        set(errors) - {"p0"})
+    eps_once = True
+    final = ledgers()
+    for name, _ in plan.parties:
+        if abs(final[name].spent(name) - plan.party_eps()[name]) > 1e-9:
+            eps_once = False
+    return {"crashed_at": "federation.pre_release",
+            "victim": "p0", "crash_fired": crashed,
+            "resumed": resumed_ok, "eps_exactly_once": eps_once,
+            "cells": _merged_cells(results) if resumed_ok else None}
+
+
+def _matrix_family(family: str, args) -> dict:
+    """One family's federation arms: timed in-process matrices
+    (cells/s), one TCP matrix (transport equivalence), the
+    k·(k−1)/2-independent-sessions equivalence, the ledger's ε against
+    the release-reuse optimum vs the naive per-cell baseline, and the
+    kill/resume verdict."""
+    import numpy as np
+
+    from dpcorr.__main__ import _federation_columns
+    from dpcorr.protocol import run_inproc
+    from dpcorr.protocol.federation import (
+        run_federation_inproc,
+        run_federation_tcp,
+    )
+    from dpcorr.protocol.matrix import FederationPlan
+    from dpcorr.serve.ledger import PrivacyLedger
+
+    plan = FederationPlan(family=family, n=args.n, eps=args.eps1,
+                          parties=MATRIX_PARTIES, seed=args.seed)
+    data = _federation_columns(plan, 0.6)
+    lat, cells_ref = [], None
+    for _ in range(args.sessions):
+        t0 = time.perf_counter()
+        res = run_federation_inproc(plan, data)
+        lat.append(time.perf_counter() - t0)
+        cells = _merged_cells(res)
+        if cells_ref is None:
+            cells_ref = cells
+        assert cells == cells_ref, "matrix drifted across sessions"
+    wall = sum(lat)
+    n_cells = len(plan.cells())
+    t0 = time.perf_counter()
+    tcp_cells = _merged_cells(run_federation_tcp(plan, data))
+    tcp_s = time.perf_counter() - t0
+    # the acceptance contract: bit-identical to k·(k−1)/2 independent
+    # two-party sessions over the same per-column key labels
+    independent_ok = True
+    for i, j in plan.cells():
+        r = run_inproc(plan.cell_spec(i, j), data[plan.label(i)],
+                       data[plan.label(j)])["x"]
+        want = cells_ref[f"{i},{j}"]
+        if (np.float32(r.rho_hat), np.float32(r.ci_low),
+                np.float32(r.ci_high)) != (np.float32(want["rho_hat"]),
+                                           np.float32(want["ci_low"]),
+                                           np.float32(want["ci_high"])):
+            independent_ok = False
+    ledgers = {name: PrivacyLedger(1e6) for name, _ in plan.parties}
+    run_federation_inproc(plan, data, ledgers=ledgers)
+    spent = {name: ledgers[name].spent(name)
+             for name, _ in plan.parties}
+    eps_ok = (abs(sum(spent.values()) - plan.optimal_eps()) < 1e-9
+              and all(abs(spent[p] - e) < 1e-9
+                      for p, e in plan.party_eps().items())
+              and plan.optimal_eps() < plan.naive_eps())
+    with tempfile.TemporaryDirectory() as td:
+        resume = _matrix_resume_verdict(plan, data, td)
+    fam = {
+        "plan": {"fed": plan.fed, "k": plan.k, "cells": n_cells,
+                 "parties": [[p, list(c)] for p, c in plan.parties]},
+        "matrix_latency_s": _percentiles(lat),
+        "cells_per_sec": round(n_cells * args.sessions / wall, 2)
+        if wall else None,
+        "tcp_matrix_s": tcp_s,
+        "eps": {"optimal": plan.optimal_eps(),
+                "naive_per_cell": plan.naive_eps(),
+                "spent": spent,
+                "saving_vs_naive": round(
+                    1.0 - plan.optimal_eps() / plan.naive_eps(), 4)},
+        "resume": {k: v for k, v in resume.items() if k != "cells"},
+        "verdicts": {
+            "tcp_bit_identical": tcp_cells == cells_ref,
+            "matches_independent_runs": independent_ok,
+            "eps_at_optimum": eps_ok,
+            "kill_resume_exactly_once": bool(
+                resume["crash_fired"] and resume["resumed"]
+                and resume["eps_exactly_once"]
+                and resume["cells"] == cells_ref),
+        },
+    }
+    return fam
+
+
+def run_matrix(args) -> int:
+    """The ``--matrix`` arm: federation benchmarks for every family,
+    one JSON document (committed as
+    ``benchmarks/results/r12_federation_cpu.json``)."""
+    doc = {"benchmark": "federation_matrix",
+           "config": {"n": args.n, "eps": args.eps1, "seed": args.seed,
+                      "sessions": args.sessions,
+                      "parties": MATRIX_PARTIES},
+           "families": {}, "ok": True}
+    for family in FAMILIES:
+        fam = _matrix_family(family, args)
+        if not all(fam["verdicts"].values()):
+            doc["ok"] = False
+        doc["families"][family] = fam
+        print(f"{family}: cells/s={fam['cells_per_sec']} " + " ".join(
+            f"{k}={v}" for k, v in fam["verdicts"].items()),
+            file=sys.stderr)
+    print(json.dumps(doc, indent=2))
+    if args.out_json:
+        with open(args.out_json, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return 0 if doc["ok"] else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--matrix", action="store_true",
+                    help="benchmark the N-party federation matrix "
+                         "(protocol.federation) instead of the "
+                         "two-party arms: cells/s, ε at the "
+                         "release-reuse optimum vs naive per-cell, "
+                         "bit-identity to independent runs, and the "
+                         "kill/resume verdict")
     ap.add_argument("--sessions", type=int, default=8,
                     help="timed sessions per clean arm (the fault arm "
                          "runs half, floor 2)")
@@ -138,6 +335,8 @@ def main() -> int:
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.matrix:
+        return run_matrix(args)
     import jax
     import numpy as np
 

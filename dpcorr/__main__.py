@@ -846,6 +846,7 @@ def cmd_chaos(args):
 
     reports = []
     failures = []
+    fed_refs = {}  # family -> uninterrupted in-process federation oracle
     for family in families:
         for role in roles:
             for point in points:
@@ -853,11 +854,19 @@ def cmd_chaos(args):
                 case_dir = os.path.join(workdir,
                                         case.replace(".", "_"))
                 os.makedirs(case_dir, exist_ok=True)
-                errs = _run_chaos_case(
-                    args, family, role, point, case_dir, refs[family],
-                    spec_for(family), party_argv, launch, parse_result,
-                    ledger_balance, scan_transcript, read_events,
-                    chaos.EXIT_CODE)
+                if point.startswith("federation."):
+                    # federation crash windows never fire in a two-party
+                    # session: the case is a 3-party matrix over TCP,
+                    # with the victim role mapped onto a party
+                    errs = _run_federation_chaos_case(
+                        args, family, role, point, case_dir, launch,
+                        parse_result, fed_refs)
+                else:
+                    errs = _run_chaos_case(
+                        args, family, role, point, case_dir,
+                        refs[family], spec_for(family), party_argv,
+                        launch, parse_result, ledger_balance,
+                        scan_transcript, read_events, chaos.EXIT_CODE)
                 reports.append({"case": case, "ok": not errs,
                                 "errors": errs, "dir": case_dir})
                 failures.extend(f"{case}: {e}" for e in errs)
@@ -981,6 +990,408 @@ def _run_chaos_case(args, family, role, point, case_dir, ref, spec,
             errs.append(
                 f"role {r} obs budget replay disagreed with the "
                 f"directory: {chk.stdout.strip()[-400:]}")
+    return errs
+
+
+def _federation_plan(args):
+    """Build the public :class:`FederationPlan` a subcommand runs
+    under — from a ``--plan`` JSON file (the byte-identical document
+    every party process of one federation must share) or inline
+    ``--party`` flags (order is the public plan order)."""
+    from dpcorr.protocol.matrix import FederationPlan
+
+    if args.plan:
+        with open(args.plan, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        return FederationPlan.from_public(doc.get("plan", doc))
+    if not args.party:
+        raise SystemExit("pass --party NAME=LAB1[,LAB2...] (repeatable; "
+                         "order is the plan order) or --plan FILE")
+    parties = []
+    for spec in args.party:
+        name, sep, labs = spec.partition("=")
+        labels = [s for s in labs.split(",") if s]
+        if not sep or not name or not labels:
+            raise SystemExit(f"--party {spec!r}: expected "
+                             "NAME=LAB1[,LAB2...]")
+        parties.append((name, labels))
+    return FederationPlan(family=args.family, n=args.n, eps=args.eps,
+                          parties=parties, alpha=args.alpha,
+                          normalise=args.normalise == "on",
+                          seed=args.seed, noise_mode=args.noise_mode,
+                          max_cells_per_round=args.max_cells_per_round)
+
+
+def _federation_columns(plan, rho: float) -> dict:
+    """Synthetic equicorrelated columns for all k labels, derived from
+    the public plan seed — the federation analogue of _party_columns:
+    every party process re-derives the identical draw and keeps only
+    its own labels (numpy Generator, disjoint from the jax key tree)."""
+    import numpy as np
+
+    k = plan.k
+    if not -1.0 / max(k - 1, 1) < rho < 1.0:
+        raise SystemExit(f"--rho {rho} is not a valid equicorrelation "
+                         f"for k={k} (need -1/(k-1) < rho < 1)")
+    cov = np.full((k, k), float(rho))
+    np.fill_diagonal(cov, 1.0)
+    xy = np.random.default_rng(plan.seed).multivariate_normal(
+        np.zeros(k), cov, size=plan.n)
+    return {label: np.asarray(xy[:, idx], np.float32)
+            for idx, (_owner, label) in enumerate(plan.columns())}
+
+
+def cmd_federation_plan(args):
+    """Compile and print the federation schedule — cells, links,
+    rounds, artifact charge venues and the ε arithmetic (optimal vs
+    naive per-cell). Pure plan arithmetic, jax-free."""
+    print(json.dumps(_federation_plan(args).describe(), indent=2))
+
+
+def cmd_federation_run(args):
+    """Whole federation in one process: every party on a thread over
+    inproc or loopback-TCP wires — the smoke/repro path for the
+    federation section of docs/PROTOCOL.md."""
+    from dpcorr.protocol import ProtocolError
+    from dpcorr.protocol.federation import (
+        run_federation_inproc,
+        run_federation_tcp,
+    )
+
+    plan = _federation_plan(args)
+    data = _federation_columns(plan, args.rho)
+    fault = None
+    if args.fault_drop or args.fault_delay_ms or args.fault_duplicate:
+        fault = {"drop": args.fault_drop,
+                 "delay_s": args.fault_delay_ms / 1000.0,
+                 "duplicate": args.fault_duplicate}
+    if args.fault_seed is not None:
+        fault = dict(fault or {})
+        fault["seed"] = args.fault_seed
+    run = (run_federation_tcp if args.transport == "tcp"
+           else run_federation_inproc)
+    try:
+        results = run(plan, data, fault=fault,
+                      transcript_dir=args.transcript_dir,
+                      timeout_s=args.timeout,
+                      max_retries=args.max_retries, engine=args.engine)
+    except ProtocolError as e:
+        raise SystemExit(f"federation aborted: {e}") from e
+    # every cell two parties both see must agree bitwise — the wire
+    # result IS the finisher's result, so disagreement means corruption
+    cells: dict = {}
+    agree = True
+    for _name, res in sorted(results.items()):
+        for key, val in res.cells.items():
+            if key in cells and cells[key] != val:
+                agree = False
+            cells.setdefault(key, val)
+    out = {"fed": plan.fed, "fed_hash": plan.fed_hash(),
+           "plan": plan.to_public(),
+           "cells": {key: cells[key] for key in sorted(cells)},
+           "eps": {"optimal": plan.optimal_eps(),
+                   "naive_per_cell": plan.naive_eps(),
+                   "per_party": plan.party_eps()},
+           "parties": {name: {"cells": res.cells, "eps": res.eps,
+                              "stats": res.stats}
+                       for name, res in sorted(results.items())},
+           "parties_agree": agree}
+    print(json.dumps(out, indent=2))
+    if not agree:
+        raise SystemExit("parties diverged on a shared cell")
+
+
+def cmd_federation_party(args):
+    """One real party process of a multi-process federation over TCP
+    (docs/PROTOCOL.md): topology is plan-derived — for each pair link
+    the lower party dials (``--peer NAME=HOST:PORT``) and the higher
+    listens (``--listen``, the bound port announced in the banner).
+    With ``--journal-dir`` every link is crash-safe exactly like
+    ``dpcorr party --journal``: rerun the identical command after a
+    crash and the matrix resumes instead of restarting."""
+    from dpcorr import chaos
+    from dpcorr.obs import trace as obs_trace
+    from dpcorr.obs.audit import AuditTrail
+    from dpcorr.protocol.federation import serve_federation_party
+    from dpcorr.serve.ledger import PrivacyLedger
+
+    plan = chaos.plan_from_spec(args.chaos) if args.chaos \
+        else chaos.plan_from_env()
+    if plan is not None:
+        chaos.install(plan)
+    if args.trace:
+        obs_trace.configure(args.trace)
+    fed = _federation_plan(args)
+    name = args.name
+    my_idx = fed.party_index(name)
+    columns = {lab: col for lab, col
+               in _federation_columns(fed, args.rho).items()
+               if lab in fed.party_labels(name)}
+    listen = None
+    if args.listen:
+        host, sep, port = args.listen.rpartition(":")
+        if not sep:
+            raise SystemExit(f"--listen {args.listen!r}: expected "
+                             "HOST:PORT")
+        listen = (host, int(port))
+    peers = {}
+    for spec in args.peer or []:
+        peer, sep, addr = spec.partition("=")
+        host, sep2, port = addr.rpartition(":")
+        if not sep or not sep2:
+            raise SystemExit(f"--peer {spec!r}: expected "
+                             "NAME=HOST:PORT")
+        peers[peer] = (host, int(port))
+    accepts = any(fed.party_index(q if p == name else p) < my_idx
+                  for p, q in fed.party_links(name))
+
+    def on_listening(host, port):
+        print(json.dumps({"party": {"federation": fed.fed,
+                                    "name": name,
+                                    "listening": [host, port]}}),
+              flush=True)
+
+    if not accepts:
+        # pure dialers still print a banner: drivers parse every
+        # party's stdout uniformly (banner lines, then the result)
+        print(json.dumps({"party": {"federation": fed.fed,
+                                    "name": name,
+                                    "dialing": sorted(peers)}}),
+              flush=True)
+    audit = AuditTrail(args.audit) if args.audit else None
+    ledger = PrivacyLedger(args.budget, path=args.ledger, audit=audit)
+    res = serve_federation_party(
+        name, fed, columns, ledger=ledger, listen=listen, peers=peers,
+        transcript_dir=args.transcript_dir,
+        journal_dir=args.journal_dir, timeout_s=args.timeout,
+        max_retries=args.max_retries,
+        connect_timeout_s=args.connect_timeout,
+        recv_timeout_s=args.recv_timeout, engine=args.engine,
+        on_listening=on_listening)
+    print(json.dumps({"result": {"party": res.party, "fed": res.fed,
+                                 "cells": res.cells, "eps": res.eps,
+                                 "stats": res.stats}}, indent=2))
+
+
+def cmd_federation_scan(args):
+    """Offline federation audit, jax-free: per-transcript schema scan,
+    the cross-pair correlation-leak gate (a reused column release must
+    be byte-identical in every pair session; exit 1 names the offending
+    pair), and — with ``--audit NAME=PATH`` — each party's whole-matrix
+    ε balance against its plan-derived local spend."""
+    import glob as globmod
+
+    from dpcorr.obs import read_events
+    from dpcorr.protocol.scan import (
+        federation_balance,
+        scan_federation,
+        scan_transcript,
+    )
+
+    transcripts = list(args.transcript or [])
+    if args.transcript_dir:
+        for path in sorted(globmod.glob(
+                os.path.join(args.transcript_dir, "*.jsonl"))):
+            base = os.path.basename(path)
+            if not base.startswith(("audit.", "trace.")):
+                transcripts.append(path)
+    if not transcripts:
+        raise SystemExit("pass --transcript (repeatable) or "
+                         "--transcript-dir")
+    plan = None
+    if args.plan:
+        from dpcorr.protocol.matrix import FederationPlan
+
+        with open(args.plan, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        plan = FederationPlan.from_public(doc.get("plan", doc))
+    per = {t: scan_transcript(t) for t in transcripts}
+    cross = scan_federation(transcripts)
+    ok = all(r["ok"] for r in per.values()) and cross["ok"]
+    out = {"transcripts": per, "cross_pair": cross}
+    balances = {}
+    for spec in args.audit or []:
+        pname, sep, path = spec.partition("=")
+        if not sep:
+            raise SystemExit(f"--audit {spec!r}: expected NAME=PATH")
+        mine = [t for t in transcripts
+                if os.path.basename(t).split(".")[-2] == pname]
+        expected = (sum(plan.local_charges(pname)["charges"].values())
+                    if plan is not None else 0.0)
+        bal = federation_balance(mine, read_events(path),
+                                 expected_local_eps=expected)
+        balances[pname] = bal
+        ok = ok and bal["ok"]
+    if balances:
+        out["balance"] = balances
+    print(json.dumps(out, indent=2))
+    if not ok:
+        sys.exit(1)
+
+
+#: Federation chaos cases map the sweep's victim role onto a party of
+#: the fixed 3-party case topology (p0:[a,b] p1:[c] p2:[d]) — chosen so
+#: each point actually fires in the victim: pre_release fires in link
+#: initiators (p0 initiates both its links, p1 initiates p1-p2),
+#: pre_finish in finishers (p1 finishes p0-p1, p2 finishes both its
+#: links), mid_matrix in any party joining link threads.
+_FED_VICTIMS = {
+    "federation.pre_release": {"x": "p0", "y": "p1"},
+    "federation.pre_finish": {"x": "p1", "y": "p2"},
+    "federation.mid_matrix": {"x": "p0", "y": "p1"},
+}
+
+
+def _run_federation_chaos_case(args, family, role, point, case_dir,
+                               launch, parse_result,
+                               fed_refs) -> list[str]:
+    """One federation chaos case: three real party processes over TCP
+    computing the 4×4 matrix, kill the mapped victim at the named
+    federation point (exit 42), restart it with the identical command
+    line, and assert the finished matrix is bit-identical to an
+    uninterrupted in-process reference with every party's ε spent
+    exactly once at the release-reuse optimum."""
+    import subprocess
+
+    from dpcorr import chaos
+    from dpcorr.obs import read_events
+    from dpcorr.protocol.federation import run_federation_inproc
+    from dpcorr.protocol.matrix import FederationPlan
+    from dpcorr.protocol.scan import (
+        federation_balance,
+        scan_federation,
+        scan_transcript,
+    )
+
+    plan = FederationPlan(
+        family=family, n=args.n, eps=args.eps1,
+        parties=[("p0", ["a", "b"]), ("p1", ["c"]), ("p2", ["d"])],
+        alpha=args.alpha, normalise=args.normalise == "on",
+        seed=args.seed, noise_mode=args.noise_mode)
+    victim_name = _FED_VICTIMS[point][role]
+    if family not in fed_refs:
+        fed_refs[family] = run_federation_inproc(
+            plan, _federation_columns(plan, args.rho))
+    ref = fed_refs[family]
+    plan_path = os.path.join(case_dir, "plan.json")
+    with open(plan_path, "w", encoding="utf-8") as fh:
+        json.dump(plan.to_public(), fh)
+
+    def argv(name: str, listen_port, peers: dict) -> list[str]:
+        cmd = [sys.executable, "-m", "dpcorr", "federation", "party",
+               "--name", name, "--plan", plan_path,
+               "--rho", str(args.rho), "--budget", "100",
+               "--timeout", str(args.timeout),
+               "--max-retries", str(max(args.max_retries, 40)),
+               "--connect-timeout", str(args.case_timeout),
+               "--recv-timeout", str(args.case_timeout),
+               "--ledger", os.path.join(case_dir, f"ledger.{name}.json"),
+               "--audit", os.path.join(case_dir, f"audit.{name}.jsonl"),
+               "--transcript-dir", case_dir,
+               "--journal-dir", case_dir]
+        if listen_port is not None:
+            cmd += ["--listen", f"127.0.0.1:{listen_port}"]
+        for peer, port in sorted(peers.items()):
+            cmd += ["--peer", f"{peer}=127.0.0.1:{port}"]
+        return cmd
+
+    chaos_spec = f"point={point},hit=1,mode=exit"
+    timeout = args.case_timeout
+    procs: dict = {}
+    ports: dict = {}
+
+    def spawn(name, listen_port, peers):
+        extra = ["--chaos", chaos_spec] if name == victim_name else []
+        procs[name] = launch(argv(name, listen_port, peers) + extra,
+                             case_dir, name)
+
+    def peers_of(name) -> dict:
+        # plan topology: the lower party of each link dials the higher
+        dials = {"p2": (), "p1": ("p2",), "p0": ("p1", "p2")}[name]
+        return {peer: ports[peer] for peer in dials}
+
+    def read_port(name) -> int:
+        banner = json.loads(procs[name].stdout.readline())
+        return int(banner["party"]["listening"][1])
+
+    try:
+        # listeners first: p2 accepts p0+p1; p1 accepts p0, dials p2;
+        # p0 dials both (it is the lower party of both its links)
+        spawn("p2", 0, {})
+        ports["p2"] = read_port("p2")
+        spawn("p1", 0, peers_of("p1"))
+        ports["p1"] = read_port("p1")
+        spawn("p0", None, peers_of("p0"))
+        victim = procs[victim_name]
+        try:
+            rc = victim.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return [f"victim {victim_name} did not crash at {point} "
+                    f"within {timeout:.0f}s"]
+        victim.stdout.read()  # drain the dead pipe
+        if rc != chaos.EXIT_CODE:
+            return [f"victim {victim_name} exited {rc}, expected the "
+                    f"chaos kill code {chaos.EXIT_CODE}"]
+        # restart: the identical command line minus the kill plan
+        # (listeners rebind their concrete discovered port — port 0 was
+        # only for discovery; the peers' reconnecting links redial it)
+        procs[victim_name] = launch(
+            argv(victim_name, ports.get(victim_name),
+                 peers_of(victim_name)), case_dir, victim_name)
+        out, results = {}, {}
+        for name in ("p0", "p1", "p2"):
+            try:
+                rc = procs[name].wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                return [f"party {name} hung after the restart "
+                        f"(>{timeout:.0f}s)"]
+            out[name] = procs[name].stdout.read()
+            if rc != 0:
+                return [f"party {name} exited {rc} after the restart; "
+                        f"see {case_dir}/{name}.stderr.log"]
+            results[name] = parse_result(out[name])
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+    errs = []
+    all_transcripts = []
+    for name in ("p0", "p1", "p2"):
+        if results[name]["cells"] != ref[name].cells:
+            errs.append(f"party {name} matrix diverged from the "
+                        "uninterrupted in-process reference")
+        # ε spent exactly once, at the release-reuse optimum share
+        with open(os.path.join(case_dir, f"ledger.{name}.json")) as fh:
+            spent = json.load(fh)["spent"]
+        want = plan.party_eps()[name]
+        if abs(spent.get(name, 0.0) - want) > 1e-9:
+            errs.append(f"party {name} spent {spent.get(name, 0.0)!r}, "
+                        f"expected exactly-once charges totalling "
+                        f"{want!r}")
+        tscripts = [
+            os.path.join(case_dir,
+                         f"{plan.link_session(p, q)}.{name}.jsonl")
+            for p, q in plan.party_links(name)]
+        all_transcripts.extend(tscripts)
+        for t in tscripts:
+            rep = scan_transcript(t)
+            if not rep["ok"]:
+                errs.append(f"party {name} transcript scan: "
+                            f"{rep['violations']}")
+        bal = federation_balance(
+            tscripts,
+            read_events(os.path.join(case_dir, f"audit.{name}.jsonl")),
+            expected_local_eps=sum(
+                plan.local_charges(name)["charges"].values()))
+        if not bal["ok"]:
+            errs.append(f"party {name} ledger balance: "
+                        f"sends {bal['unmatched_sends']} "
+                        f"charges {bal['unmatched_charges']} "
+                        f"local {bal['local_eps']!r}")
+    cross = scan_federation(all_transcripts)
+    if not cross["ok"]:
+        errs.append(f"cross-pair federation scan: {cross['violations']}")
     return errs
 
 
@@ -1438,6 +1849,169 @@ def main(argv=None):
                           "(seconds)")
     _add_spec_flags(pc_)
     pc_.set_defaults(fn=cmd_chaos)
+
+    pf_ = sub.add_parser("federation", help="N-party federation: the "
+                         "full k×k DP correlation matrix over "
+                         "multiplexed pair sessions, at the "
+                         "column-release-reuse ε optimum "
+                         "(docs/PROTOCOL.md)")
+    pf_sub = pf_.add_subparsers(dest="federation_cmd", required=True)
+
+    def _add_fed_flags(p):
+        p.add_argument("--plan", default=None,
+                       help="federation plan JSON file (the document "
+                            "`dpcorr federation plan` prints, or its "
+                            "inner public dict); overrides the inline "
+                            "--party/spec flags — every party process "
+                            "of one federation must hold the identical "
+                            "plan (the link handshake pins its hash)")
+        p.add_argument("--party", action="append", default=None,
+                       metavar="NAME=LAB1[,LAB2...]",
+                       help="one party and its column labels "
+                            "(repeatable; order is the public plan "
+                            "order, which decides roles and topology)")
+        p.add_argument("--family", default="ni_sign",
+                       choices=["ni_sign", "int_sign", "ni_subg",
+                                "int_subg"])
+        p.add_argument("--n", type=int, default=4000)
+        p.add_argument("--eps", type=float, default=1.0,
+                       help="the federation's shared per-column ε")
+        p.add_argument("--alpha", type=float, default=0.05)
+        p.add_argument("--normalise", default="on", choices=["on", "off"])
+        p.add_argument("--seed", type=int, default=2025)
+        p.add_argument("--noise-mode", dest="noise_mode",
+                       default="replay", choices=["replay", "hardened"])
+        p.add_argument("--max-cells-per-round",
+                       dest="max_cells_per_round", type=int, default=0,
+                       help="chunk a link's cells into rounds of this "
+                            "size (0: all of a link's cells in one "
+                            "batched round)")
+
+    def _add_fed_run_flags(p):
+        p.add_argument("--rho", type=float, default=0.6,
+                       help="synthetic-data equicorrelation across the "
+                            "k columns")
+        p.add_argument("--engine", default="exact",
+                       choices=["exact", "vector"],
+                       help="batched finish engine "
+                            "(split_reference.finish_batch): 'exact' is "
+                            "the bit-identity contract, 'vector' the "
+                            "vmapped opt-in")
+        p.add_argument("--timeout", type=float, default=10.0,
+                       help="per-message ack timeout (seconds)")
+        p.add_argument("--max-retries", dest="max_retries", type=int,
+                       default=10)
+        p.add_argument("--platform", default=None,
+                       choices=["cpu", "tpu"])
+
+    pfp = pf_sub.add_parser("plan", help="compile and print the "
+                            "schedule: cells, links, rounds, artifact "
+                            "charge venues and the ε arithmetic "
+                            "(optimal vs naive per-cell); jax-free")
+    _add_fed_flags(pfp)
+    pfp.set_defaults(fn=cmd_federation_plan, platform=None,
+                     jax_free=True)
+
+    pfr = pf_sub.add_parser("run", help="whole federation in one "
+                            "process (every party on a thread) over "
+                            "inproc or loopback-TCP transport")
+    _add_fed_flags(pfr)
+    _add_fed_run_flags(pfr)
+    pfr.add_argument("--transport", default="inproc",
+                     choices=["inproc", "tcp"])
+    pfr.add_argument("--transcript-dir", dest="transcript_dir",
+                     default=None,
+                     help="write every pair link's per-party wire "
+                          "transcript JSONL into this directory "
+                          "(audit with `dpcorr federation scan`)")
+    pfr.add_argument("--fault-drop", dest="fault_drop", type=float,
+                     default=0.0, help="fault injection: drop rate")
+    pfr.add_argument("--fault-delay-ms", dest="fault_delay_ms",
+                     type=float, default=0.0,
+                     help="fault injection: per-frame delay")
+    pfr.add_argument("--fault-duplicate", dest="fault_duplicate",
+                     type=float, default=0.0,
+                     help="fault injection: duplicate rate")
+    pfr.add_argument("--fault-seed", dest="fault_seed", type=int,
+                     default=None,
+                     help="base seed for every endpoint's fault "
+                          "injector (per-link-side offsets keep the "
+                          "streams distinct but reproducible)")
+    pfr.set_defaults(fn=cmd_federation_run)
+
+    pft = pf_sub.add_parser("party", help="one real party process of a "
+                            "multi-process federation over TCP: dials "
+                            "lower links via --peer, listens for "
+                            "higher ones via --listen; with "
+                            "--journal-dir the whole matrix is "
+                            "crash-safe — rerun the identical command "
+                            "after a crash and it resumes")
+    _add_fed_flags(pft)
+    _add_fed_run_flags(pft)
+    pft.add_argument("--name", required=True,
+                     help="this process's party name in the plan")
+    pft.add_argument("--listen", default=None, metavar="HOST:PORT",
+                     help="bind here for peers that dial this party "
+                          "(port 0: ephemeral, announced in the "
+                          "banner); required iff a lower-indexed peer "
+                          "shares a link")
+    pft.add_argument("--peer", action="append", default=None,
+                     metavar="NAME=HOST:PORT",
+                     help="where to dial a higher-indexed link peer "
+                          "(repeatable)")
+    pft.add_argument("--budget", type=float, default=100.0,
+                     help="this party's ε budget (basic composition)")
+    pft.add_argument("--ledger", default=None,
+                     help="ledger persistence path (JSON), same "
+                          "format as serve --ledger")
+    pft.add_argument("--audit", default=None,
+                     help="budget audit-trail JSONL path (obs.audit)")
+    pft.add_argument("--trace", default=None,
+                     help="span-trace JSONL path")
+    pft.add_argument("--transcript-dir", dest="transcript_dir",
+                     default=None,
+                     help="per-link wire transcript directory")
+    pft.add_argument("--journal-dir", dest="journal_dir", default=None,
+                     help="per-link session journal directory: makes "
+                          "every pair session crash-safe "
+                          "(docs/ROBUSTNESS.md)")
+    pft.add_argument("--chaos", default=None,
+                     help="crash plan 'point=NAME[,hit=K][,mode=exit|"
+                          "raise]' or 'seed=N' (dpcorr.chaos); "
+                          "default: $DPCORR_CHAOS")
+    pft.add_argument("--connect-timeout", dest="connect_timeout",
+                     type=float, default=30.0,
+                     help="seconds to keep dialing / await each peer")
+    pft.add_argument("--recv-timeout", dest="recv_timeout", type=float,
+                     default=30.0,
+                     help="seconds to wait for a peer's next protocol "
+                          "message (raise it when peers may restart "
+                          "mid-matrix)")
+    pft.set_defaults(fn=cmd_federation_party)
+
+    pfs = pf_sub.add_parser("scan", help="audit a federation's pair "
+                            "transcripts: per-transcript schema + "
+                            "no-raw-columns, the cross-pair "
+                            "correlation-leak gate (reused releases "
+                            "must be byte-identical; exit 1 names the "
+                            "offending pair), and per-party ε balance")
+    pfs.add_argument("--transcript", action="append", default=None,
+                     help="pair-link transcript JSONL (repeatable)")
+    pfs.add_argument("--transcript-dir", dest="transcript_dir",
+                     default=None,
+                     help="scan every *.jsonl in this directory "
+                          "(audit./trace. prefixes skipped)")
+    pfs.add_argument("--audit", action="append", default=None,
+                     metavar="NAME=PATH",
+                     help="party NAME's audit-trail JSONL: enables "
+                          "that party's whole-matrix ε balance check "
+                          "(repeatable)")
+    pfs.add_argument("--plan", default=None,
+                     help="the federation plan JSON: lets the balance "
+                          "check derive each party's expected "
+                          "local-cell ε (default: 0)")
+    pfs.set_defaults(fn=cmd_federation_scan, platform=None,
+                     jax_free=True)
 
     backends_by_cmd = {
         "grid": ("local", "sharded", "bucketed", "bucketed-sharded"),

@@ -66,6 +66,10 @@ KNOWN_POINTS = (
     "budget.post_journal",    # WAL line fsynced, not applied in memory
     "budget.mid_compaction",  # snapshot gen+1 renamed, WAL still gen
     "budget.mid_eviction",    # cold spill appended, user still resident
+    # federation matrix sessions (protocol/federation.py)
+    "federation.pre_release",  # column artifacts built, round not charged
+    "federation.mid_matrix",   # some pair links finished, others pending
+    "federation.pre_finish",   # round validated, finish kernel not run
 )
 
 #: The step-kill matrix `dpcorr chaos` sweeps: the points every protocol
@@ -87,6 +91,12 @@ MATRIX_POINTS = (
     "budget.post_journal",
     "budget.mid_compaction",
     "budget.mid_eviction",
+    # federation points: two-party sessions never traverse these; the
+    # chaos CLI routes them to a 3-party matrix case instead (and the
+    # two-party crash-resume matrix test filters them out)
+    "federation.pre_release",
+    "federation.mid_matrix",
+    "federation.pre_finish",
 )
 
 _MODES = ("exit", "raise")

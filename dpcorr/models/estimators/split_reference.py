@@ -333,6 +333,61 @@ def finish(family: str, key: jax.Array, peer_release: dict, col: jax.Array,
     return fn(key, rel, jnp.asarray(col, jnp.float32))
 
 
+@functools.lru_cache(maxsize=None)
+def _finish_batch_jit(family: str, eps1: float, eps2: float, alpha: float,
+                      normalise: bool, engine: str):
+    """Compiled batched finisher per design point. ``"exact"`` rolls
+    ``jax.lax.map`` over the single-cell finisher — the serve batch
+    engines' bit-reproducibility contract (serve.kernels: lax.map of
+    the jitted single program is bit-identical to per-item calls for
+    every family, measured in PR 1 and pinned again by
+    tests/test_federation.py). ``"vector"`` is ``vmap`` — faster, but
+    only ρ-exact/CI≤1ulp, so it is opt-in and never used where the
+    federation's bit-identity acceptance applies."""
+    single = functools.partial(_finish_impl, family, eps1=eps1, eps2=eps2,
+                               alpha=alpha, normalise=normalise)
+    if engine == "vector":
+        return jax.jit(jax.vmap(single))
+    if engine != "exact":
+        raise ValueError(f"unknown finish engine {engine!r}; "
+                         "expected 'exact' or 'vector'")
+    return jax.jit(lambda keys, rels, cols: jax.lax.map(
+        lambda args: single(*args), (keys, rels, cols)))
+
+
+def finish_batch(family: str, keys, peer_releases, cols,
+                 eps1: float, eps2: float, alpha: float = 0.05,
+                 normalise: bool = True, engine: str = "exact",
+                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One finish kernel over a whole federation round: B cells of the
+    same design point, each with its own finisher key, peer release and
+    finisher column. ``keys`` is a sequence of per-cell finisher roots,
+    ``peer_releases`` a sequence of decoded release payloads (validated
+    like :func:`finish`), ``cols`` a sequence of finisher columns.
+    Returns (ρ̂, ci_low, ci_high) arrays of shape (B,).
+
+    This is what makes session multiplexing pay: a pair link's round
+    lands as one envelope and finishes as one compiled program instead
+    of B dispatches — while the ``"exact"`` engine keeps every cell
+    bit-identical to the independent two-party run it replaces."""
+    name = next(iter(RELEASE_KINDS[family]))
+    rels = []
+    for rel in peer_releases:
+        if set(rel) != {name}:
+            raise ValueError(
+                f"{family}: expected release payload {{{name!r}}}, "
+                f"got {sorted(rel)}")
+        rels.append(jnp.asarray(rel[name], jnp.float32))
+    if not (len(keys) == len(rels) == len(cols)):
+        raise ValueError(
+            f"batch length mismatch: {len(keys)} keys, {len(rels)} "
+            f"releases, {len(cols)} columns")
+    fn = _finish_batch_jit(family, float(eps1), float(eps2), float(alpha),
+                           bool(normalise), engine)
+    return fn(jnp.stack(list(keys)), jnp.stack(rels),
+              jnp.stack([jnp.asarray(c, jnp.float32) for c in cols]))
+
+
 def split_estimate(family: str, key_x: jax.Array, key_y: jax.Array,
                    x: jax.Array, y: jax.Array, eps1: float, eps2: float,
                    alpha: float = 0.05, normalise: bool = True,

@@ -55,6 +55,7 @@ from dpcorr.obs.cost import (  # noqa: F401
     CostRecord,
     CostRegistry,
     ExemplarStore,
+    split_exact,
 )
 from dpcorr.obs.fleet import (  # noqa: F401
     FleetCollector,

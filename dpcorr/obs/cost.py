@@ -41,6 +41,27 @@ from dpcorr.obs.metrics import LATENCY_BUCKETS
 _local_ids = itertools.count()
 
 
+def split_exact(total, n: int) -> list:
+    """Divide a batched launch's ``total`` (seconds or bytes) across its
+    ``n`` riders so the parts sum back to *exactly* the total — the
+    add_kernel contract ("divided evenly … so the records of a batch
+    sum to the launch's cost") made arithmetic-safe. Integer totals
+    split largest-remainder (the first ``total % n`` riders carry one
+    extra unit); float totals give every rider the even share and put
+    the rounding residual on the last one, so an auditor summing
+    per-cell attributions reconciles against the round total without a
+    tolerance."""
+    if n <= 0:
+        raise ValueError(f"cannot split across {n} riders")
+    if isinstance(total, int):
+        base, extra = divmod(total, n)
+        return [base + (1 if i < extra else 0) for i in range(n)]
+    share = float(total) / n
+    parts = [share] * n
+    parts[-1] = float(total) - share * (n - 1)
+    return parts
+
+
 class CostRecord:
     """One request's accumulating cost. Mutated from the admission
     (client) thread and the flush thread, so every update takes the

@@ -40,9 +40,18 @@ docs/PROTOCOL.md for roles, the message table and failure semantics.
 # stay importable where jax isn't installed — the auditor runs where the
 # estimators can't. An eager star-import here would weld them together.
 _EXPORTS = {
+    "FederationParty": "federation",
+    "FederationResult": "federation",
+    "LinkBroker": "federation",
+    "dial_link": "federation",
+    "make_federation_parties": "federation",
+    "run_federation_inproc": "federation",
+    "run_federation_tcp": "federation",
+    "serve_federation_party": "federation",
     "ReleaseGate": "gate",
     "JournalError": "journal",
     "SessionJournal": "journal",
+    "FederationPlan": "matrix",
     "PROTOCOL_VERSION": "messages",
     "Message": "messages",
     "Transcript": "messages",
@@ -58,7 +67,9 @@ _EXPORTS = {
     "ProtocolSpec": "party",
     "run_inproc": "runner",
     "run_tcp": "runner",
+    "federation_balance": "scan",
     "ledger_balance": "scan",
+    "scan_federation": "scan",
     "scan_transcript": "scan",
     "FaultInjector": "transport",
     "InProcTransport": "transport",

@@ -83,6 +83,20 @@ class ReleaseGate:
         receipt["eps"] = float(sum(charges.values()))
         return receipt
 
+    def charge_local(self, charges: Mapping[str, float],
+                     trace_id: str | None = None,
+                     charge_id: str | None = None) -> float:
+        """Charge for releases that never cross a wire: a federation
+        party's *local* cells (both columns its own) still run the DP
+        split estimator, so the ε is real spend even though there is no
+        send to gate. The idempotent ``charge_id`` carries the
+        exactly-once contract across crash/resume — a resumed matrix
+        re-runs its local cells bit-identically but the ledger spends
+        the id once. Returns the total ε charged."""
+        self.ledger.charge(charges, trace_id=trace_id,
+                           charge_id=charge_id)
+        return float(sum(charges.values()))
+
     def charge_replayed(self, charges: Mapping[str, float],
                         trace_id: str | None = None,
                         charge_id: str | None = None) -> None:

@@ -103,7 +103,7 @@ class Message:
     ``payload`` is type-specific (see docs/PROTOCOL.md)."""
 
     msg_type: str
-    sender: str                      # role, "x" | "y"
+    sender: str                      # role ("x"|"y") or federation party
     session: str                     # spec-derived session id
     payload: dict = field(default_factory=dict)
     headers: dict = field(default_factory=dict)
@@ -113,8 +113,10 @@ class Message:
         if self.msg_type not in MSG_TYPES:
             raise ValueError(f"unknown msg_type {self.msg_type!r}; "
                              f"expected one of {MSG_TYPES}")
-        if self.sender not in ("x", "y"):
-            raise ValueError(f"sender must be 'x' or 'y', "
+        # two-party sessions use the role letters; federation pair-links
+        # (protocol.federation) send under the party's own name
+        if not isinstance(self.sender, str) or not self.sender:
+            raise ValueError(f"sender must be a non-empty string, "
                              f"got {self.sender!r}")
 
     def to_wire(self) -> dict:

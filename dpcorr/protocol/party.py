@@ -90,6 +90,14 @@ class ProtocolSpec:
     party_x: str = "party-x"
     party_y: str = "party-y"
     session: str = ""
+    # Optional per-role column key labels (federation): when set, the
+    # role roots its noise in utils.rng.column_root(master, label)
+    # instead of the bare master key, so different columns of a k×k
+    # matrix draw independent noise and a column's release is the same
+    # bytes in every pair that reuses it. Empty (the default) keeps the
+    # original two-party key layout — and the original spec hash.
+    key_x: str = ""
+    key_y: str = ""
 
     def __post_init__(self):
         if self.session == "":
@@ -97,12 +105,18 @@ class ProtocolSpec:
                                f"sess-{self.spec_hash()[:12]}")
 
     def to_public(self) -> dict:
-        return {"family": self.family, "n": int(self.n),
-                "eps1": float(self.eps1), "eps2": float(self.eps2),
-                "alpha": float(self.alpha),
-                "normalise": bool(self.normalise),
-                "seed": int(self.seed), "noise_mode": self.noise_mode,
-                "party_x": self.party_x, "party_y": self.party_y}
+        pub = {"family": self.family, "n": int(self.n),
+               "eps1": float(self.eps1), "eps2": float(self.eps2),
+               "alpha": float(self.alpha),
+               "normalise": bool(self.normalise),
+               "seed": int(self.seed), "noise_mode": self.noise_mode,
+               "party_x": self.party_x, "party_y": self.party_y}
+        if self.key_x or self.key_y:
+            # only present when used: pre-federation specs keep their
+            # exact hash (and transcript bytes) across this change
+            pub["key_x"] = self.key_x
+            pub["key_y"] = self.key_y
+        return pub
 
     def spec_hash(self) -> str:
         return hashlib.sha256(canonical_encode(self.to_public())).hexdigest()
@@ -146,41 +160,32 @@ def _result_floats(rho, lo, hi) -> dict:
             "ci_high": float(hi)}
 
 
-class Party:
-    """One role ("x" or "y") of one protocol session.
+class SessionEndpoint:
+    """One endpoint of one journaled, gated protocol session — the
+    plumbing shared by the two-party :class:`Party` and the federation
+    pair links (protocol.federation), factored out of ``Party``
+    verbatim. Everything session-shaped lives here: transcript
+    recording, the journal slot ↔ wire seq discipline, gated and plain
+    sends, journal replay on receive, the resume re-attach handshake
+    and its peer-gone fallback, and the terminal linger.
 
-    ``column`` is this party's raw column — it never leaves this object
-    except through ``split_reference.party_release``/``finish`` (DP
-    releases) and is never serialized. ``ledger`` is wrapped in the
-    release gate immediately; the party itself keeps no direct
-    reference.
-
-    With ``journal`` (a :class:`SessionJournal`), the session is
-    crash-safe: every outbound message is journaled before it is sent
-    (outbound slot *k* ↔ wire seq *k+1*), every inbound message is
-    journaled before it is acked, the gated charge carries a
-    deterministic ``charge_id`` so the ledger spends it once across
-    restarts, and a restarted party replays its journal — re-sending
-    journaled wire bytes verbatim under their original seqs — until it
-    rejoins the live session exactly where it died. Without a journal
-    nothing changes, down to the wire bytes (the determinism test
-    byte-compares transcripts).
+    Subclasses provide the three identity facts (``session`` id,
+    ``spec_hash`` the handshake pins, ``sender`` — the wire name this
+    endpoint signs messages with: the role letter for two-party
+    sessions, the party's own name on a federation link) and drive the
+    message flow; this class guarantees that however they drive it, ε
+    is charged before any release send, refunded only on provable
+    non-delivery, and spent exactly once across restarts.
     """
 
-    def __init__(self, role: str, column, spec: ProtocolSpec,
+    def __init__(self, *, session: str, spec_hash: str, sender: str,
                  channel: ReliableChannel, ledger: PrivacyLedger,
                  transcript: Transcript | None = None,
                  recv_timeout_s: float = 30.0,
                  journal: SessionJournal | None = None):
-        if role not in ("x", "y"):
-            raise ValueError(f"role must be 'x' or 'y', got {role!r}")
-        col = np.asarray(column, dtype=np.float32)
-        if col.ndim != 1 or col.shape[0] != spec.n:
-            raise ValueError(
-                f"column must be shape ({spec.n},), got {col.shape}")
-        self.role = role
-        self._column = col
-        self.spec = spec
+        self.session = session
+        self.spec_hash = spec_hash
+        self.sender = sender
         self.channel = channel
         self._gate = ReleaseGate(ledger)
         self.transcript = transcript or Transcript(None)
@@ -278,8 +283,8 @@ class Party:
         except TransportError:
             pass
 
-    def _send_gated(self, msg: Message) -> None:
-        """Charge this role's ε, then send; refund handled inside the
+    def _send_gated(self, msg: Message, charges) -> None:
+        """Charge ``charges``, then send; refund handled inside the
         gate. On refusal, signal the peer with an ungated ``error`` so
         it stops waiting, then raise :class:`ProtocolRefused`.
 
@@ -290,7 +295,6 @@ class Party:
         absorbs a pre-crash delivery), and a slot already marked acked
         skips straight to the transcript — ε spent exactly once no
         matter where in this function the process last died."""
-        charges = self.spec.charges_for(self.role)
         if self.journal is None:
             try:
                 receipt = self._gate.send_release(
@@ -303,7 +307,7 @@ class Party:
                 raise ProtocolRefused(str(e)) from e
             self._record("send", msg, receipt, eps=receipt["eps"])
             return
-        cid = f"{self.spec.session}:{self.role}:out{self._out_slot}"
+        cid = f"{self.session}:{self.sender}:out{self._out_slot}"
         entry = self._journal_outbound(msg, charges=charges, charge_id=cid)
         cid = entry["charge_id"]
         wire_msg = Message.from_wire(entry["wire"])
@@ -355,10 +359,10 @@ class Party:
             self._in_slot += 1
         msg = Message.from_wire(got["body"])
         self._record("recv", msg, {"seq": got["seq"]})
-        if msg.session != self.spec.session:
+        if msg.session != self.session:
             raise ProtocolError(
                 f"session mismatch: peer says {msg.session!r}, "
-                f"ours is {self.spec.session!r}")
+                f"ours is {self.session!r}")
         if msg.msg_type == "error":
             # terminal inbound: linger so the peer's abort send doesn't
             # fail on a chaos-dropped ack after we raise (transport.drain)
@@ -374,19 +378,106 @@ class Party:
         return msg
 
     def _msg(self, msg_type: str, payload: dict) -> Message:
-        return Message(msg_type=msg_type, sender=self.role,
-                       session=self.spec.session, payload=payload,
+        return Message(msg_type=msg_type, sender=self.sender,
+                       session=self.session, payload=payload,
                        headers=self._headers())
 
-    # ------------------------------------------------------ handshake ----
     def _register_session_info(self) -> None:
         """Tell the channel which (session, token) a peer's resume
         handshake must present — the surviving side answers resumes
         from whatever loop it is blocked in."""
         token = self.journal.resume_token if self.journal else None
         if token:
-            self.channel.session_info = {"session": self.spec.session,
+            self.channel.session_info = {"session": self.session,
                                          "token": token}
+
+    def _attach_journal(self) -> None:
+        """Bind the journal to this session and reload channel state.
+
+        The resume re-attach handshake runs only when there is evidence
+        the *peer* already knows this session (something of ours was
+        acked, or something of theirs journaled): before that point the
+        peer is still parked in its opening recv and a resume frame
+        would go unanswered — the plain journal replay alone is
+        sufficient and correct there."""
+        j = self.journal
+        self._resumed = j.begin(self.session, self.sender, self.spec_hash)
+        self._replay_in = len(j.inbound)
+        self.channel.on_deliver = j.record_inbound
+        self.channel.restore(send_seq=len(j.outbound),
+                             delivered=j.delivered_seqs())
+        self._register_session_info()
+        token = j.resume_token
+        peer_knows_us = bool(j.inbound) \
+            or any(e["acked"] for e in j.outbound)
+        if self._resumed and token and peer_knows_us:
+            budget = max(10.0 * self.channel.timeout_s, 5.0)
+            try:
+                self.channel.resume(self.session, token,
+                                    max_wait_s=budget)
+            except SessionResumeRefused:
+                raise  # wrong session/token — never a peer-gone case
+            except TransportError:
+                # Unanswered: the peer finished and left. Single-crash
+                # soundness: it cannot have completed without every
+                # release we journaled — the channel acks a frame only
+                # after journaling it, and the peer's final recv could
+                # not have returned otherwise — so delivery of our
+                # unacked slots already happened and replay can finish
+                # from the journal alone (_send_gated/_send_plain skip
+                # the wire when this flag is set). A dual-crash that
+                # violates the premise fails loudly via recv timeout.
+                self._peer_gone = True
+
+    def _stats(self) -> dict:
+        ch = self.channel
+        out = {"sent_msgs": ch.sent_msgs,
+               "total_retries": ch.total_retries}
+        if ch.fault is not None:
+            out["fault"] = ch.fault.stats()
+        return out
+
+
+class Party(SessionEndpoint):
+    """One role ("x" or "y") of one protocol session.
+
+    ``column`` is this party's raw column — it never leaves this object
+    except through ``split_reference.party_release``/``finish`` (DP
+    releases) and is never serialized. ``ledger`` is wrapped in the
+    release gate immediately; the party itself keeps no direct
+    reference.
+
+    With ``journal`` (a :class:`SessionJournal`), the session is
+    crash-safe: every outbound message is journaled before it is sent
+    (outbound slot *k* ↔ wire seq *k+1*), every inbound message is
+    journaled before it is acked, the gated charge carries a
+    deterministic ``charge_id`` so the ledger spends it once across
+    restarts, and a restarted party replays its journal — re-sending
+    journaled wire bytes verbatim under their original seqs — until it
+    rejoins the live session exactly where it died. Without a journal
+    nothing changes, down to the wire bytes (the determinism test
+    byte-compares transcripts).
+    """
+
+    def __init__(self, role: str, column, spec: ProtocolSpec,
+                 channel: ReliableChannel, ledger: PrivacyLedger,
+                 transcript: Transcript | None = None,
+                 recv_timeout_s: float = 30.0,
+                 journal: SessionJournal | None = None):
+        if role not in ("x", "y"):
+            raise ValueError(f"role must be 'x' or 'y', got {role!r}")
+        col = np.asarray(column, dtype=np.float32)
+        if col.ndim != 1 or col.shape[0] != spec.n:
+            raise ValueError(
+                f"column must be shape ({spec.n},), got {col.shape}")
+        super().__init__(session=spec.session,
+                         spec_hash=spec.spec_hash(), sender=role,
+                         channel=channel, ledger=ledger,
+                         transcript=transcript,
+                         recv_timeout_s=recv_timeout_s, journal=journal)
+        self.role = role
+        self._column = col
+        self.spec = spec
 
     def _handshake(self) -> None:
         """X proposes (opening the trace root), Y verifies the spec
@@ -450,8 +541,11 @@ class Party:
     def _root_key(self):
         from dpcorr.utils import rng
 
-        return rng.party_root(rng.master_key(self.spec.seed), self.role,
-                              self.spec.noise_mode)
+        key = rng.master_key(self.spec.seed)
+        label = self.spec.key_x if self.role == "x" else self.spec.key_y
+        if label:
+            key = rng.column_root(key, label)
+        return rng.party_root(key, self.role, self.spec.noise_mode)
 
     def _run_releaser(self) -> ProtocolResult:
         from dpcorr.models.estimators import split_reference as sr
@@ -467,7 +561,7 @@ class Party:
                                           kind=kinds[name])
                        for name, arr in rel.items()}
         outbound = self._msg("release", payload)
-        self._send_gated(outbound)
+        self._send_gated(outbound, self.spec.charges_for(self.role))
         final = self._recv("result")
         # result is the session's last message and we are its receiver:
         # linger so our ack loss doesn't strand the finisher mid-send
@@ -525,7 +619,7 @@ class Party:
                                     peer_release, self._column, s.eps1,
                                     s.eps2, s.alpha, s.normalise)
         outbound = self._msg("result", _result_floats(rho, lo, hi))
-        self._send_gated(outbound)
+        self._send_gated(outbound, self.spec.charges_for(self.role))
         # our result being acked does NOT mean our ack of the peer's
         # release got through: the releaser absorbs the result (and acks
         # it) from inside its own blocked send, so it can still be
@@ -536,53 +630,6 @@ class Party:
             role=self.role, session=s.session,
             rho_hat=float(rho), ci_low=float(lo), ci_high=float(hi),
             trace_id=self._trace_id(), stats=self._stats())
-
-    def _stats(self) -> dict:
-        ch = self.channel
-        out = {"sent_msgs": ch.sent_msgs,
-               "total_retries": ch.total_retries}
-        if ch.fault is not None:
-            out["fault"] = ch.fault.stats()
-        return out
-
-    def _attach_journal(self) -> None:
-        """Bind the journal to this session and reload channel state.
-
-        The resume re-attach handshake runs only when there is evidence
-        the *peer* already knows this session (something of ours was
-        acked, or something of theirs journaled): before that point the
-        peer is still parked in its opening recv and a resume frame
-        would go unanswered — the plain journal replay alone is
-        sufficient and correct there."""
-        j = self.journal
-        s = self.spec
-        self._resumed = j.begin(s.session, self.role, s.spec_hash())
-        self._replay_in = len(j.inbound)
-        self.channel.on_deliver = j.record_inbound
-        self.channel.restore(send_seq=len(j.outbound),
-                             delivered=j.delivered_seqs())
-        self._register_session_info()
-        token = j.resume_token
-        peer_knows_us = bool(j.inbound) \
-            or any(e["acked"] for e in j.outbound)
-        if self._resumed and token and peer_knows_us:
-            budget = max(10.0 * self.channel.timeout_s, 5.0)
-            try:
-                self.channel.resume(s.session, token,
-                                    max_wait_s=budget)
-            except SessionResumeRefused:
-                raise  # wrong session/token — never a peer-gone case
-            except TransportError:
-                # Unanswered: the peer finished and left. Single-crash
-                # soundness: it cannot have completed without every
-                # release we journaled — the channel acks a frame only
-                # after journaling it, and the peer's final recv could
-                # not have returned otherwise — so delivery of our
-                # unacked slots already happened and replay can finish
-                # from the journal alone (_send_gated/_send_plain skip
-                # the wire when this flag is set). A dual-crash that
-                # violates the premise fails loudly via recv timeout.
-                self._peer_gone = True
 
     def run(self) -> ProtocolResult:
         """Execute this role's side of the session to completion. A

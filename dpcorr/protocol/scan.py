@@ -36,6 +36,7 @@ from dpcorr.obs.budget_replay import RESERVED_PREFIXES
 from dpcorr.protocol.messages import (
     MSG_TYPES,
     PROTOCOL_VERSION,
+    canonical_encode,
     decode_array,
     iter_arrays,
     read_transcript,
@@ -82,6 +83,19 @@ def _spec_from_hello(entries: list[dict]) -> dict | None:
     return None
 
 
+def _fed_from_hello(entries: list[dict]) -> dict | None:
+    """The federation plan a pair-link transcript opened under (the
+    link hello carries the full public plan, like the two-party hello
+    carries the public spec)."""
+    for e in entries:
+        w = e.get("wire", {})
+        if w.get("msg_type") == "hello":
+            fed = w.get("payload", {}).get("fed")
+            if isinstance(fed, dict):
+                return fed
+    return None
+
+
 def _check_raw(viol: list, idx: int, rel, raws: dict) -> None:
     """The no-raw-columns proof against supplied raw columns. Shapes
     that cannot hold a column pass trivially; same-shape arrays must
@@ -106,24 +120,71 @@ def _check_raw(viol: list, idx: int, rel, raws: dict) -> None:
                        "no randomization applied")
 
 
+def _check_group(viol: list, idx: int, group, schema: dict, raws: dict,
+                 where: str = "") -> None:
+    """One release payload group (the whole payload of a two-party
+    ``release``, or one labelled artifact of a federation round)
+    against the family wire schema — keys, envelope, kind, shape,
+    dtype, sign-value range, raw-column proof."""
+    import numpy as np
+
+    tag = f"{where}: " if where else ""
+    if not isinstance(group, dict) or set(group) != set(schema):
+        _violation(viol, idx, "schema-keys",
+                   f"{tag}payload keys "
+                   f"{sorted(group) if isinstance(group, dict) else group!r}"
+                   f" != {sorted(schema)}")
+        return
+    for name, want in schema.items():
+        env = group[name]
+        if not (isinstance(env, dict) and env.get("__array__") == 1):
+            _violation(viol, idx, "schema-envelope",
+                       f"{tag}{name!r} is not an array envelope")
+            continue
+        if env.get("kind") != want["kind"]:
+            _violation(viol, idx, "schema-kind",
+                       f"{tag}{name!r} kind {env.get('kind')!r} != "
+                       f"{want['kind']!r}")
+        rel = decode_array(env)
+        if tuple(rel.shape) != want["shape"] \
+                or str(rel.dtype) != want["dtype"]:
+            _violation(viol, idx, "schema-shape",
+                       f"{tag}{name!r} is {rel.dtype}{rel.shape}, schema "
+                       f"says {want['dtype']}{want['shape']}")
+            continue
+        if name == "flipped_signs":
+            bad = ~np.isin(rel, np.asarray(_SIGN_VALUES, np.float32))
+            if bool(bad.any()):
+                _violation(viol, idx, "sign-values",
+                           f"{tag}{int(bad.sum())} values outside "
+                           "{-1, 0, +1}")
+        _check_raw(viol, idx, rel, raws)
+
+
 def scan_transcript(transcript, spec: dict | None = None,
                     raw_x=None, raw_y=None) -> dict:
     """Audit one party's transcript. ``transcript`` is a path or the
     entry list from :func:`~dpcorr.protocol.messages.read_transcript`;
     ``spec`` overrides the hello-embedded public spec (they are
-    cross-checked when both exist). Returns ``{"ok", "violations",
-    "messages", "releases", "gated_eps"}`` — never raises on content
-    violations, only on an unreadable transcript."""
-    import numpy as np
-
+    cross-checked when both exist). Federation pair-link transcripts
+    (hello carries the public *plan*) validate each round's labelled
+    artifact groups against the same family schema and flag
+    ``"federation": True`` in the report. Returns ``{"ok",
+    "violations", "messages", "releases", "gated_eps"}`` — never
+    raises on content violations, only on an unreadable transcript."""
     entries = (read_transcript(transcript) if isinstance(transcript, str)
                else list(transcript))
     viol: list[dict] = []
     hello_spec = _spec_from_hello(entries)
+    fed = _fed_from_hello(entries)
     if spec is not None and hello_spec is not None and spec != hello_spec:
         _violation(viol, -1, "spec-mismatch",
                    "supplied spec differs from the transcript's hello")
     eff = spec or hello_spec
+    if eff is None and fed is not None:
+        # a federation pair-link: every column shares the plan's one ε
+        eff = {"family": fed["family"], "n": fed["n"],
+               "eps1": fed["eps"], "eps2": fed["eps"]}
     schema = (wire_schema(eff["family"], int(eff["n"]),
                           float(eff["eps1"]), float(eff["eps2"]))
               if eff else None)
@@ -167,39 +228,34 @@ def scan_transcript(transcript, spec: dict | None = None,
             _violation(viol, idx, "no-spec",
                        "release before any hello spec; cannot validate")
             continue
-        if set(payload) != set(schema):
-            _violation(viol, idx, "schema-keys",
-                       f"payload keys {sorted(payload)} != "
-                       f"{sorted(schema)}")
+        if fed is not None:
+            # federation round envelope: arrays may appear only inside
+            # the labelled artifact groups; each group is one column's
+            # release and must satisfy the family schema exactly like a
+            # two-party payload
+            arts = payload.get("artifacts")
+            if not isinstance(arts, dict):
+                _violation(viol, idx, "fed-release-shape",
+                           "round release carries no artifacts map")
+                continue
+            outside = list(iter_arrays(
+                {k: v for k, v in payload.items() if k != "artifacts"}))
+            if outside:
+                _violation(viol, idx, "array-outside-artifacts",
+                           f"{len(outside)} array(s) outside the "
+                           "artifacts map")
+            for lab in sorted(arts):
+                _check_group(viol, idx, arts[lab], schema, raws,
+                             where=f"artifact {lab!r}")
             continue
-        for name, want in schema.items():
-            env = payload[name]
-            if not (isinstance(env, dict) and env.get("__array__") == 1):
-                _violation(viol, idx, "schema-envelope",
-                           f"{name!r} is not an array envelope")
-                continue
-            if env.get("kind") != want["kind"]:
-                _violation(viol, idx, "schema-kind",
-                           f"{name!r} kind {env.get('kind')!r} != "
-                           f"{want['kind']!r}")
-            rel = decode_array(env)
-            if tuple(rel.shape) != want["shape"] \
-                    or str(rel.dtype) != want["dtype"]:
-                _violation(viol, idx, "schema-shape",
-                           f"{name!r} is {rel.dtype}{rel.shape}, schema "
-                           f"says {want['dtype']}{want['shape']}")
-                continue
-            if name == "flipped_signs":
-                bad = ~np.isin(rel, np.asarray(_SIGN_VALUES, np.float32))
-                if bool(bad.any()):
-                    _violation(viol, idx, "sign-values",
-                               f"{int(bad.sum())} values outside "
-                               "{-1, 0, +1}")
-            _check_raw(viol, idx, rel, raws)
+        _check_group(viol, idx, payload, schema, raws)
 
-    return {"ok": not viol, "violations": viol,
-            "messages": len(entries), "releases": releases,
-            "gated_eps": gated_eps}
+    out = {"ok": not viol, "violations": viol,
+           "messages": len(entries), "releases": releases,
+           "gated_eps": gated_eps}
+    if fed is not None:
+        out["federation"] = True
+    return out
 
 
 def ledger_balance(transcript, audit_events: list[dict]) -> dict:
@@ -297,3 +353,97 @@ def ledger_balance(transcript, audit_events: list[dict]) -> dict:
         "unmatched_charges": unmatched_charges,
         "spent": replay(audit_events),
     }
+
+
+def scan_federation(transcripts) -> dict:
+    """The cross-pair correlation-leak gate over a whole federation's
+    pair-link transcripts (every party, every link).
+
+    The federation's budget optimum rests on *reusing* a column's DP
+    release across every pair that needs it: re-noising per pair would
+    hand a curious observer k−1 independently-noised images of the same
+    column (averaging them cancels the noise — a correlation leak the
+    per-release ε accounting never sees). The wire-checkable form of
+    that contract is **byte identity**: a given column label's release
+    envelope must be the *identical bytes* in every transcript it
+    appears in. Divergence names the offending pair sessions. The gate
+    also refuses double-charging — an artifact whose label appears in
+    more than one distinct round's ``charged`` list was paid for twice,
+    which is an ε leak even when the bytes agree.
+
+    ``transcripts`` is a list of paths or entry lists. Returns
+    ``{"ok", "violations", "labels", "transcripts"}``; the
+    ``dpcorr federation scan`` CLI exits 1 on any violation."""
+    by_label: dict = {}     # label -> {canonical bytes -> [session...]}
+    charged_x: dict = {}    # label -> set of (session, round) charging it
+    charged_y: dict = {}
+    n = 0
+    for t in transcripts:
+        entries = (read_transcript(t) if isinstance(t, str) else list(t))
+        n += 1
+        for e in entries:
+            w = e.get("wire", {})
+            sess = w.get("session", "?")
+            payload = w.get("payload", {})
+            mtype = w.get("msg_type")
+            if mtype == "release" and isinstance(
+                    payload.get("artifacts"), dict):
+                for lab, group in payload["artifacts"].items():
+                    enc = canonical_encode(group) \
+                        if isinstance(group, dict) else repr(group).encode()
+                    by_label.setdefault(lab, {}).setdefault(
+                        enc, set()).add(sess)
+                for lab in payload.get("charged", ()):
+                    charged_x.setdefault(lab, set()).add(
+                        (sess, payload.get("round")))
+            elif mtype == "result":
+                for lab in payload.get("charged", ()):
+                    charged_y.setdefault(lab, set()).add(
+                        (sess, payload.get("round")))
+    viol: list[dict] = []
+    for lab, variants in sorted(by_label.items()):
+        if len(variants) > 1:
+            sessions = sorted(s for ss in variants.values() for s in ss)
+            _violation(
+                viol, -1, "cross-pair-release-divergence",
+                f"column {lab!r} released as {len(variants)} distinct "
+                f"byte encodings across pair sessions {sessions} — "
+                "re-noised releases of one column are subtractable")
+    for side, charged in (("x", charged_x), ("y", charged_y)):
+        for lab, venues in sorted(charged.items()):
+            if len(venues) > 1:
+                _violation(
+                    viol, -1, "double-charged-artifact",
+                    f"({side}, {lab!r}) charged in {len(venues)} rounds "
+                    f"{sorted(venues)} — the plan charges each artifact "
+                    "exactly once")
+    return {"ok": not viol, "violations": viol,
+            "labels": sorted(by_label), "transcripts": n}
+
+
+def federation_balance(transcripts, audit_events: list[dict],
+                       expected_local_eps: float = 0.0) -> dict:
+    """One party's whole-matrix accounting audit: every gated send
+    across *all* of its pair-link transcripts matches exactly one
+    durable charge (:func:`ledger_balance` over the concatenated
+    entries), and the only charges allowed to stand unmatched by any
+    send are the party's local-cell charges (their plan-derived
+    ``charge_id`` ends in ``":local"`` — local cells spend real ε with
+    no wire message to pair it with), whose total must equal
+    ``expected_local_eps`` (``FederationPlan.local_charges``)."""
+    entries: list = []
+    for t in transcripts:
+        entries.extend(read_transcript(t) if isinstance(t, str)
+                       else list(t))
+    bal = ledger_balance(entries, audit_events)
+    local, rest = [], []
+    for c in bal["unmatched_charges"]:
+        cid = str(c.get("charge_id") or "")
+        (local if cid.endswith(":local") else rest).append(c)
+    local_eps = sum(float(c["eps"]) for c in local)
+    ok = (not bal["unmatched_sends"] and not rest
+          and abs(local_eps - float(expected_local_eps)) < 1e-9)
+    return {"ok": ok, "unmatched_sends": bal["unmatched_sends"],
+            "unmatched_charges": rest, "local_eps": local_eps,
+            "expected_local_eps": float(expected_local_eps),
+            "spent": bal["spent"]}

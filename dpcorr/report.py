@@ -311,6 +311,53 @@ def protocol_transcript_frame(transcript) -> pd.DataFrame:
                                        "trace_id", "ts"])
 
 
+def correlation_matrix_frame(results, plan=None) -> pd.DataFrame:
+    """A completed federation matrix (protocol.federation) as a tidy
+    per-cell frame — the N-party sibling of
+    :func:`protocol_transcript_frame`. ``results`` is one
+    ``FederationResult``, a ``{party: FederationResult}`` mapping (what
+    ``run_federation_inproc`` returns — each party only sees its own
+    cells, the frame is their union), or a plain cells dict
+    ``{"i,j": {"rho_hat", "ci_low", "ci_high"}}`` (the CLI JSON).
+    Parties must agree bitwise on every shared cell — disagreement
+    raises. With ``plan`` each row also carries the cell's column
+    labels and venue (``local@P`` or ``link P-Q``)."""
+    cells: dict = {}
+
+    def merge(d):
+        for key, val in d.items():
+            if key in cells and cells[key] != val:
+                raise ValueError(f"parties disagree on cell {key}: "
+                                 f"{cells[key]} != {val}")
+            cells.setdefault(key, val)
+
+    if hasattr(results, "cells"):
+        merge(results.cells)
+    elif isinstance(results, dict) \
+            and all(hasattr(r, "cells") for r in results.values()):
+        for r in results.values():
+            merge(r.cells)
+    else:
+        merge(dict(results))
+    rows = []
+    for key in sorted(cells,
+                      key=lambda s: tuple(int(t) for t in s.split(","))):
+        i, j = (int(t) for t in key.split(","))
+        val = cells[key]
+        row = {"i": i, "j": j, "label_x": None, "label_y": None,
+               "venue": None, "rho_hat": val["rho_hat"],
+               "ci_low": val["ci_low"], "ci_high": val["ci_high"]}
+        if plan is not None:
+            row["label_x"], row["label_y"] = plan.label(i), plan.label(j)
+            v = plan.cell_venue(i, j)
+            row["venue"] = (f"local@{v[1]}" if v[0] == "local"
+                            else f"link {v[1]}-{v[2]}")
+        rows.append(row)
+    return pd.DataFrame(rows, columns=["i", "j", "label_x", "label_y",
+                                       "venue", "rho_hat", "ci_low",
+                                       "ci_high"])
+
+
 def render_all(grid_detail: pd.DataFrame | None = None,
                grid_summ: pd.DataFrame | None = None,
                hrs_summ: pd.DataFrame | None = None,

@@ -151,6 +151,26 @@ def party_root(key: jax.Array, role: str, mode: str = "replay") -> jax.Array:
                      "expected 'replay' or 'hardened'")
 
 
+def column_root(key: jax.Array, label: str) -> jax.Array:
+    """Root key for one federated column (``dpcorr.protocol.matrix``).
+
+    A k×k federation runs one protocol session per column pair; if every
+    session reused the session key directly, two different columns would
+    draw their noise from the *same* named streams — the same Laplace
+    vector added to two different releases is subtractable, a privacy
+    bug. Each column therefore gets its own named subtree keyed by its
+    public label, so (a) a column's release is a function of (label,
+    column) alone — byte-identical wherever it is reused, the federation
+    reuse contract — and (b) noise across distinct columns is
+    independent by key-tree construction. Composes with
+    :func:`party_root`: the pair session applies its role/noise-mode
+    layout *below* the column root.
+    """
+    if not label:
+        raise ValueError("column label must be non-empty")
+    return stream(key, f"protocol/col/{label}")
+
+
 def stream(key: jax.Array, name: str) -> jax.Array:
     """Named substream: stable across code movement, unlike split() order.
 

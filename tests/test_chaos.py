@@ -458,8 +458,11 @@ def _crash_resume(family, victim, point, tmp_path, n=512):
 
 
 @pytest.mark.parametrize("victim", ["x", "y"])
-@pytest.mark.parametrize("point", chaos.MATRIX_POINTS)
+@pytest.mark.parametrize("point", [p for p in chaos.MATRIX_POINTS
+                                   if not p.startswith("federation.")])
 def test_crash_resume_matrix_inproc(point, victim, tmp_path):
+    # federation.* points never fire in a two-party session — their
+    # matrix crash-resume coverage lives in tests/test_federation.py
     _crash_resume("ni_sign", victim, point, tmp_path)
 
 

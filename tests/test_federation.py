@@ -1,0 +1,419 @@
+"""N-party federation tests (ISSUE 12): the k×k matrix over
+multiplexed pair sessions.
+
+The acceptance contract, pinned here end to end:
+
+- **bit identity** — the federation matrix equals k·(k−1)/2 independent
+  two-party sessions over the same per-column key labels, on both
+  transports, with any round chunking, and under fault injection;
+- **ε optimum** — total spend is the column-release-reuse optimum
+  ``2·f·ε·(k−1)`` (strictly less than the naive per-cell
+  ``f·ε·k·(k−1)`` for k ≥ 3), each party's ledger showing exactly its
+  plan share;
+- **exactly-once resume** — any party killed at any federation chaos
+  point resumes on restart with the identical matrix and no double
+  spend;
+- **the cross-pair gate** — reused releases are byte-identical across
+  every pair session, and the scanner refuses divergence.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dpcorr import chaos
+from dpcorr.models.estimators import split_reference as sr
+from dpcorr.obs.audit import AuditTrail, read_events
+from dpcorr.protocol import InProcTransport, ProtocolRefused, run_inproc
+from dpcorr.protocol.federation import (
+    make_federation_parties,
+    run_federation_inproc,
+    run_federation_tcp,
+)
+from dpcorr.protocol.matrix import FederationPlan, _factor
+from dpcorr.protocol.messages import read_transcript
+from dpcorr.protocol.scan import (
+    federation_balance,
+    scan_federation,
+    scan_transcript,
+)
+from dpcorr.serve.ledger import PrivacyLedger, release_factor
+from dpcorr.utils import rng
+
+FAMILIES = ("ni_sign", "int_sign", "ni_subg", "int_subg")
+N = 512
+
+
+def _plan(family="ni_sign", n=N, eps=1.0, **kw):
+    """The canonical 3-party / 4-column case: one local cell (p0's
+    a×b), three pair links, every reuse pattern exercised."""
+    return FederationPlan(
+        family=family, n=n, eps=eps,
+        parties=[("p0", ["a", "b"]), ("p1", ["c"]), ("p2", ["d"])], **kw)
+
+
+def _data(plan, rho=0.6):
+    k = plan.k
+    cov = np.full((k, k), rho)
+    np.fill_diagonal(cov, 1.0)
+    xy = np.random.default_rng(plan.seed).multivariate_normal(
+        np.zeros(k), cov, size=plan.n)
+    return {lab: np.asarray(xy[:, i], np.float32)
+            for i, (_owner, lab) in enumerate(plan.columns())}
+
+
+def _merged(results) -> dict:
+    """Union of every party's cell view, asserting bitwise agreement
+    on shared cells."""
+    cells: dict = {}
+    for res in results.values():
+        for key, val in res.cells.items():
+            if key in cells:
+                assert cells[key] == val, f"parties disagree on {key}"
+            cells[key] = val
+    return cells
+
+
+# ------------------------------------------------------------ plan ----
+
+@pytest.mark.parametrize("family", FAMILIES)
+@pytest.mark.parametrize("normalise", [True, False])
+def test_release_factor_pin(family, normalise):
+    # matrix._factor is the jax-free mirror of serve.ledger's factor —
+    # the planner's ε arithmetic must never drift from the gate's
+    assert _factor(family, normalise) == release_factor(family, normalise)
+
+
+def test_plan_schedule():
+    plan = _plan()
+    assert plan.k == 4
+    assert plan.links() == (("p0", "p1"), ("p0", "p2"), ("p1", "p2"))
+    assert plan.local_cells("p0") == ((0, 1),)
+    assert plan.cell_venue(0, 2) == ("link", "p0", "p1")
+    # one round per link by default; chunked at 1 → one cell per round
+    assert len(plan.link_rounds("p0", "p1")) == 1
+    assert plan.round_x_labels("p0", "p1", 0) == ("a", "b")
+    chunked = _plan(max_cells_per_round=1)
+    assert len(chunked.link_rounds("p0", "p1")) == 2
+    # the public identity round-trips and pins the schedule
+    clone = FederationPlan.from_public(plan.to_public())
+    assert clone.fed_hash() == plan.fed_hash()
+    assert clone.fed == plan.fed
+    spec = plan.cell_spec(0, 2)
+    assert (spec.key_x, spec.key_y) == ("a", "c")
+    assert (spec.party_x, spec.party_y) == ("p0", "p1")
+
+
+def test_plan_eps_arithmetic():
+    plan = _plan()  # ni_sign normalised: f = 2
+    assert plan.optimal_eps() == 2 * 2.0 * 1.0 * (plan.k - 1) == 12.0
+    assert plan.naive_eps() == 2 * 2.0 * 1.0 * len(plan.cells()) == 24.0
+    assert plan.optimal_eps() < plan.naive_eps()  # strict for k >= 3
+    per = plan.party_eps()
+    assert per == {"p0": 6.0, "p1": 4.0, "p2": 2.0}
+    assert abs(sum(per.values()) - plan.optimal_eps()) < 1e-12
+    # every artifact is charged at exactly one venue
+    venues = plan.artifact_venues()
+    assert len(venues) == 2 * (plan.k - 1)
+    lc = plan.local_charges("p0")
+    assert lc["artifacts"] == (("x", "a"), ("y", "b"))
+    assert lc["charges"] == {"p0": 4.0}
+    assert lc["charge_id"].endswith(":local")
+
+
+# ---------------------------------------------------- finish batch ----
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_finish_batch_exact_is_bitwise_per_cell(family):
+    plan = _plan(family=family)
+    data = _data(plan)
+    eps = plan.eps
+
+    def root(lab, side):
+        return rng.party_root(
+            rng.column_root(rng.master_key(plan.seed), lab), side,
+            "replay")
+
+    labels_x = ["a", "b", "c"]
+    rels = [sr.party_release(family, root(lab, "x"), "x", data[lab],
+                             eps, eps, True) for lab in labels_x]
+    keys = [root("d", "y")] * len(labels_x)
+    cols = [data["d"]] * len(labels_x)
+    rho, lo, hi = sr.finish_batch(family, keys, rels, cols, eps, eps)
+    assert rho.shape == (3,)
+    for b in range(len(labels_x)):
+        r1, l1, h1 = sr.finish(family, keys[b], rels[b], cols[b], eps,
+                               eps)
+        assert (float(rho[b]), float(lo[b]), float(hi[b])) \
+            == (float(r1), float(l1), float(h1))
+
+
+def test_finish_batch_vector_engine_and_validation():
+    plan = _plan()
+    data = _data(plan)
+    key = rng.party_root(
+        rng.column_root(rng.master_key(plan.seed), "a"), "x", "replay")
+    rel = sr.party_release("ni_sign", key, "x", data["a"], 1.0, 1.0,
+                           True)
+    fkey = rng.party_root(
+        rng.column_root(rng.master_key(plan.seed), "d"), "y", "replay")
+    rho, lo, hi = sr.finish_batch("ni_sign", [fkey], [rel], [data["d"]],
+                                  1.0, 1.0, engine="vector")
+    exact, _, _ = sr.finish_batch("ni_sign", [fkey], [rel], [data["d"]],
+                                  1.0, 1.0, engine="exact")
+    assert np.allclose(float(rho[0]), float(exact[0]), atol=1e-6)
+    with pytest.raises(ValueError, match="engine"):
+        sr.finish_batch("ni_sign", [fkey], [rel], [data["d"]], 1.0, 1.0,
+                        engine="nope")
+    with pytest.raises(ValueError, match="length mismatch"):
+        sr.finish_batch("ni_sign", [fkey, fkey], [rel], [data["d"]],
+                        1.0, 1.0)
+
+
+# ----------------------------------------------------- bit identity ----
+
+def test_matrix_bit_identical_to_independent_runs():
+    plan = _plan()
+    data = _data(plan)
+    cells = _merged(run_federation_inproc(plan, data))
+    assert sorted(cells) == [f"{i},{j}" for i, j in plan.cells()]
+    for i, j in plan.cells():
+        ref = run_inproc(plan.cell_spec(i, j), data[plan.label(i)],
+                         data[plan.label(j)])["x"]
+        got = cells[f"{i},{j}"]
+        assert (got["rho_hat"], got["ci_low"], got["ci_high"]) \
+            == (ref.rho_hat, ref.ci_low, ref.ci_high), (i, j)
+
+
+def test_matrix_tcp_and_chunked_same_bits():
+    plan = _plan()
+    data = _data(plan)
+    ref = _merged(run_federation_inproc(plan, data))
+    assert _merged(run_federation_tcp(plan, data)) == ref
+    # one cell per round: more envelopes, identical bits — chunking is
+    # pure scheduling
+    assert _merged(run_federation_inproc(
+        _plan(max_cells_per_round=1), data)) == ref
+
+
+def test_multiplexed_rounds_survive_faults():
+    plan = _plan()
+    data = _data(plan)
+    clean = _merged(run_federation_inproc(plan, data))
+    res = run_federation_inproc(
+        plan, data, fault={"drop": 0.15, "duplicate": 0.15},
+        timeout_s=0.2)
+    assert _merged(res) == clean
+    retries = sum(st["total_retries"] for r in res.values()
+                  for st in r.stats.values())
+    assert retries > 0, "fault arm proved nothing"
+
+
+# ------------------------------------------------------------- ε ----
+
+def test_eps_spent_at_release_reuse_optimum():
+    plan = _plan()
+    data = _data(plan)
+    ledgers = {name: PrivacyLedger(1e6) for name, _ in plan.parties}
+    res = run_federation_inproc(plan, data, ledgers=ledgers)
+    for name, want in plan.party_eps().items():
+        assert abs(ledgers[name].spent(name) - want) < 1e-9, name
+    total = sum(ledgers[n_].spent(n_) for n_, _ in plan.parties)
+    assert abs(total - plan.optimal_eps()) < 1e-9
+    assert total < plan.naive_eps()
+    # per-cell cost attributions sum back to the whole-matrix ε
+    eps_new = sum(c["eps_new"] for r in res.values() for c in r.costs
+                  if len(c["pair"]) > 1 or c["pair"] == [r.party])
+    # wire cells are attributed on the finisher only; local on the owner
+    attributed = sum(
+        c["eps_new"] for r in res.values() for c in r.costs)
+    assert abs(attributed - plan.optimal_eps()) < 1e-9, eps_new
+
+
+def test_budget_refusal_before_any_release():
+    plan = _plan()
+    data = _data(plan)
+    ledgers = {name: PrivacyLedger(0.5) for name, _ in plan.parties}
+    with pytest.raises(ProtocolRefused):
+        run_federation_inproc(plan, data, ledgers=ledgers,
+                              timeout_s=0.2, max_retries=3,
+                              recv_timeout_s=2.0)
+
+
+# ----------------------------------------------------- crash-resume ----
+
+#: Victims chosen so the point actually fires in that party: p0
+#: initiates (releases on) both its links, p1 finishes p0-p1, and
+#: mid_matrix fires in every party's join loop.
+_VICTIMS = {"federation.pre_release": "p0",
+            "federation.pre_finish": "p1",
+            "federation.mid_matrix": "p2"}
+
+
+@pytest.mark.parametrize("point", sorted(_VICTIMS))
+def test_crash_resume_exactly_once(point, tmp_path):
+    victim = _VICTIMS[point]
+    plan = _plan()
+    data = _data(plan)
+    ref = _merged(run_federation_inproc(plan, data))
+
+    def ledgers():
+        # path-persistent: the restart reloads the exact balances,
+        # like a real process would
+        return {name: PrivacyLedger(
+            1e6, path=str(tmp_path / f"ledger.{name}.json"))
+            for name, _ in plan.parties}
+
+    endpoints = {lk: InProcTransport() for lk in plan.links()}
+    parties = make_federation_parties(
+        plan, data, ledgers=ledgers(), endpoints=endpoints,
+        journal_dir=str(tmp_path))
+    chaos.install(chaos.ChaosPlan(point, hit=1, mode="raise",
+                                  thread_name=f"party-{victim}"))
+    results: dict = {}
+    errors: dict = {}
+
+    def drive(name, party):
+        try:
+            results[name] = party.run()
+        except BaseException as e:  # SimulatedCrash is a BaseException
+            errors[name] = e
+
+    threads = {name: threading.Thread(target=drive, args=(name, p),
+                                      name=f"party-{name}")
+               for name, p in parties.items()}
+    try:
+        for t in threads.values():
+            t.start()
+        threads[victim].join()
+    finally:
+        chaos.install(None)
+    assert isinstance(errors.pop(victim), chaos.SimulatedCrash)
+    # restart: fresh party objects on the surviving queue pairs, same
+    # journals, ledgers reloaded from disk — "rerun the same command"
+    fresh = make_federation_parties(
+        plan, data, ledgers=ledgers(), endpoints=endpoints,
+        journal_dir=str(tmp_path))
+    rerun = threading.Thread(target=drive, args=(victim, fresh[victim]),
+                             name=f"party-{victim}")
+    rerun.start()
+    rerun.join()
+    for name, t in threads.items():
+        if name != victim:
+            t.join()
+    assert not errors, errors
+    assert set(results) == {name for name, _ in plan.parties}
+    assert _merged(results) == ref
+    final = ledgers()
+    for name, want in plan.party_eps().items():
+        assert abs(final[name].spent(name) - want) < 1e-9, name
+
+
+# ------------------------------------------------------------ scan ----
+
+def _transcript_paths(plan, tmp_path):
+    return {name: [str(tmp_path / f"{plan.link_session(p, q)}"
+                       f".{name}.jsonl")
+                   for p, q in plan.party_links(name)]
+            for name, _ in plan.parties}
+
+
+def test_scan_federation_clean_and_balanced(tmp_path):
+    plan = _plan()
+    data = _data(plan)
+    audits = {name: AuditTrail(str(tmp_path / f"audit.{name}.jsonl"))
+              for name, _ in plan.parties}
+    ledgers = {name: PrivacyLedger(1e6, audit=audits[name])
+               for name, _ in plan.parties}
+    run_federation_inproc(plan, data, ledgers=ledgers,
+                          transcript_dir=str(tmp_path))
+    paths = _transcript_paths(plan, tmp_path)
+    flat = sorted({t for ts in paths.values() for t in ts})
+    assert len(flat) == 2 * len(plan.links())
+    for t in flat:
+        rep = scan_transcript(t)
+        assert rep["ok"], (t, rep["violations"])
+        assert rep["federation"] is True
+    cross = scan_federation(flat)
+    assert cross["ok"], cross["violations"]
+    # labels that crossed a wire: a, b (p0's) and c (p1's, to p2)
+    assert cross["labels"] == ["a", "b", "c"]
+    for name, _ in plan.parties:
+        expected_local = sum(
+            plan.local_charges(name)["charges"].values())
+        bal = federation_balance(
+            paths[name],
+            read_events(str(tmp_path / f"audit.{name}.jsonl")),
+            expected_local_eps=expected_local)
+        assert bal["ok"], (name, bal)
+        assert abs(bal["spent"][name] - plan.party_eps()[name]) < 1e-9
+
+
+def test_scan_federation_catches_renoised_release(tmp_path):
+    plan = _plan()
+    data = _data(plan)
+    run_federation_inproc(plan, data, transcript_dir=str(tmp_path))
+    flat = sorted({t for ts in _transcript_paths(plan,
+                                                 tmp_path).values()
+                   for t in ts})
+    tampered = [read_transcript(t) for t in flat]
+    hits = 0
+    for e in tampered[0]:
+        w = e.get("wire", {})
+        if w.get("msg_type") == "release":
+            arts = w["payload"]["artifacts"]
+            # a re-noised (or swapped) release of column "a": its bytes
+            # now diverge from every other pair session embedding "a"
+            arts["a"], arts["b"] = arts["b"], arts["a"]
+            hits += 1
+    assert hits, "no release round found to tamper with"
+    rep = scan_federation(tampered)
+    assert not rep["ok"]
+    rules = {v["rule"] for v in rep["violations"]}
+    assert "cross-pair-release-divergence" in rules
+    offending = " ".join(v["detail"] for v in rep["violations"])
+    assert plan.link_session("p0", "p1") in offending
+
+
+def test_chaos_cli_federation_victim_map():
+    # the chaos CLI sweeps every MATRIX_POINTS × {x, y}; federation
+    # points must map both roles onto a victim party so the case count
+    # (2 per point) holds
+    from dpcorr.__main__ import _FED_VICTIMS
+
+    fed_points = {p for p in chaos.MATRIX_POINTS
+                  if p.startswith("federation.")}
+    assert set(_FED_VICTIMS) == fed_points == set(_VICTIMS)
+    for mapping in _FED_VICTIMS.values():
+        assert set(mapping) == {"x", "y"}
+        assert set(mapping.values()) <= {"p0", "p1", "p2"}
+
+
+# ---------------------------------------------------------- report ----
+
+def test_correlation_matrix_frame():
+    pytest.importorskip("pandas")
+    pytest.importorskip("matplotlib")
+    from dpcorr.report import correlation_matrix_frame
+
+    plan = _plan()
+    data = _data(plan)
+    res = run_federation_inproc(plan, data)
+    df = correlation_matrix_frame(res, plan)
+    assert list(df.columns) == ["i", "j", "label_x", "label_y", "venue",
+                                "rho_hat", "ci_low", "ci_high"]
+    assert len(df) == len(plan.cells())
+    assert df.iloc[0]["venue"] == "local@p0"
+    assert set(df["venue"]) == {"local@p0", "link p0-p1", "link p0-p2",
+                                "link p1-p2"}
+    # one party's partial view still frames (its own cells only)
+    assert len(correlation_matrix_frame(res["p2"])) \
+        == len(res["p2"].cells)
+    bad = dict(res["p0"].cells)
+    bad["0,1"] = {"rho_hat": 0.0, "ci_low": 0.0, "ci_high": 0.0}
+    with pytest.raises(ValueError, match="disagree"):
+        correlation_matrix_frame({"p0": res["p0"],
+                                  "bad": type(res["p0"])(
+                                      party="bad", fed=plan.fed,
+                                      cells=bad, eps={})})

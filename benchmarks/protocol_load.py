@@ -202,6 +202,42 @@ def _matrix_resume_verdict(plan, data, workdir: str) -> dict:
             "cells": _merged_cells(results) if resumed_ok else None}
 
 
+def _trace_overhead(plan, data, pairs: int) -> dict:
+    """Interleaved traced-vs-untraced A/B over the same in-process
+    matrix (ISSUE 13): alternating run order per pair cancels drift,
+    and the span spool must carry ONE trace ID — the plan's. The
+    verdict upstream gates tracing at ≤3% on the p50."""
+    from dpcorr.obs import trace as obs_trace
+    from dpcorr.protocol.federation import run_federation_inproc
+
+    traced: list[float] = []
+    untraced: list[float] = []
+    with tempfile.TemporaryDirectory() as td:
+        spool = os.path.join(td, "spans.jsonl")
+        for i in range(pairs):
+            order = (("traced", "untraced") if i % 2
+                     else ("untraced", "traced"))
+            for mode in order:
+                if mode == "traced":
+                    obs_trace.configure(spool)
+                try:
+                    t0 = time.perf_counter()
+                    run_federation_inproc(plan, data)
+                    dt = time.perf_counter() - t0
+                finally:
+                    obs_trace.configure(None)
+                (traced if mode == "traced" else untraced).append(dt)
+        spans = obs_trace.read_spans(spool)
+    p50_t = _percentiles(traced)["p50"]
+    p50_u = _percentiles(untraced)["p50"]
+    return {"pairs": pairs,
+            "traced_s": _percentiles(traced),
+            "untraced_s": _percentiles(untraced),
+            "overhead": round(p50_t / p50_u - 1.0, 4) if p50_u else None,
+            "spans": len(spans),
+            "trace_ids": sorted({s["trace_id"] for s in spans})}
+
+
 def _matrix_family(family: str, args) -> dict:
     """One family's federation arms: timed in-process matrices
     (cells/s), one TCP matrix (transport equivalence), the
@@ -258,6 +294,7 @@ def _matrix_family(family: str, args) -> dict:
               and plan.optimal_eps() < plan.naive_eps())
     with tempfile.TemporaryDirectory() as td:
         resume = _matrix_resume_verdict(plan, data, td)
+    ab = _trace_overhead(plan, data, max(3, args.sessions // 2))
     fam = {
         "plan": {"fed": plan.fed, "k": plan.k, "cells": n_cells,
                  "parties": [[p, list(c)] for p, c in plan.parties]},
@@ -271,10 +308,15 @@ def _matrix_family(family: str, args) -> dict:
                 "saving_vs_naive": round(
                     1.0 - plan.optimal_eps() / plan.naive_eps(), 4)},
         "resume": {k: v for k, v in resume.items() if k != "cells"},
+        "trace_ab": ab,
         "verdicts": {
             "tcp_bit_identical": tcp_cells == cells_ref,
             "matches_independent_runs": independent_ok,
             "eps_at_optimum": eps_ok,
+            "trace_overhead_le_3pct": (ab["overhead"] is not None
+                                       and ab["overhead"] <= 0.03),
+            "traced_single_trace_id": ab["trace_ids"] == [
+                plan.trace_id()],
             "kill_resume_exactly_once": bool(
                 resume["crash_fired"] and resume["resumed"]
                 and resume["eps_exactly_once"]
@@ -316,8 +358,10 @@ def main() -> int:
                          "(protocol.federation) instead of the "
                          "two-party arms: cells/s, ε at the "
                          "release-reuse optimum vs naive per-cell, "
-                         "bit-identity to independent runs, and the "
-                         "kill/resume verdict")
+                         "bit-identity to independent runs, the "
+                         "kill/resume verdict, and the interleaved "
+                         "traced-vs-untraced A/B (≤3% overhead, one "
+                         "plan-derived trace ID)")
     ap.add_argument("--sessions", type=int, default=8,
                     help="timed sessions per clean arm (the fault arm "
                          "runs half, floor 2)")

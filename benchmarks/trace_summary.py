@@ -98,12 +98,16 @@ def summarize_trace(trace_dir: str, top: int = 8) -> dict:
     }
 
 
-def summarize_spans(path_or_spans, top: int = 0) -> dict:
+def summarize_spans(path_or_spans, top: int = 0,
+                    by_attr: str | None = None) -> dict:
     """Reduce an obs span JSONL log (or pre-loaded span list) to
     per-span-name aggregates: {spans, names: {name: {count, total_s,
     p50_s, p99_s}}}, names ordered by total time descending (all of
-    them unless ``top`` truncates). Strict input: a bad line raises
-    (obs.trace.read_spans), matching the CI artifact gate."""
+    them unless ``top`` truncates). ``by_attr`` splits each name by a
+    span attribute value — ``by_attr="link"`` turns a federation
+    spool into per-pair-session rows (``federation.round[p0-p1]``).
+    Strict input: a bad line raises (obs.trace.read_spans), matching
+    the CI artifact gate."""
     from dpcorr.obs.trace import read_spans
     from dpcorr.serve.stats import percentiles
 
@@ -111,7 +115,12 @@ def summarize_spans(path_or_spans, top: int = 0) -> dict:
              else path_or_spans)
     by_name: dict[str, list[float]] = collections.defaultdict(list)
     for sp in spans:
-        by_name[sp["name"]].append(float(sp["dur_s"]))
+        name = sp["name"]
+        if by_attr is not None:
+            val = (sp.get("attrs") or {}).get(by_attr)
+            if val is not None:
+                name = f"{name}[{val}]"
+        by_name[name].append(float(sp["dur_s"]))
     rows = []
     for name, durs in by_name.items():
         pct = percentiles(durs)
@@ -130,15 +139,31 @@ def summarize_spans(path_or_spans, top: int = 0) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace_dir",
-                    help="jax.profiler trace dir, or an obs span JSONL "
-                         "file (dpcorr serve --trace)")
+                    help="jax.profiler trace dir, an obs span JSONL "
+                         "file (dpcorr serve --trace), or a directory "
+                         "of federation spools (trace.*.jsonl) to "
+                         "union")
     ap.add_argument("--top", type=int, default=8)
+    ap.add_argument("--by-attr", dest="by_attr", default=None,
+                    help="split span rows by this span attribute "
+                         "(e.g. 'link' for per-pair-session rows from "
+                         "a federation spool)")
     ap.add_argument("--json", action="store_true",
                     help="print the full summary as one JSON object")
     args = ap.parse_args()
 
-    if os.path.isfile(args.trace_dir):
-        s = summarize_spans(args.trace_dir)
+    spools = (sorted(glob.glob(os.path.join(args.trace_dir,
+                                            "trace.*.jsonl")))
+              if os.path.isdir(args.trace_dir) else [])
+    if os.path.isfile(args.trace_dir) or spools:
+        if spools:
+            from dpcorr.obs.trace import read_spans
+            spans: list = []
+            for p in spools:
+                spans.extend(read_spans(p))
+            s = summarize_spans(spans, by_attr=args.by_attr)
+        else:
+            s = summarize_spans(args.trace_dir, by_attr=args.by_attr)
         if args.json:
             print(json.dumps(s))
             return

@@ -467,7 +467,14 @@ def cmd_obs_dump(args):
 
 def cmd_obs_top(args):
     """Live ops console over a serve replica's /metrics + /stats —
-    or, with --fleet, over every replica in a target map at once."""
+    or, with --fleet / --federation, over every replica or federation
+    party process in a target map at once."""
+    if getattr(args, "federation", None):
+        from dpcorr.obs.console import run_federation_top
+
+        raise SystemExit(run_federation_top(args.federation,
+                                            interval_s=args.interval,
+                                            once=args.once))
     if args.fleet:
         from dpcorr.obs.console import run_fleet_top
 
@@ -478,6 +485,62 @@ def cmd_obs_top(args):
 
     raise SystemExit(run_top(args.url, interval_s=args.interval,
                              once=args.once))
+
+
+def cmd_obs_provenance(args):
+    """Build the federation ε-provenance DAG jax-free
+    (docs/OBSERVABILITY.md §Federation): merge every party's
+    transcripts + audit trails + journals against the plan, prove
+    exactly-once charging and byte-identical reuse at the
+    ``2·f·ε·(k−1)`` optimum, and exit 1 naming the offending party on
+    any divergence. ``--out`` writes the JSON document, ``--dot`` the
+    Graphviz rendering, ``--cell I,J`` prints one cell's full story."""
+    from dpcorr.obs.provenance import build_provenance, discover_federation
+
+    plan, transcripts, audits, journals = discover_federation(
+        args.plan, transcript_dir=args.transcript_dir,
+        transcript_specs=args.transcript, audit_specs=args.audit,
+        journal_dir=args.journal_dir)
+    if not any(transcripts.values()):
+        raise SystemExit("no transcripts found: pass --transcript-dir "
+                         "or --transcript NAME=PATH")
+    prov = build_provenance(plan, transcripts, audits=audits,
+                            journals=journals)
+    doc = prov.to_doc()
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    if args.dot:
+        with open(args.dot, "w", encoding="utf-8") as f:
+            f.write(prov.to_dot())
+    if args.cell:
+        i, _, j = args.cell.partition(",")
+        print(json.dumps(prov.cell_story(int(i), int(j)), indent=2))
+    elif args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        eps = doc["eps"]
+        print(f"provenance {prov.fed}: "
+              f"{doc['counts']['nodes']} nodes, "
+              f"{doc['counts']['edges']} edges; "
+              f"eps total={eps['total']:.6g} "
+              f"optimal={eps['optimal']:.6g} "
+              f"{'EXACT' if prov.total_eps == prov.expected_eps else 'MISMATCH'}")
+        for pname, rec in sorted(eps["parties"].items()):
+            print(f"  {pname}: spent={rec['spent']:.6g} "
+                  f"share={rec['share']:.6g}")
+        for d in prov.divergences:
+            print(f"  DIVERGENCE [{d['kind']}] party={d['party']}: "
+                  f"{d['detail']}")
+    if not prov.ok:
+        from dpcorr.obs import recorder as obs_recorder
+
+        obs_recorder.trigger(
+            "federation_scan_violation",
+            divergences=[{"kind": d["kind"], "party": d["party"]}
+                         for d in prov.divergences])
+        sys.exit(1)
 
 
 def cmd_obs_fleet_snapshot(args):
@@ -1112,6 +1175,8 @@ def cmd_federation_party(args):
     from dpcorr import chaos
     from dpcorr.obs import trace as obs_trace
     from dpcorr.obs.audit import AuditTrail
+    from dpcorr.obs.endpoint import start_obs_server
+    from dpcorr.obs.metrics import Registry
     from dpcorr.protocol.federation import serve_federation_party
     from dpcorr.serve.ledger import PrivacyLedger
 
@@ -1119,10 +1184,17 @@ def cmd_federation_party(args):
         else chaos.plan_from_env()
     if plan is not None:
         chaos.install(plan)
-    if args.trace:
-        obs_trace.configure(args.trace)
     fed = _federation_plan(args)
     name = args.name
+    instance = args.instance or name
+    if args.trace:
+        # a directory spools per-instance (trace.<instance>.jsonl) so
+        # k parties can share one --trace value and the fleet union
+        # (obs fleet chrome) gets one spool per party, pre-named
+        trace_path = (os.path.join(args.trace,
+                                   f"trace.{instance}.jsonl")
+                      if os.path.isdir(args.trace) else args.trace)
+        obs_trace.configure(trace_path)
     my_idx = fed.party_index(name)
     columns = {lab: col for lab, col
                in _federation_columns(fed, args.rho).items()
@@ -1144,20 +1216,36 @@ def cmd_federation_party(args):
         peers[peer] = (host, int(port))
     accepts = any(fed.party_index(q if p == name else p) < my_idx
                   for p, q in fed.party_links(name))
+    registry = Registry()
+    party_box: list = []
+    obs_port = None
+    if args.obs_port is not None:
+        # the scrape surface up before any banner: FleetCollector,
+        # obs top --federation and SLO paging can watch the whole run
+        _srv, obs_port = start_obs_server(
+            registry,
+            stats_fn=lambda: (party_box[0].stats_snapshot()
+                              if party_box else
+                              {"kind": "federation_party",
+                               "instance": instance, "party": name,
+                               "fed": fed.fed, "starting": True}),
+            port=args.obs_port)
+
+    def banner(**extra):
+        doc = {"federation": fed.fed, "name": name,
+               "instance": instance}
+        if obs_port is not None:
+            doc["obs_port"] = obs_port
+        doc.update(extra)
+        print(json.dumps({"party": doc}), flush=True)
 
     def on_listening(host, port):
-        print(json.dumps({"party": {"federation": fed.fed,
-                                    "name": name,
-                                    "listening": [host, port]}}),
-              flush=True)
+        banner(listening=[host, port])
 
     if not accepts:
         # pure dialers still print a banner: drivers parse every
         # party's stdout uniformly (banner lines, then the result)
-        print(json.dumps({"party": {"federation": fed.fed,
-                                    "name": name,
-                                    "dialing": sorted(peers)}}),
-              flush=True)
+        banner(dialing=sorted(peers))
     audit = AuditTrail(args.audit) if args.audit else None
     ledger = PrivacyLedger(args.budget, path=args.ledger, audit=audit)
     res = serve_federation_party(
@@ -1167,7 +1255,8 @@ def cmd_federation_party(args):
         max_retries=args.max_retries,
         connect_timeout_s=args.connect_timeout,
         recv_timeout_s=args.recv_timeout, engine=args.engine,
-        on_listening=on_listening)
+        on_listening=on_listening, registry=registry,
+        instance=args.instance, on_party=party_box.append)
     print(json.dumps({"result": {"party": res.party, "fed": res.fed,
                                  "cells": res.cells, "eps": res.eps,
                                  "stats": res.stats}}, indent=2))
@@ -1226,6 +1315,13 @@ def cmd_federation_scan(args):
         out["balance"] = balances
     print(json.dumps(out, indent=2))
     if not ok:
+        from dpcorr.obs import recorder as obs_recorder
+
+        obs_recorder.trigger(
+            "federation_scan_violation",
+            violations=cross["violations"],
+            transcripts=sorted(os.path.basename(t)
+                               for t in transcripts))
         sys.exit(1)
 
 
@@ -1641,9 +1737,50 @@ def main(argv=None):
                      help="multi-instance view: comma-separated "
                           "name=url targets (bare urls get positional "
                           "names); overrides --url")
+    pot.add_argument("--federation", default=None, metavar="TARGETS",
+                     help="federation view: comma-separated name=url "
+                          "targets pointing at party --obs-port "
+                          "endpoints; overrides --url and --fleet")
     pot.add_argument("--once", action="store_true",
                      help="render one frame and exit (scripting/CI)")
     pot.set_defaults(fn=cmd_obs_top, platform=None, jax_free=True)
+    pop = obs_sub.add_parser(
+        "provenance", help="federation ε-provenance DAG (ISSUE 13): "
+        "merge per-party transcripts/audits/journals against the "
+        "plan, prove exactly-once charging + byte-identical reuse at "
+        "the 2fε(k-1) optimum; exit 1 names the offending party")
+    pop.add_argument("--plan", required=True,
+                     help="federation plan JSON (`dpcorr federation "
+                          "plan` output or its `plan` field)")
+    pop.add_argument("--transcript-dir", dest="transcript_dir",
+                     default=None,
+                     help="directory of {session}.{party}.jsonl "
+                          "pair-link transcripts (party inferred from "
+                          "the filename)")
+    pop.add_argument("--transcript", action="append", default=None,
+                     metavar="NAME=PATH",
+                     help="explicit party transcript (repeatable; "
+                          "bare PATH infers the party from the "
+                          "filename)")
+    pop.add_argument("--audit", action="append", default=None,
+                     metavar="NAME=PATH",
+                     help="party audit trail (repeatable) — required "
+                          "to *prove* exactly-once charging rather "
+                          "than infer it from transcripts")
+    pop.add_argument("--journal-dir", dest="journal_dir", default=None,
+                     help="session-journal directory (adds resume "
+                          "lineage to round nodes)")
+    pop.add_argument("--out", default=None,
+                     help="write the provenance JSON document here")
+    pop.add_argument("--dot", default=None,
+                     help="write the Graphviz DOT rendering here")
+    pop.add_argument("--cell", default=None, metavar="I,J",
+                     help="print one cell's full story (rounds, "
+                          "artifacts, charges) instead of the summary")
+    pop.add_argument("--json", action="store_true",
+                     help="print the full document to stdout")
+    pop.set_defaults(fn=cmd_obs_provenance, platform=None,
+                     jax_free=True)
     pof = obs_sub.add_parser("fleet", help="fleet telemetry plane "
                              "(ISSUE 11): scrape + merge N instances, "
                              "union spools, replay the fleet ε table; "
@@ -1967,7 +2104,21 @@ def main(argv=None):
     pft.add_argument("--audit", default=None,
                      help="budget audit-trail JSONL path (obs.audit)")
     pft.add_argument("--trace", default=None,
-                     help="span-trace JSONL path")
+                     help="span-trace JSONL path — or a directory, "
+                          "which spools to trace.<instance>.jsonl so "
+                          "k parties can share one flag value")
+    pft.add_argument("--instance", default=None,
+                     help="instance name for telemetry (the "
+                          "dpcorr_federation_instance_info self-claim "
+                          "the fleet merge cross-checks, span-spool "
+                          "filenames, the JSON banner); default: "
+                          "--name")
+    pft.add_argument("--obs-port", dest="obs_port", type=int,
+                     default=None, metavar="PORT",
+                     help="serve /metrics + /stats + POST /obs/trigger "
+                          "on this port (0: ephemeral, announced in "
+                          "the banner) for FleetCollector, obs top "
+                          "--federation and SLO burn-rate paging")
     pft.add_argument("--transcript-dir", dest="transcript_dir",
                      default=None,
                      help="per-link wire transcript directory")

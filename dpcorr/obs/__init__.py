@@ -40,6 +40,16 @@ package is the one spine they now share:
 - :mod:`devicemon` — per-device memory watermarks + transfer counters
   split per device, published as ``dpcorr_device_*`` gauges and
   stamped into bench artifacts.
+- :mod:`provenance` — the federation ε-provenance DAG (ISSUE 13):
+  per-party transcripts + audit trails + journals merged into
+  artifacts → charges → rounds → cells, structurally proving
+  exactly-once charging and byte-identical reuse at the
+  ``2·f·ε·(k−1)`` optimum; typed divergences name the offending
+  party. ``dpcorr obs provenance`` exports JSON + DOT, jax-free.
+- :mod:`endpoint` — the mini scrape surface for non-serve processes
+  (``dpcorr federation party --obs-port``): ``/metrics`` + ``/stats``
+  + ``POST /obs/trigger``, byte-compatible with serve's routes so the
+  fleet collector, ``obs top`` and SLO paging work unchanged.
 
 See docs/OBSERVABILITY.md for the span model, metric names and the
 audit-trail format.
@@ -56,6 +66,10 @@ from dpcorr.obs.cost import (  # noqa: F401
     CostRegistry,
     ExemplarStore,
     split_exact,
+)
+from dpcorr.obs.endpoint import (  # noqa: F401
+    make_obs_server,
+    start_obs_server,
 )
 from dpcorr.obs.fleet import (  # noqa: F401
     FleetCollector,
@@ -78,6 +92,12 @@ from dpcorr.obs.metrics import (  # noqa: F401
     default_registry,
     parse_exposition,
 )
+from dpcorr.obs.provenance import (  # noqa: F401
+    DIVERGENCE_KINDS,
+    Provenance,
+    build_provenance,
+    discover_federation,
+)
 from dpcorr.obs.recorder import (  # noqa: F401
     FlightRecorder,
     read_dump,
@@ -87,6 +107,8 @@ from dpcorr.obs.slo import (  # noqa: F401
     Alert,
     BurnRateEngine,
     Objective,
+    federation_eps_burn_objectives,
+    federation_round_latency_objective,
     http_trigger_hook,
     recorder_trigger_hook,
 )

@@ -214,6 +214,111 @@ def render_fleet_frame(snapshot, now: float | None = None) -> str:
     return "\n".join(lines)
 
 
+def render_federation_frame(snapshot, now: float | None = None) -> str:
+    """One federation frame from a :class:`~dpcorr.obs.fleet.FleetSnapshot`
+    of party processes (``dpcorr federation party --obs-port``): one
+    row per party — matrix cells completed, link count, ε spent against
+    the plan share, round count and mean round latency, release-cache
+    hits/builds — plus a federation line proving all live parties agree
+    on the fed id and the single plan-derived trace id."""
+    lines = []
+    ts = time.strftime("%H:%M:%S",
+                       time.localtime(now if now is not None
+                                      else time.time()))
+    n_live = len(snapshot.live())
+    n_all = len(snapshot.instances)
+    lines.append(f"dpcorr obs top --federation  ·  {ts}  ·  "
+                 f"{n_live}/{n_all} parties up")
+    lines.append("-" * 76)
+    lines.append(f"{'party':<12} {'cells':>9} {'links':>5} "
+                 f"{'ε spent/share':>15} {'rounds':>6} "
+                 f"{'rt mean ms':>10} {'cache h/b':>9}")
+    families = snapshot.families()
+    feds, traces, done_total, cells_total = set(), set(), 0, 0
+    for name in sorted(snapshot.instances):
+        rec = snapshot.instances[name]
+        if rec.get("error") is not None:
+            lines.append(f"{name:<12} DOWN  {rec['error']}")
+            continue
+        stats = rec.get("stats") or {}
+        fams = families.get(name, {})
+
+        def total(family: str, sample: str | None = None,
+                  **match) -> float:
+            fam = fams.get(family)  # noqa: B023 (read-only loop var)
+            if fam is None:
+                return 0.0
+            want = sample if sample is not None else family
+            return sum(v for s, ls, v in fam.samples
+                       if s == want
+                       and all(dict(ls).get(k) == mv
+                               for k, mv in match.items()))
+
+        feds.add(stats.get("fed"))
+        traces.add(stats.get("trace_id"))
+        done = int(stats.get("cells_done", 0))
+        out_of = int(stats.get("cells_total", 0))
+        done_total, cells_total = done_total + done, max(cells_total,
+                                                         out_of)
+        eps = stats.get("eps", {})
+        rounds = total("dpcorr_federation_rounds_total")
+        rt_count = total("dpcorr_federation_round_latency_seconds",
+                         "dpcorr_federation_round_latency_seconds_count")
+        rt_sum = total("dpcorr_federation_round_latency_seconds",
+                       "dpcorr_federation_round_latency_seconds_sum")
+        rt_mean = (rt_sum / rt_count * 1e3) if rt_count else 0.0
+        hits = total("dpcorr_federation_release_cache_total",
+                     outcome="hit")
+        builds = total("dpcorr_federation_release_cache_total",
+                       outcome="build")
+        lines.append(
+            f"{name:<12} {done:>4}/{out_of:<4} "
+            f"{len(stats.get('links', ())):>5} "
+            f"{_fmt_eps(eps.get('spent', 0.0)):>7}/"
+            f"{_fmt_eps(eps.get('share', 0.0)):<7} "
+            f"{rounds:>6g} {rt_mean:>10.2f} "
+            f"{hits:>4g}/{builds:<4g}")
+    lines.append("-" * 76)
+    if n_live:
+        fed = feds.pop() if len(feds) == 1 else f"DISAGREE {sorted(feds)}"
+        trace = (traces.pop() if len(traces) == 1
+                 else f"DISAGREE {sorted(traces)}")
+        lines.append(f"federation  : {fed}   trace {trace}   "
+                     f"cells {done_total} done "
+                     f"(matrix {cells_total})")
+    else:
+        lines.append("federation  : no live parties")
+    return "\n".join(lines)
+
+
+def run_federation_top(targets, interval_s: float = 2.0,
+                       once: bool = False, out=None,
+                       max_frames: int | None = None) -> int:
+    """The ``dpcorr obs top --federation`` loop over party
+    ``--obs-port`` endpoints; exit contract mirrors
+    :func:`run_fleet_top`."""
+    from dpcorr.obs.fleet import FleetCollector
+    emit = out if out is not None else print
+    collector = FleetCollector(targets)
+    frames = 0
+    while True:
+        snapshot = collector.scrape()
+        if not snapshot.live() and frames == 0:
+            emit("obs top --federation: no live parties:")
+            for name, err in sorted(snapshot.errors().items()):
+                emit(f"  {name}: {err}")
+            return 1
+        frame = render_federation_frame(snapshot)
+        if once:
+            emit(frame)
+            return 0
+        emit(_CLEAR + frame)
+        frames += 1
+        if max_frames is not None and frames >= max_frames:
+            return 0
+        time.sleep(interval_s)
+
+
 def run_fleet_top(targets, interval_s: float = 2.0, once: bool = False,
                   out=None, max_frames: int | None = None) -> int:
     """The ``dpcorr obs top --fleet`` loop. Exit 0 after any frame with

@@ -57,6 +57,9 @@ TRIGGER_REASONS = (
     "cli",                 # dpcorr obs dump --live / tests
     "shutdown",            # orderly close with --flight-recorder armed
     "slo_page",            # a burn-rate page armed this instance (obs.slo)
+    "federation_unhandled",       # a federation party died unexpectedly
+    "federation_resume_refused",  # a pair link's resume handshake refused
+    "federation_scan_violation",  # cross-pair scan / provenance divergence
 )
 
 

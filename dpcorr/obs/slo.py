@@ -293,6 +293,47 @@ class BurnRateEngine:
         return {f"{o}/{i}": s for (o, i), s in sorted(self._state.items())}
 
 
+# ------------------------------------- federation objectives (ISSUE 13) ----
+def federation_round_latency_objective(
+        name: str = "fed-round-latency", threshold_s: float = 2.5,
+        target: float = 0.05) -> Objective:
+    """Round-trip latency objective over a federation party's
+    ``dpcorr_federation_round_latency_seconds`` histogram: a round is
+    *bad* above ``threshold_s`` (which must be an exact
+    ``LATENCY_BUCKETS`` bound), ``target`` is the tolerated bad
+    fraction. Feed the party scrapes (``--obs-port``) to a
+    :class:`BurnRateEngine` with :func:`http_trigger_hook` pointed at
+    the same ports and a page dumps the *offending party's* flight
+    recorder, in-process."""
+    return Objective(
+        name=name, kind="latency", target=target,
+        histogram="dpcorr_federation_round_latency_seconds",
+        threshold_s=threshold_s)
+
+
+def federation_eps_burn_objectives(plan, makespan_s: float,
+                                   target: float = 1.0) -> tuple:
+    """One ε-burn-vs-plan-share objective per federation party: party
+    P's sustainable rate is its :meth:`FederationPlan.party_eps` share
+    spread over ``makespan_s`` (the matrix duration the schedule is
+    sized for), so burn rate 1.0 means "spending exactly the plan
+    share, on schedule" and a party re-charging artifacts or running
+    ahead of plan pages. Each party process only exposes its *own*
+    ``dpcorr_federation_ledger_spent_eps`` gauge, so evaluate each
+    objective against its matching instance — pair alerts on
+    ``alert.objective.endswith(alert.instance)`` or run one engine per
+    party."""
+    if makespan_s <= 0:
+        raise ValueError(f"makespan_s must be > 0, got {makespan_s}")
+    shares = plan.party_eps()
+    return tuple(
+        Objective(name=f"fed-eps-burn-{party}", kind="eps_burn",
+                  target=target,
+                  eps_series="dpcorr_federation_ledger_spent_eps",
+                  eps_per_s=shares[party] / makespan_s)
+        for party, _cols in plan.parties if shares[party] > 0)
+
+
 # ------------------------------------------------- recorder arming ----
 def recorder_trigger_hook(**extra) -> Callable[[Alert], None]:
     """In-process page hook: dump the installed flight recorder with

@@ -50,6 +50,7 @@ import numpy as np
 from dpcorr import chaos
 from dpcorr.obs import from_wire_headers, split_exact, tracer
 from dpcorr.obs import recorder as obs_recorder
+from dpcorr.obs.metrics import LATENCY_BUCKETS, Registry
 from dpcorr.protocol.gate import ReleaseGate
 from dpcorr.protocol.journal import SessionJournal
 from dpcorr.protocol.matrix import FederationPlan
@@ -68,6 +69,7 @@ from dpcorr.protocol.transport import (
     InProcTransport,
     ReconnectingTcpLink,
     ReliableChannel,
+    SessionResumeRefused,
     TransportError,
     TransportTimeout,
     tcp_accept,
@@ -132,27 +134,24 @@ class _PairLink(SessionEndpoint):
         self.peer = peer
         self.p, self.q = p, q
         self.initiator = lo
+        # re-point the endpoint's gate at an observed one: every charge
+        # this link lands (gated send, replay) moves the owner's
+        # ε-burn gauge without touching the budget discipline
+        self._gate = ReleaseGate(owner.ledger,
+                                 on_charge=owner.note_charge)
 
     # ------------------------------------------------------ handshake ----
-    def _handshake(self) -> None:
+    def _handshake(self, first) -> None:
         """Same two frames as the two-party opening, pinning the
         *federation* hash: both ends prove they compiled the identical
         plan (schedule, rounds, charge assignment included) before any
         ε moves. The initiator also names the pair — a link dialed to
-        the wrong peer fails here, not mid-round."""
+        the wrong peer fails here, not mid-round. ``first`` is the
+        acceptor's already-received ``hello`` (the span parented on its
+        headers was opened in :meth:`run` before this call); the
+        initiator passes ``None``."""
         plan = self.plan
         if self.initiator:
-            if self.journal is not None and self.journal.trace_id:
-                self._span = tracer().start_span(
-                    "federation.link", trace_id=self.journal.trace_id,
-                    party=self.sender, session=self.session,
-                    family=plan.family, resumed=True)
-            else:
-                self._span = tracer().start_span(
-                    "federation.link", party=self.sender,
-                    session=self.session, family=plan.family)
-                if self.journal is not None and self._span.trace_id:
-                    self.journal.set_trace(self._span.trace_id)
             payload = {"fed": plan.to_public(),
                        "fed_hash": plan.fed_hash(),
                        "pair": [self.p, self.q]}
@@ -162,17 +161,11 @@ class _PairLink(SessionEndpoint):
             self._send_plain(self._msg("hello", payload))
             self._recv("hello_ack")
             return
-        first = self._recv("hello")
-        self._span = tracer().start_span(
-            "federation.link", parent=from_wire_headers(first.headers),
-            party=self.sender, session=self.session, family=plan.family)
         if self.journal is not None:
             token = first.payload.get("resume_token")
             if token:
                 self.journal.adopt_token(token)
                 self._register_session_info()
-            if self._span.trace_id:
-                self.journal.set_trace(self._span.trace_id)
         theirs = first.payload.get("fed_hash")
         if theirs != plan.fed_hash() \
                 or first.payload.get("pair") != [self.p, self.q]:
@@ -188,19 +181,29 @@ class _PairLink(SessionEndpoint):
     # --------------------------------------------------------- rounds ----
     def _drive_releaser(self) -> list:
         out = []
+        link = f"{self.p}-{self.q}"
         for r, cells in enumerate(self.plan.link_rounds(self.p, self.q)):
-            labels = self.plan.round_x_labels(self.p, self.q, r)
-            artifacts = {lab: self.owner.release_artifact(lab)
-                         for lab in labels}
-            rc = self.plan.round_charges(self.p, self.q, r)["release"]
-            chaos.point("federation.pre_release")
-            payload = {"round": r, "cells": [list(c) for c in cells],
-                       "artifacts": artifacts,
-                       "charged": list(rc["labels"])}
-            self._send_gated(self._msg("release", payload),
-                             rc["charges"])
-            final = self._recv("result")
-            out.extend(self._check_result(final, r, cells))
+            t0 = time.perf_counter()
+            with tracer().span("federation.round", parent=self._span,
+                               link=link, round=r, role="release",
+                               cells=len(cells)):
+                labels = self.plan.round_x_labels(self.p, self.q, r)
+                artifacts = {lab: self.owner.release_artifact(lab)
+                             for lab in labels}
+                rc = self.plan.round_charges(self.p, self.q,
+                                             r)["release"]
+                chaos.point("federation.pre_release")
+                payload = {"round": r,
+                           "cells": [list(c) for c in cells],
+                           "artifacts": artifacts,
+                           "charged": list(rc["labels"])}
+                self._send_gated(self._msg("release", payload),
+                                 rc["charges"])
+                final = self._recv("result")
+                out.extend(self._check_result(final, r, cells))
+            self.owner.note_cells(cells, "link")
+            self.owner.note_round(link, "release",
+                                  time.perf_counter() - t0)
         return out
 
     def _check_result(self, msg, r: int, cells) -> list:
@@ -283,34 +286,51 @@ class _PairLink(SessionEndpoint):
 
         plan = self.plan
         out = []
+        link = f"{self.p}-{self.q}"
         for r, cells in enumerate(plan.link_rounds(self.p, self.q)):
             msg = self._recv("release")
-            decoded = self._validate_round(msg, r, cells)
-            chaos.point("federation.pre_finish")
-            keys = [self.owner.finisher_key(plan.label(j))
-                    for _i, j in cells]
-            rels = [decoded[plan.label(i)] for i, _j in cells]
-            cols = [self.owner.column(plan.label(j)) for _i, j in cells]
-            t0 = time.perf_counter()
-            with tracer().span("federation.finish", parent=self._span,
-                               cells=len(cells)):
-                rho, lo, hi = sr.finish_batch(
-                    plan.family, keys, rels, cols, plan.eps, plan.eps,
-                    plan.alpha, plan.normalise,
-                    engine=self.owner.engine)
-            finish_s = time.perf_counter() - t0
-            result_cells = [
-                [int(i), int(j), float(rho[b]), float(lo[b]),
-                 float(hi[b])]
-                for b, (i, j) in enumerate(cells)]
-            rc = plan.round_charges(self.p, self.q, r)["result"]
-            self._send_gated(
-                self._msg("result", {"round": r, "cells": result_cells,
-                                     "charged": list(rc["labels"])}),
-                rc["charges"])
-            self.owner.attribute_round(
-                pair=(self.p, self.q), cells=cells, finish_s=finish_s,
-                n_bytes=len(msg.encode()))
+            rt0 = time.perf_counter()
+            with tracer().span("federation.round", parent=self._span,
+                               link=link, round=r, role="finish",
+                               cells=len(cells)) as rsp:
+                decoded = self._validate_round(msg, r, cells)
+                chaos.point("federation.pre_finish")
+                keys = [self.owner.finisher_key(plan.label(j))
+                        for _i, j in cells]
+                rels = [decoded[plan.label(i)] for i, _j in cells]
+                cols = [self.owner.column(plan.label(j))
+                        for _i, j in cells]
+                t0 = time.perf_counter()
+                with tracer().span("federation.finish",
+                                   cells=len(cells)):
+                    rho, lo, hi = sr.finish_batch(
+                        plan.family, keys, rels, cols, plan.eps,
+                        plan.eps, plan.alpha, plan.normalise,
+                        engine=self.owner.engine)
+                finish_s = time.perf_counter() - t0
+                result_cells = [
+                    [int(i), int(j), float(rho[b]), float(lo[b]),
+                     float(hi[b])]
+                    for b, (i, j) in enumerate(cells)]
+                for i, j in cells:
+                    # per-cell completion markers: instantaneous child
+                    # spans so the unioned timeline shows exactly when
+                    # each matrix cell finished, on which link
+                    with tracer().span("federation.cell", parent=rsp,
+                                       i=int(i), j=int(j), link=link):
+                        pass
+                rc = plan.round_charges(self.p, self.q, r)["result"]
+                self._send_gated(
+                    self._msg("result",
+                              {"round": r, "cells": result_cells,
+                               "charged": list(rc["labels"])}),
+                    rc["charges"])
+                self.owner.attribute_round(
+                    pair=(self.p, self.q), cells=cells,
+                    finish_s=finish_s, n_bytes=len(msg.encode()))
+            self.owner.note_cells(cells, "link")
+            self.owner.note_round(link, "finish",
+                                  time.perf_counter() - rt0)
             out.extend(tuple(c) for c in result_cells)
         return out
 
@@ -318,13 +338,48 @@ class _PairLink(SessionEndpoint):
         """All rounds of this pair session; returns the link's cells as
         ``(i, j, rho, lo, hi)`` tuples. A journaled link that already
         finished returns its terminal result without touching the wire
-        or the ledger — the same idempotency level as Party.run."""
+        or the ledger — the same idempotency level as Party.run.
+
+        Every link of every party joins ONE federation trace: the
+        initiator pins the deterministic plan-derived trace id
+        (``FederationPlan.trace_id``; a resumed journal's recorded
+        trace wins, and is itself that same id for any run of this
+        code), the acceptor parents on the hello's wire headers and
+        falls back to the same pin when the initiator runs untraced."""
         if self.journal is not None:
             if self.journal.status == "finished" and self.journal.result:
                 return [tuple(c) for c in self.journal.result["cells"]]
-            self._attach_journal()
+            try:
+                self._attach_journal()
+            except SessionResumeRefused as e:
+                obs_recorder.trigger(
+                    "federation_resume_refused", party=self.sender,
+                    peer=self.peer, session=self.session,
+                    fed=self.plan.fed, detail=str(e))
+                raise
+        plan = self.plan
+        first = None
+        if self.initiator:
+            resumed = bool(self.journal is not None
+                           and self.journal.trace_id)
+            span = tracer().start_span(
+                "federation.link",
+                trace_id=(self.journal.trace_id if resumed
+                          else plan.trace_id()),
+                party=self.sender, session=self.session,
+                family=plan.family, resumed=resumed)
+        else:
+            first = self._recv("hello")
+            span = tracer().start_span(
+                "federation.link",
+                parent=from_wire_headers(first.headers),
+                trace_id=plan.trace_id(), party=self.sender,
+                session=self.session, family=plan.family)
+        self._span = span
+        if self.journal is not None and span.trace_id:
+            self.journal.set_trace(span.trace_id)
         try:
-            self._handshake()
+            self._handshake(first)
             cells = (self._drive_releaser() if self.initiator
                      else self._drive_finisher())
             # terminal symmetry with the two-party roles: whichever side
@@ -332,12 +387,12 @@ class _PairLink(SessionEndpoint):
             # loss is possible (transport.drain decides)
             self._linger()
         finally:
-            if self._span is not None:
-                self._span.end()
+            span.end()
             self.transcript.close()
         if self.journal is not None:
             self.journal.set_result({"cells": [list(c) for c in cells]})
             self.journal.finish()
+        self.owner.note_link_done(self.p, self.q)
         return cells
 
 
@@ -357,14 +412,20 @@ class FederationParty:
                  channels: dict | None = None, *,
                  journals: dict | None = None,
                  transcripts: dict | None = None,
-                 recv_timeout_s: float = 30.0, engine: str = "exact"):
+                 recv_timeout_s: float = 30.0, engine: str = "exact",
+                 registry: Registry | None = None,
+                 instance: str | None = None):
         plan.party_index(name)  # unknown party fails loudly here
         self.name = name
         self.plan = plan
         self.ledger = ledger or PrivacyLedger(DEFAULT_BUDGET)
         self.engine = engine
         self.recv_timeout_s = recv_timeout_s
-        self._gate = ReleaseGate(self.ledger)
+        self.instance = instance
+        self.registry = registry if registry is not None else Registry()
+        self._init_metrics()
+        self._gate = ReleaseGate(self.ledger,
+                                 on_charge=self.note_charge)
         self._channels = dict(channels or {})
         self._journals = dict(journals or {})
         self._transcripts = dict(transcripts or {})
@@ -386,7 +447,111 @@ class FederationParty:
         self._lock = threading.Lock()
         self._artifacts: dict = {}   # guarded by: _lock
         self._costs: list = []       # guarded by: _lock
+        self._done: set = set()      # guarded by: _lock
         self._first = _first_cells(plan)
+
+    # -------------------------------------------------------- metrics ----
+    def _init_metrics(self) -> None:
+        """The party-process telemetry plane (ISSUE 13): one registry
+        backs both the ``--obs-port`` /metrics scrape (FleetCollector-
+        compatible: the instance-labelled info gauge is the self-claim
+        the fleet merge cross-checks) and /stats. All series carry
+        enough labels for the SLO engine's federation objectives
+        (round latency, ε-burn vs plan share) to point at them."""
+        r = self.registry
+        plan = self.plan
+        self._m_info = r.gauge(
+            "dpcorr_federation_instance_info",
+            "federation party identity: constant 1, labelled with the "
+            "fleet instance name, party and federation id",
+            labelnames=("instance", "party", "fed"))
+        if self.instance:
+            self._m_info.set(1, instance=str(self.instance),
+                             party=self.name, fed=plan.fed)
+        self._m_round_latency = r.histogram(
+            "dpcorr_federation_round_latency_seconds",
+            "wall time of one pair-link round (release->result on the "
+            "releaser, recv->result-sent on the finisher)",
+            buckets=LATENCY_BUCKETS)
+        self._m_rounds = r.counter(
+            "dpcorr_federation_rounds_total",
+            "pair-link rounds completed", labelnames=("link", "role"))
+        self._m_cells = r.counter(
+            "dpcorr_federation_cells_completed_total",
+            "matrix cells this party finished or received",
+            labelnames=("venue",))
+        self._m_cache = r.counter(
+            "dpcorr_federation_release_cache_total",
+            "column release artifact cache outcomes (a hit is the "
+            "byte-identical reuse the eps optimum rests on)",
+            labelnames=("label", "outcome"))
+        self._m_links = r.counter(
+            "dpcorr_federation_links_finished_total",
+            "pair links run to completion", labelnames=("link",))
+        self._m_spent = r.gauge(
+            "dpcorr_federation_ledger_spent_eps",
+            "eps this party's ledger has spent on its own account",
+            labelnames=("ledger",))
+        self._m_share = r.gauge(
+            "dpcorr_federation_plan_share_eps",
+            "this party's plan-derived share of the federation "
+            "optimum (constant; burn above it is an SLO violation)",
+            labelnames=("ledger",))
+        self._m_share.set(plan.party_eps().get(self.name, 0.0),
+                          ledger=self.name)
+        self.note_charge(None)
+
+    def note_charge(self, charges) -> None:
+        """Gate observer: refresh the ε-burn gauge from the ledger
+        after any charge leg lands (the gauge reads the ledger, not the
+        increment, so refunds and idempotent resume re-charges can
+        never drift it)."""
+        try:
+            self._m_spent.set(self.ledger.spent(self.name),
+                              ledger=self.name)
+        except Exception:
+            pass
+
+    def note_round(self, link: str, role: str, seconds: float) -> None:
+        self._m_round_latency.observe(seconds)
+        self._m_rounds.inc(link=link, role=role)
+
+    def note_link_done(self, p: str, q: str) -> None:
+        self._m_links.inc(link=f"{p}-{q}")
+
+    def note_cells(self, cells, venue: str) -> None:
+        with self._lock:
+            fresh = [c for c in cells
+                     if (int(c[0]), int(c[1])) not in self._done]
+            self._done.update((int(c[0]), int(c[1])) for c in fresh)
+        if fresh:
+            self._m_cells.inc(len(fresh), venue=venue)
+
+    def stats_snapshot(self) -> dict:
+        """The /stats document for the party obs endpoint — shaped so
+        the fleet console's federation frame and FleetCollector's
+        per-instance stats map both read it directly."""
+        plan = self.plan
+        with self._lock:
+            done = len(self._done)
+            cached = sorted(self._artifacts)
+        spent = self.ledger.spent(self.name)
+        return {
+            "kind": "federation_party",
+            "instance": self.instance,
+            "party": self.name,
+            "fed": plan.fed,
+            "trace_id": plan.trace_id(),
+            "family": plan.family,
+            "cells_done": done,
+            "cells_total": len(plan.cells()),
+            "links": [f"{p}-{q}" for p, q in plan.party_links(self.name)],
+            "eps": {"spent": spent,
+                    "share": plan.party_eps().get(self.name, 0.0),
+                    "optimal": plan.optimal_eps(),
+                    "naive_per_cell": plan.naive_eps()},
+            "artifacts_cached": cached,
+        }
 
     # ----------------------------------------------------------- keys ----
     def _root(self, label: str, side: str):
@@ -412,17 +577,28 @@ class FederationParty:
         with self._lock:
             env = self._artifacts.get(label)
             if env is not None:
+                self._m_cache.inc(label=label, outcome="hit")
+                with tracer().span("federation.release_cache",
+                                   label=label, hit=True):
+                    pass
                 return env
-            from dpcorr.models.estimators import split_reference as sr
+            with tracer().span("federation.release_cache",
+                               label=label, hit=False):
+                from dpcorr.models.estimators import (
+                    split_reference as sr,
+                )
 
-            plan = self.plan
-            rel = sr.party_release(plan.family, self._root(label, "x"),
-                                   "x", self._columns[label], plan.eps,
-                                   plan.eps, plan.normalise)
-            kinds = sr.RELEASE_KINDS[plan.family]
-            env = {name: encode_array(np.asarray(arr), kind=kinds[name])
-                   for name, arr in rel.items()}
-            self._artifacts[label] = env
+                plan = self.plan
+                rel = sr.party_release(
+                    plan.family, self._root(label, "x"), "x",
+                    self._columns[label], plan.eps, plan.eps,
+                    plan.normalise)
+                kinds = sr.RELEASE_KINDS[plan.family]
+                env = {name: encode_array(np.asarray(arr),
+                                          kind=kinds[name])
+                       for name, arr in rel.items()}
+                self._artifacts[label] = env
+            self._m_cache.inc(label=label, outcome="build")
             return env
 
     # ----------------------------------------------------------- cost ----
@@ -476,12 +652,18 @@ class FederationParty:
         for i, j in cells:
             li, lj = plan.label(i), plan.label(j)
             t0 = time.perf_counter()
-            rho, lo, hi = sr.split_estimate(
-                plan.family, self._root(li, "x"), self.finisher_key(lj),
-                self._columns[li], self._columns[lj], plan.eps,
-                plan.eps, alpha=plan.alpha, normalise=plan.normalise)
+            with tracer().span("federation.cell",
+                               parent=getattr(self, "_matrix_span",
+                                              None),
+                               i=int(i), j=int(j), venue="local"):
+                rho, lo, hi = sr.split_estimate(
+                    plan.family, self._root(li, "x"),
+                    self.finisher_key(lj), self._columns[li],
+                    self._columns[lj], plan.eps, plan.eps,
+                    alpha=plan.alpha, normalise=plan.normalise)
             cell_s = time.perf_counter() - t0
             out.append((i, j, float(rho), float(lo), float(hi)))
+            self.note_cells([(i, j)], "local")
             unit_new = sum(
                 1 for art in (("x", li), ("y", lj))
                 if self._first[art] == (i, j))
@@ -504,7 +686,10 @@ class FederationParty:
         channels when the restarted party re-attaches."""
         plan = self.plan
         span = tracer().start_span("federation.matrix",
-                                   party=self.name, fed=plan.fed)
+                                   trace_id=plan.trace_id(),
+                                   party=self.name, fed=plan.fed,
+                                   instance=self.instance or self.name)
+        self._matrix_span = span
         results: dict = {}
         try:
             for c in self._run_local():
@@ -814,7 +999,10 @@ def serve_federation_party(name: str, plan: FederationPlan, columns, *,
                            connect_timeout_s: float = 30.0,
                            recv_timeout_s: float = 30.0,
                            engine: str = "exact",
-                           on_listening=None) -> FederationResult:
+                           on_listening=None,
+                           registry: Registry | None = None,
+                           instance: str | None = None,
+                           on_party=None) -> FederationResult:
     """One real party process of a multi-process federation (the
     ``dpcorr federation party`` CLI body). Topology is plan-derived:
     for each link the *lower* party dials and the higher listens, so a
@@ -893,7 +1081,11 @@ def serve_federation_party(name: str, plan: FederationPlan, columns, *,
         party = FederationParty(
             name, plan, columns, ledger, channels, journals=journals,
             transcripts=transcripts, recv_timeout_s=recv_timeout_s,
-            engine=engine)
+            engine=engine, registry=registry, instance=instance)
+        if on_party is not None:
+            # the CLI's --obs-port endpoint wires its /stats snapshot
+            # to the live party object through this hook
+            on_party(party)
         return party.run()
     finally:
         for link in links:

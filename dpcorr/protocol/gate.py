@@ -46,10 +46,26 @@ class ReleaseGate:
     ``charge``/``refund`` calls — so per-user accounting rides the
     gate's charge-before-send and refund-on-transport-failure
     discipline unchanged, and the receipt's ``eps`` (the transcript
-    column) stays party-leg-only by construction."""
+    column) stays party-leg-only by construction.
 
-    def __init__(self, ledger: PrivacyLedger):
+    ``on_charge`` (optional) is called with the charge mapping after
+    every *successful* charge leg — gated send delivered, local charge
+    landed, replay charge landed — and never on the refund path. It is
+    a telemetry observer (the federation party's ε-burn gauges hang
+    here); observer failures are swallowed so metrics can never break
+    the budget discipline they watch."""
+
+    def __init__(self, ledger: PrivacyLedger, on_charge=None):
         self.ledger = ledger
+        self._on_charge = on_charge
+
+    def _observe(self, charges: Mapping[str, float]) -> None:
+        if self._on_charge is None:
+            return
+        try:
+            self._on_charge(dict(charges))
+        except Exception:
+            pass
 
     def send_release(self, channel: ReliableChannel, body: dict,
                      charges: Mapping[str, float],
@@ -81,6 +97,7 @@ class ReleaseGate:
             raise
         chaos.point("gate.post_send")
         receipt["eps"] = float(sum(charges.values()))
+        self._observe(charges)
         return receipt
 
     def charge_local(self, charges: Mapping[str, float],
@@ -95,6 +112,7 @@ class ReleaseGate:
         the id once. Returns the total ε charged."""
         self.ledger.charge(charges, trace_id=trace_id,
                            charge_id=charge_id)
+        self._observe(charges)
         return float(sum(charges.values()))
 
     def charge_replayed(self, charges: Mapping[str, float],
@@ -108,3 +126,4 @@ class ReleaseGate:
         refund."""
         self.ledger.charge(charges, trace_id=trace_id,
                            charge_id=charge_id)
+        self._observe(charges)

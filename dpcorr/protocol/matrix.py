@@ -114,6 +114,14 @@ class FederationPlan:
     def fed_hash(self) -> str:
         return hashlib.sha256(canonical_encode(self.to_public())).hexdigest()
 
+    def trace_id(self) -> str:
+        """Deterministic federation-wide trace ID (ISSUE 13): every
+        party derives the same 64-bit hex id from the public plan, so
+        all k processes — and a crash-resumed rerun of any of them —
+        join ONE trace with zero coordination. Same width as the
+        tracer's random ids (``secrets.token_hex(8)``)."""
+        return self.fed_hash()[:16]
+
     @classmethod
     def from_public(cls, pub: dict) -> "FederationPlan":
         return cls(family=pub["family"], n=int(pub["n"]),

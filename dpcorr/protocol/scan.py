@@ -29,6 +29,7 @@ batch-geometry rule — test_protocol.py pins it equal to
 
 from __future__ import annotations
 
+import hashlib
 import math
 
 from dpcorr.obs.audit import replay
@@ -372,7 +373,12 @@ def scan_federation(transcripts) -> dict:
     which is an ε leak even when the bytes agree.
 
     ``transcripts`` is a list of paths or entry lists. Returns
-    ``{"ok", "violations", "labels", "transcripts"}``; the
+    ``{"ok", "violations", "labels", "transcripts", "by_label",
+    "charged"}`` — the last two are the gate's working evidence
+    (per-label encoding variants with sha256 + sessions, and each
+    side's charging venues), exported so the ε-provenance builder
+    (:mod:`dpcorr.obs.provenance`) can upgrade this pass/fail gate
+    into an explorable graph without re-walking the transcripts; the
     ``dpcorr federation scan`` CLI exits 1 on any violation."""
     by_label: dict = {}     # label -> {canonical bytes -> [session...]}
     charged_x: dict = {}    # label -> set of (session, round) charging it
@@ -417,8 +423,20 @@ def scan_federation(transcripts) -> dict:
                     f"({side}, {lab!r}) charged in {len(venues)} rounds "
                     f"{sorted(venues)} — the plan charges each artifact "
                     "exactly once")
+    label_detail = {
+        lab: [{"sha256": hashlib.sha256(enc).hexdigest(),
+               "bytes": len(enc), "sessions": sorted(sessions)}
+              for enc, sessions in sorted(
+                  variants.items(),
+                  key=lambda kv: sorted(kv[1]))]
+        for lab, variants in sorted(by_label.items())}
+    charged = {side: {lab: sorted(([s, r] for s, r in venues),
+                                  key=lambda v: (str(v[0]), str(v[1])))
+                      for lab, venues in sorted(ch.items())}
+               for side, ch in (("x", charged_x), ("y", charged_y))}
     return {"ok": not viol, "violations": viol,
-            "labels": sorted(by_label), "transcripts": n}
+            "labels": sorted(by_label), "transcripts": n,
+            "by_label": label_detail, "charged": charged}
 
 
 def federation_balance(transcripts, audit_events: list[dict],

@@ -1,0 +1,545 @@
+"""Federation observability tests (ISSUE 13): the ε-provenance DAG,
+the party obs endpoint, the single plan-derived trace, and the
+federation console/SLO surfaces.
+
+The hostile-input contract pinned here: a missing party view, a
+tampered charge amount, a re-noised artifact and a truncated
+transcript each produce a *named, typed* divergence attributing the
+offending party — never a crash — while the clean run proves
+exactly-once charging with total spend float-for-float equal to
+``FederationPlan.optimal_eps()``.
+"""
+
+import glob
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpcorr.obs import recorder as obs_recorder
+from dpcorr.obs import trace as obs_trace
+from dpcorr.obs.audit import AuditTrail
+from dpcorr.obs.endpoint import start_obs_server
+from dpcorr.obs.fleet import FleetCollector, FleetSnapshot
+from dpcorr.obs.metrics import Registry
+from dpcorr.obs.provenance import (
+    DIVERGENCE_KINDS,
+    build_provenance,
+    discover_federation,
+)
+from dpcorr.obs.recorder import FlightRecorder
+from dpcorr.protocol.federation import (
+    make_federation_parties,
+    run_federation_inproc,
+)
+from dpcorr.protocol.matrix import FederationPlan
+from dpcorr.serve.ledger import PrivacyLedger
+
+N = 512
+
+
+def _plan(eps=1.0, parties=None, n=N, family="ni_sign"):
+    return FederationPlan(
+        family=family, n=n, eps=eps,
+        parties=parties or [("p0", ["a", "b"]), ("p1", ["c"]),
+                            ("p2", ["d"])])
+
+
+def _data(plan, rho=0.6):
+    k = plan.k
+    cov = np.full((k, k), rho)
+    np.fill_diagonal(cov, 1.0)
+    xy = np.random.default_rng(plan.seed).multivariate_normal(
+        np.zeros(k), cov, size=plan.n)
+    return {lab: np.asarray(xy[:, i], np.float32)
+            for i, (_owner, lab) in enumerate(plan.columns())}
+
+
+def _run_recorded(plan, outdir):
+    """One clean federation with every record kind on disk; returns
+    (transcripts, audits, journals) maps for build_provenance."""
+    ledgers = {}
+    for name, _cols in plan.parties:
+        trail = AuditTrail(os.path.join(outdir, f"audit.{name}.jsonl"))
+        ledgers[name] = PrivacyLedger(
+            100.0, path=os.path.join(outdir, f"ledger.{name}.json"),
+            audit=trail)
+    run_federation_inproc(plan, _data(plan), ledgers=ledgers,
+                          transcript_dir=outdir, journal_dir=outdir)
+    transcripts, journals = {}, {}
+    for path in sorted(glob.glob(os.path.join(outdir, "*.jsonl"))):
+        base = os.path.basename(path)
+        if base.startswith("audit."):
+            continue
+        transcripts.setdefault(base.split(".")[-2], []).append(path)
+    for path in sorted(glob.glob(os.path.join(outdir, "journal.*.json"))):
+        journals.setdefault(
+            os.path.basename(path).split(".")[1], []).append(path)
+    audits = {name: os.path.join(outdir, f"audit.{name}.jsonl")
+              for name, _cols in plan.parties}
+    return transcripts, audits, journals
+
+
+@pytest.fixture(scope="module")
+def clean_run(tmp_path_factory):
+    """One recorded 3-party run shared by the read-only tests (the
+    hostile tests mutate *copies* of its files)."""
+    outdir = str(tmp_path_factory.mktemp("fedprov"))
+    plan = _plan()
+    return plan, outdir, _run_recorded(plan, outdir)
+
+
+def _mutate_transcript(src, dstdir, fn):
+    """Copy a transcript applying ``fn(entry_dict) -> entry_dict`` to
+    every message line (meta lines pass through)."""
+    os.makedirs(dstdir, exist_ok=True)
+    dst = os.path.join(dstdir, os.path.basename(src))
+    with open(src) as f, open(dst, "w") as out:
+        for line in f:
+            obj = json.loads(line)
+            if "dir" in obj:
+                obj = fn(obj)
+            out.write(json.dumps(obj) + "\n")
+    return dst
+
+
+# ------------------------------------------------------ clean DAG ----
+
+def test_clean_run_proves_optimum(clean_run):
+    plan, _outdir, (transcripts, audits, journals) = clean_run
+    prov = build_provenance(plan, transcripts, audits=audits,
+                            journals=journals)
+    assert prov.ok, prov.divergences
+    # float-for-float at the 2fε(k-1) optimum, per party and in total
+    assert prov.total_eps == plan.optimal_eps()
+    for name, share in plan.party_eps().items():
+        assert prov.parties[name]["spent"] == share
+    # exactly-once structurally: every wire-charged artifact has
+    # exactly one charge edge, at its plan venue's session
+    charged_by = {}
+    for src, dst, rel in prov.edges:
+        if rel == "charged_by":
+            charged_by.setdefault(src, []).append(dst)
+    for (side, lab), venue in plan.artifact_venues().items():
+        aid = f"artifact:{side}:{lab}"
+        assert len(charged_by.get(aid, [])) == 1, (aid, charged_by)
+    # exports are well-formed
+    doc = prov.to_doc()
+    json.dumps(doc)
+    assert doc["ok"] and doc["eps"]["total"] == plan.optimal_eps()
+    dot = prov.to_dot()
+    assert dot.startswith("digraph") and "artifact:x:a" in dot
+    # the postmortem query walks cell -> round -> artifacts -> charges
+    i, j = plan.cells()[-1]
+    story = prov.cell_story(i, j)
+    assert story["cell"]["venue"] == list(plan.cell_venue(i, j))
+    assert story["rounds"] and story["charges"]
+
+
+def test_four_party_meta_total_eps_exact(tmp_path):
+    """The ISSUE's meta-test: a clean 4-party federation's DAG carries
+    total ε == FederationPlan.optimal_eps() exactly (not approx)."""
+    plan = _plan(eps=1.0, n=256,
+                 parties=[("p0", ["a", "b"]), ("p1", ["c"]),
+                          ("p2", ["d"]), ("p3", ["e", "f"])])
+    transcripts, audits, journals = _run_recorded(plan, str(tmp_path))
+    prov = build_provenance(plan, transcripts, audits=audits,
+                            journals=journals)
+    assert prov.ok, prov.divergences
+    assert prov.total_eps == plan.optimal_eps()
+    assert sum(1 for _s, _d, rel in prov.edges
+               if rel == "charged_by") == len(plan.artifact_venues())
+
+
+def test_awkward_eps_reassociation_is_not_a_divergence(tmp_path):
+    """ε=0.7 makes optimal_eps()'s single multiply differ from the
+    charge-by-charge fsum in the last ulp — the DAG's expected total
+    is the plan's own per-party arithmetic, so a clean run stays ok."""
+    plan = _plan(eps=0.7, n=256)
+    transcripts, audits, journals = _run_recorded(plan, str(tmp_path))
+    prov = build_provenance(plan, transcripts, audits=audits,
+                            journals=journals)
+    assert prov.ok, prov.divergences
+    import math
+    assert prov.total_eps == math.fsum(
+        plan.party_eps()[p] for p, _c in plan.parties)
+    assert abs(prov.total_eps - plan.optimal_eps()) < 1e-12
+
+
+# -------------------------------------------------- hostile inputs ----
+
+def _kinds(prov):
+    return {d["kind"] for d in prov.divergences}
+
+
+def test_divergence_kinds_are_closed():
+    assert set(DIVERGENCE_KINDS) == {
+        "missing-party-view", "truncated-transcript",
+        "re-noised-artifact", "double-charged-artifact",
+        "tampered-charge", "eps-total-mismatch"}
+
+
+def test_missing_party_view_named(clean_run):
+    plan, _outdir, (transcripts, audits, _journals) = clean_run
+    partial = {k: v for k, v in transcripts.items() if k != "p2"}
+    prov = build_provenance(plan, partial, audits=audits)
+    assert not prov.ok
+    assert _kinds(prov) == {"missing-party-view"}
+    assert all(d["party"] == "p2" for d in prov.divergences)
+
+
+def test_tampered_charge_amount_named(clean_run, tmp_path):
+    plan, _outdir, (transcripts, audits, _journals) = clean_run
+
+    def halve(entry):
+        if entry.get("dir") == "send" and entry.get("eps", 0) > 0:
+            entry["eps"] = entry["eps"] / 2
+        return entry
+
+    mutated = dict(transcripts)
+    mutated["p0"] = [_mutate_transcript(p, str(tmp_path), halve)
+                     for p in transcripts["p0"]]
+    prov = build_provenance(plan, mutated, audits=audits)
+    assert not prov.ok
+    assert "tampered-charge" in _kinds(prov)
+    bad = [d for d in prov.divergences if d["kind"] == "tampered-charge"]
+    assert bad and all(d["party"] == "p0" for d in bad)
+    assert all(d.get("charge_id") for d in bad)
+
+
+def test_tampered_audit_trail_named(clean_run, tmp_path):
+    """The durable trail disagreeing with the transcript is attributed
+    to the party whose records diverge — and the reconstructed total
+    moves off the optimum."""
+    plan, _outdir, (transcripts, audits, _journals) = clean_run
+    forged = os.path.join(str(tmp_path), "audit.p1.jsonl")
+    with open(audits["p1"]) as f, open(forged, "w") as out:
+        for line in f:
+            ev = json.loads(line)
+            if ev.get("kind") == "charge" and ev.get("charges"):
+                k = sorted(ev["charges"])[0]
+                ev["charges"][k] += 0.25
+            out.write(json.dumps(ev) + "\n")
+    prov = build_provenance(plan, transcripts,
+                            audits={**audits, "p1": forged})
+    assert not prov.ok
+    assert {"tampered-charge", "eps-total-mismatch"} <= _kinds(prov)
+    assert all(d["party"] == "p1" for d in prov.divergences)
+    assert prov.total_eps != plan.optimal_eps()
+
+
+def test_renoised_artifact_names_minority_holder(clean_run, tmp_path):
+    plan, _outdir, (transcripts, audits, _journals) = clean_run
+
+    def perturb(entry):
+        pay = entry.get("wire", {}).get("payload", {})
+        arts = pay.get("artifacts")
+        if isinstance(arts, dict) and arts:
+            for group in arts.values():
+                for leaf in group.values():
+                    if isinstance(leaf, dict) and "b64" in leaf:
+                        s = leaf["b64"]
+                        leaf["b64"] = \
+                            ("B" if s[0] != "B" else "C") + s[1:]
+                        return entry
+        return entry
+
+    mutated = dict(transcripts)
+    mutated["p1"] = [_mutate_transcript(transcripts["p1"][0],
+                                        str(tmp_path), perturb)]
+    prov = build_provenance(plan, mutated, audits=audits)
+    assert not prov.ok
+    bad = [d for d in prov.divergences
+           if d["kind"] == "re-noised-artifact"]
+    assert bad and bad[0]["party"] == "p1"
+    assert len(bad[0]["variants"]) == 2
+
+
+def test_truncated_transcript_is_typed_not_a_crash(clean_run, tmp_path):
+    plan, _outdir, (transcripts, audits, _journals) = clean_run
+    src = transcripts["p2"][0]
+    raw = open(src).read()
+    cut = os.path.join(str(tmp_path), os.path.basename(src))
+    with open(cut, "w") as f:
+        f.write(raw[: int(len(raw) * 0.4)])  # mid-line: unparseable tail
+    mutated = dict(transcripts)
+    mutated["p2"] = [cut if p == src else p for p in transcripts["p2"]]
+    prov = build_provenance(plan, mutated, audits=audits)
+    assert not prov.ok
+    kinds = _kinds(prov)
+    assert "truncated-transcript" in kinds
+    assert all("p2" in (d["party"] or "") for d in prov.divergences)
+
+
+def test_double_charged_artifact(clean_run, tmp_path):
+    """A replayed charge in a second round — the exactly-once
+    violation the DAG exists to catch."""
+    plan, _outdir, (transcripts, audits, _journals) = clean_run
+    src = transcripts["p0"][0]
+    dst = os.path.join(str(tmp_path), os.path.basename(src))
+    lines = [json.loads(ln) for ln in open(src)]
+    dup = None
+    for obj in lines:
+        if obj.get("dir") == "send" and obj.get("eps", 0) > 0 \
+                and obj.get("wire", {}).get("msg_type") == "release":
+            dup = json.loads(json.dumps(obj))
+            dup["wire"]["payload"]["round"] = 1
+            if "charge_id" in dup:
+                dup["charge_id"] = dup["charge_id"] + ":dup"
+            break
+    assert dup is not None
+    with open(dst, "w") as f:
+        for obj in lines + [dup]:
+            f.write(json.dumps(obj) + "\n")
+    mutated = dict(transcripts)
+    mutated["p0"] = [dst if p == src else p for p in transcripts["p0"]]
+    prov = build_provenance(plan, mutated)
+    assert not prov.ok
+    assert "double-charged-artifact" in _kinds(prov)
+
+
+# ---------------------------------------------- single shared trace ----
+
+def test_inproc_federation_is_one_trace(tmp_path):
+    spool = str(tmp_path / "spans.jsonl")
+    obs_trace.configure(spool)
+    try:
+        plan = _plan(n=256)
+        run_federation_inproc(plan, _data(plan))
+    finally:
+        obs_trace.configure(None)
+    spans = obs_trace.read_spans(spool)
+    tids = {s["trace_id"] for s in spans}
+    assert tids == {plan.trace_id()}
+    names = {s["name"] for s in spans}
+    assert {"federation.matrix", "federation.link",
+            "federation.round", "federation.cell"} <= names
+
+
+def test_plan_trace_id_is_deterministic_and_wire_width():
+    plan = _plan()
+    assert plan.trace_id() == _plan().trace_id()
+    assert plan.trace_id() == plan.fed_hash()[:16]
+    assert len(plan.trace_id()) == 16  # secrets.token_hex(8) width
+
+
+# ---------------------------------------------- party obs endpoint ----
+
+def test_obs_endpoint_scrape_and_trigger(tmp_path):
+    registry = Registry()
+    c = registry.counter("dpcorr_federation_cells_completed_total",
+                         "cells", labelnames=("venue",))
+    c.inc(7, venue="link")
+    stats = {"kind": "federation_party", "party": "p0", "cells_done": 7}
+    server, port = start_obs_server(registry, stats_fn=lambda: stats)
+    rec = FlightRecorder(str(tmp_path / "dump.json"))
+    obs_recorder.install(rec)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(f"{base}/stats", timeout=5) as r:
+            assert json.loads(r.read()) == stats
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            assert r.read().decode() == registry.render()
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            assert json.loads(r.read()) == {"ok": True}
+        # trigger: unknown reason refused, federation reasons accepted
+        bad = urllib.request.Request(
+            f"{base}/obs/trigger", method="POST",
+            data=json.dumps({"reason": "nonsense"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(bad, timeout=5)
+        assert exc.value.code == 400
+        good = urllib.request.Request(
+            f"{base}/obs/trigger", method="POST",
+            data=json.dumps({
+                "reason": "federation_scan_violation",
+                "detail": {"party": "p0"}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(good, timeout=5) as r:
+            body = json.loads(r.read())
+        assert body["armed"] and body["dumped"]
+        assert rec.last_reason == "federation_scan_violation"
+    finally:
+        obs_recorder.install(None)
+        server.shutdown()
+
+
+def test_federation_trigger_reasons_registered():
+    for reason in ("federation_unhandled", "federation_resume_refused",
+                   "federation_scan_violation"):
+        assert reason in obs_recorder.TRIGGER_REASONS
+
+
+def test_fleet_collector_scrapes_party_binary_exact(tmp_path):
+    """The ISSUE acceptance: a live FleetCollector scrape of a party
+    process matches the party's own counters binary-exactly."""
+    plan = _plan(n=256)
+    parties = make_federation_parties(plan, _data(plan),
+                                      transcript_dir=str(tmp_path))
+    p0 = parties["p0"]
+    server, port = start_obs_server(p0.registry,
+                                    stats_fn=p0.stats_snapshot)
+    try:
+        from dpcorr.protocol.federation import _drive_parties
+
+        _drive_parties(parties)
+        snap = FleetCollector(
+            {"p0": f"http://127.0.0.1:{port}"}).scrape()
+        assert not snap.errors()
+        rec = snap.instances["p0"]
+        # binary-exact: the scraped exposition IS the registry render
+        assert rec["exposition"] == p0.registry.render()
+        stats = rec["stats"]
+        assert stats["kind"] == "federation_party"
+        assert stats["trace_id"] == plan.trace_id()
+        assert stats["cells_done"] == len(
+            plan.local_cells("p0")) + sum(
+            len(r) for p, q in plan.party_links("p0")
+            for r in plan.link_rounds(p, q))
+        assert stats["eps"]["spent"] == plan.party_eps()["p0"]
+        # the merged fleet view carries the per-party series intact
+        fams = snap.families()["p0"]
+        cells = fams["dpcorr_federation_cells_completed_total"]
+        total = sum(v for _s, _l, v in cells.samples)
+        assert total == stats["cells_done"]
+    finally:
+        server.shutdown()
+
+
+# ----------------------------------------------- console + SLO view ----
+
+def _canned_snapshot(registry, stats):
+    return FleetSnapshot({
+        "p0": {"url": "http://x", "error": None, "stats": stats,
+               "exposition": registry.render()},
+        "p1": {"url": "http://y", "error": "URLError: down",
+               "stats": None, "exposition": None},
+    })
+
+
+def test_console_federation_frame(clean_run):
+    from dpcorr.obs.console import render_federation_frame
+
+    plan, _outdir, _records = clean_run
+    registry = Registry()
+    registry.counter("dpcorr_federation_rounds_total", "rounds",
+                     labelnames=("link", "role")).inc(
+        3, link="p0-p1", role="release")
+    h = registry.histogram("dpcorr_federation_round_latency_seconds",
+                           "rt", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    registry.counter("dpcorr_federation_release_cache_total", "cache",
+                     labelnames=("label", "outcome")).inc(
+        2, label="a", outcome="hit")
+    stats = {"kind": "federation_party", "instance": "p0",
+             "party": "p0", "fed": plan.fed,
+             "trace_id": plan.trace_id(), "cells_done": 5,
+             "cells_total": 6, "links": ["p0-p1", "p0-p2"],
+             "eps": {"spent": 6.0, "share": 6.0}}
+    frame = render_federation_frame(_canned_snapshot(registry, stats),
+                                    now=0.0)
+    assert "p1" in frame and "DOWN" in frame
+    assert "5/6" in frame and "6/6" in frame
+    assert plan.fed in frame and plan.trace_id() in frame
+
+
+def test_slo_federation_objectives_page_offending_party():
+    from dpcorr.obs.fleet import parse_families
+    from dpcorr.obs.slo import (
+        BurnRateEngine,
+        federation_eps_burn_objectives,
+        federation_round_latency_objective,
+    )
+
+    plan = _plan()
+    lat = federation_round_latency_objective()
+    assert lat.histogram == "dpcorr_federation_round_latency_seconds"
+    objectives = federation_eps_burn_objectives(plan, makespan_s=100.0)
+    assert {o.name for o in objectives} == {
+        f"fed-eps-burn-{p}" for p, _c in plan.parties}
+    shares = plan.party_eps()
+    for o in objectives:
+        party = o.name.rsplit("-", 1)[1]
+        assert o.eps_per_s == shares[party] / 100.0
+        assert o.eps_series == "dpcorr_federation_ledger_spent_eps"
+
+    # p0 spends its whole share in 1/100th of the makespan -> page
+    obj = next(o for o in objectives if o.name.endswith("p0"))
+    engine = BurnRateEngine([obj], windows=(("page", 1.0, 1.0, 14.4),))
+
+    def fams(spent):
+        registry = Registry()
+        registry.gauge("dpcorr_federation_ledger_spent_eps", "eps",
+                       labelnames=("ledger",)).set(spent, ledger="p0")
+        return parse_families(registry.render())
+
+    engine.observe({"p0": fams(0.0)}, at=0.0)
+    engine.observe({"p0": fams(6.0)}, at=1.0)
+    fired = engine.evaluate(at=1.0)
+    assert [(a.instance, a.severity) for a in fired] == [("p0", "page")]
+
+
+# ------------------------------------------------------ CLI surface ----
+
+def test_cli_provenance_divergence_arms_recorder(clean_run, tmp_path,
+                                                 capsys):
+    """`dpcorr obs provenance` on divergent records exits 1 AND dumps
+    the installed flight recorder with the federation reason —
+    satellite (c)'s auto-arming on federation failure paths."""
+    import argparse
+
+    from dpcorr.__main__ import cmd_obs_provenance
+
+    plan, outdir, (transcripts, _audits, _journals) = clean_run
+    plan_path = str(tmp_path / "plan.json")
+    with open(plan_path, "w") as f:
+        json.dump({"plan": plan.to_public()}, f)
+    # drop one party's transcripts into a partial dir -> divergence
+    partial = tmp_path / "partial"
+    partial.mkdir()
+    for pname, paths in transcripts.items():
+        if pname == "p2":
+            continue
+        for p in paths:
+            (partial / os.path.basename(p)).write_text(open(p).read())
+    rec = FlightRecorder(str(tmp_path / "dump.json"))
+    obs_recorder.install(rec)
+    try:
+        args = argparse.Namespace(
+            plan=plan_path, transcript_dir=str(partial),
+            transcript=None, audit=None, journal_dir=None,
+            out=str(tmp_path / "prov.json"), dot=None, cell=None,
+            json=False)
+        with pytest.raises(SystemExit) as exc:
+            cmd_obs_provenance(args)
+        assert exc.value.code == 1
+        assert rec.last_reason == "federation_scan_violation"
+    finally:
+        obs_recorder.install(None)
+    out = capsys.readouterr().out
+    assert "missing-party-view" in out and "p2" in out
+    doc = json.loads(open(tmp_path / "prov.json").read())
+    assert not doc["ok"]
+
+
+def test_discover_federation_groups_by_filename(clean_run, tmp_path):
+    plan, outdir, _records = clean_run
+    plan_path = str(tmp_path / "plan.json")
+    with open(plan_path, "w") as f:
+        json.dump({"plan": plan.to_public()}, f)
+    got_plan, transcripts, audits, journals = discover_federation(
+        plan_path, transcript_dir=outdir,
+        audit_specs=[f"p0={outdir}/audit.p0.jsonl"],
+        journal_dir=outdir)
+    assert got_plan.fed == plan.fed
+    assert set(transcripts) == {"p0", "p1", "p2"}
+    assert all(len(v) == 2 for k, v in transcripts.items() if k != "p1")
+    assert list(audits) == ["p0"]
+    assert set(journals) == {"p0", "p1", "p2"}
+    prov = build_provenance(got_plan, transcripts, audits=audits,
+                            journals=journals)
+    assert prov.ok and prov.total_eps == plan.optimal_eps()

@@ -200,11 +200,13 @@ def make_rep_fn(sampler: str = "icdf"):
 
 def make_pipeline(chunk: int, block_reps: int, *, sampler: str = "icdf",
                   key=None, impl: str | None = None, counters=None,
-                  aot: bool = True):
+                  aot: bool = True, profiler=None):
     """The donated rep-block executor over :func:`make_rep_fn` — what the
     worker measures since r08. ``impl``: PRNG impl for the key tree
     (``"rbg"`` for the TPU hardware generator path); the root ``key``
-    must be built with the same impl."""
+    must be built with the same impl. ``profiler``: an optional
+    ``obs.prof.BlockProfiler`` (``DPCORR_PROF=...`` arms one for the
+    whole worker via ``prof.active()``)."""
     from dpcorr.sim import RepBlockPipeline
     from dpcorr.utils import rng
 
@@ -213,7 +215,7 @@ def make_pipeline(chunk: int, block_reps: int, *, sampler: str = "icdf",
     return RepBlockPipeline(make_rep_fn(sampler), 3, key=key,
                             block_reps=block_reps, chunk_size=chunk,
                             family=f"bench-{sampler}", impl=impl,
-                            counters=counters, aot=aot)
+                            counters=counters, aot=aot, profiler=profiler)
 
 
 def measure_pipeline(pipe, budget_s: float):
@@ -433,11 +435,15 @@ def worker_main(mode: str, budget_s: float) -> None:
               if mode == "cpu" else None)
     before = counters.snapshot()  # after the probes: the measurement's own
 
+    from dpcorr.obs import prof as prof_mod
+
+    profiler = prof_mod.active()  # armed only via DPCORR_PROF
     pipe = make_pipeline(geo.chunk_size, geo.block_reps, key=key,
-                         counters=counters)
+                         counters=counters, profiler=profiler)
     xla_rps, xla_means = measure_pipeline(pipe, budget_s)
     paths = {"xla": _path_entry(xla_rps, xla_means, pipe, geo)}
     geos = {"xla": geo}
+    pipes = {"xla": pipe}
 
     if mode == "tpu":
         # Same kernel on the rbg key impl (the TPU hardware generator):
@@ -452,6 +458,7 @@ def worker_main(mode: str, budget_s: float) -> None:
                 paths["xla_rbg"] = _path_entry(rbg_rps, rbg_means,
                                                rbg_pipe, geo)
                 geos["xla_rbg"] = geo
+                pipes["xla_rbg"] = rbg_pipe
             else:
                 paths["xla_rbg_skipped"] = f"sanity: {rbg_means}"
         except Exception as e:
@@ -471,6 +478,7 @@ def worker_main(mode: str, budget_s: float) -> None:
                 paths["xla_bm"] = _path_entry(bm_rps, bm_means, bm_pipe,
                                               bm_geo)
                 geos["xla_bm"] = bm_geo
+                pipes["xla_bm"] = bm_pipe
             else:
                 paths["xla_bm_skipped"] = f"sanity: {bm_means}"
         except Exception as e:
@@ -493,6 +501,12 @@ def worker_main(mode: str, budget_s: float) -> None:
         "geometry": best_geo.as_detail(),
         "transfer": transfer_mod.diff(counters.snapshot(), before),
     }
+    # measured arithmetic intensity (ISSUE 15): the winning kernel's XLA
+    # cost analysis, per-rep normalized — benchmarks/roofline.py consumes
+    # this instead of hand-derived FLOP constants
+    cost = pipes[best].cost_summary()
+    if cost:
+        detail["cost_analysis"] = cost
     # per-device memory watermarks (ISSUE 11): absent — not zero — when
     # the backend exposes no introspection (CPU allocators usually don't)
     from dpcorr.obs import devicemon
@@ -786,11 +800,36 @@ def main() -> None:
         floor = _gate_floor()
         lkg = _load_lkg(args.lkg)
         ok, reason = gate_check(measured, lkg, floor)
-        measured.setdefault("detail", {})["gate"] = {
+        gate = {
             "ok": ok, "reason": reason, "floor": floor,
             "lkg_value": (lkg or {}).get("value"),
             "lkg_path": args.lkg,
         }
+        if not ok:
+            # trajectory attribution (ISSUE 15): name the FIRST artifact
+            # in the committed series that bent the curve, not just the
+            # bare ratio. Jax-free and best-effort — attribution may be
+            # None (cold history) but must never change the verdict.
+            try:
+                from dpcorr.obs import trajectory as traj_mod
+
+                root = os.path.dirname(os.path.abspath(__file__))
+                first = traj_mod.gate_attribution(
+                    traj_mod.default_roots(root),
+                    metric=str(measured.get("metric") or METRIC),
+                    device_kind=str((measured.get("detail") or {})
+                                    .get("device_kind") or "unknown"),
+                    measured_value=float(measured.get("value") or 0.0),
+                    floor=floor)
+            except Exception:
+                first = None
+            if first is not None:
+                gate["first_regression"] = first
+                reason += (f"; first regressing artifact: {first['path']}"
+                           f" ({first['ratio']:.2f}x of best"
+                           f" {first['best_path']})")
+                gate["reason"] = reason
+        measured.setdefault("detail", {})["gate"] = gate
         print(json.dumps(measured), flush=True)
         sys.exit(0 if ok else 1)
 

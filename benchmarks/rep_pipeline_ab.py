@@ -26,6 +26,15 @@ Usage::
     python -m benchmarks.rep_pipeline_ab [--rounds 5] [--budget 6]
         [--block 4096] [--chunk 4]
         [--out benchmarks/results/r08_rep_pipeline_ab_cpu.json]
+
+The profiled-vs-unprofiled A/B (ISSUE 15) rides the same interleaving
+discipline: alternating rounds on two pipelines at the same geometry,
+one with an armed ``obs.prof.BlockProfiler`` and one without, gating
+the profiler's p50 throughput cost at ≤3% — and proving with the
+transfer counters that the unprofiled arm still performs exactly one
+host fetch per run (profiler syncs are accounted separately in
+``dpcorr_prof_syncs_total``, never as fetches). ``--profiler-only``
+runs just this gate (the CI ``prof-smoke`` job's fast path).
 """
 
 from __future__ import annotations
@@ -35,6 +44,63 @@ import json
 import statistics
 import time
 from pathlib import Path
+
+
+def profiler_ab(args, key, counters) -> dict:
+    """Interleaved profiled-vs-unprofiled rounds on RepBlockPipeline."""
+    import bench
+    from dpcorr.obs import prof as prof_mod
+
+    prof = prof_mod.BlockProfiler(max_syncs=8)
+    pipe_off = bench.make_pipeline(args.chunk, args.block, key=key,
+                                   counters=counters)
+    pipe_on = bench.make_pipeline(args.chunk, args.block, key=key,
+                                  counters=counters, profiler=prof)
+
+    # -- zero-extra-sync proof: each arm's run() bumps fetches by
+    # exactly 1 (the reduction boundary). The profiled arm's extra
+    # cadence syncs land in dpcorr_prof_syncs_total, NOT in fetches.
+    s0 = counters.snapshot()
+    pipe_off.run(4, start_block=0)
+    s1 = counters.snapshot()
+    syncs_before = int(prof.syncs_total.value())
+    pipe_on.run(4, start_block=0)
+    s2 = counters.snapshot()
+    off_fetches = s1["fetches"] - s0["fetches"]
+    on_fetches = s2["fetches"] - s1["fetches"]
+    prof_syncs = int(prof.syncs_total.value()) - syncs_before
+    assert off_fetches == 1, \
+        f"unprofiled run performed {off_fetches} fetches, expected 1"
+    assert on_fetches == 1, \
+        f"profiled run performed {on_fetches} fetches, expected 1 " \
+        f"(profiler syncs must not count as fetches)"
+    assert prof_syncs >= 1, "armed profiler recorded no cadence syncs"
+
+    rps_off, rps_on = [], []
+    for r in range(args.rounds):
+        a, _ = bench.measure_pipeline(pipe_off, args.budget)
+        rps_off.append(a)
+        b, _ = bench.measure_pipeline(pipe_on, args.budget)
+        rps_on.append(b)
+        print(f"prof round {r}: off {a:.1f} vs on {b:.1f} "
+              f"({(1 - b / a) * 100:+.2f}% overhead)", flush=True)
+
+    p50_off = statistics.median(rps_off)
+    p50_on = statistics.median(rps_on)
+    overhead_pct = (1.0 - p50_on / p50_off) * 100.0
+    return {
+        "rounds": args.rounds,
+        "off_reps_per_sec": [round(v, 1) for v in rps_off],
+        "on_reps_per_sec": [round(v, 1) for v in rps_on],
+        "p50_off": round(p50_off, 1),
+        "p50_on": round(p50_on, 1),
+        "overhead_pct": round(overhead_pct, 3),
+        "budget_pct": prof_mod.OVERHEAD_BUDGET_PCT,
+        "ok": overhead_pct <= prof_mod.OVERHEAD_BUDGET_PCT,
+        "profiler_syncs": prof_syncs,
+        "unprofiled_fetches_per_run": off_fetches,
+        "profiled_fetches_per_run": on_fetches,
+    }
 
 
 def main() -> None:
@@ -47,6 +113,9 @@ def main() -> None:
     ap.add_argument("--out", type=str,
                     default="benchmarks/results/r08_rep_pipeline_ab_cpu.json")
     ap.add_argument("--platform", type=str, default=None)
+    ap.add_argument("--profiler-only", action="store_true",
+                    help="run only the profiled-vs-unprofiled gate and "
+                         "write a profiler_ab-only artifact (CI prof-smoke)")
     args = ap.parse_args()
 
     import jax
@@ -62,6 +131,26 @@ def main() -> None:
 
     counters = transfer_mod.default_counters()
     key = rng.master_key()
+
+    if args.profiler_only:
+        prof_section = profiler_ab(args, key, counters)
+        out = {
+            "metric": "rep_pipeline_profiler_ab_ni_sign_n10k",
+            "device": str(jax.devices()[0]),
+            "platform": jax.devices()[0].platform,
+            "block_reps": args.block,
+            "chunk_size": args.chunk,
+            "profiler_ab": prof_section,
+            "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime()),
+        }
+        Path(args.out).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.out).write_text(json.dumps(out, indent=1))
+        print(json.dumps({"profiler_overhead_pct":
+                          prof_section["overhead_pct"],
+                          "ok": prof_section["ok"], "out": args.out}))
+        return
+
     legacy_block = bench.make_xla_block(args.chunk)
     pipe = bench.make_pipeline(args.chunk, args.block, key=key,
                                counters=counters)
@@ -119,6 +208,7 @@ def main() -> None:
         "donation_engaged": pipe.donation_engaged,
         "aot": pipe.aot_ok,
         "transfer": counters.snapshot(),
+        "profiler_ab": profiler_ab(args, key, counters),
         "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     Path(args.out).parent.mkdir(parents=True, exist_ok=True)

@@ -414,6 +414,117 @@ def cmd_obs_chrome(args):
     print(f"wrote {args.out} ({n} spans)")
 
 
+def cmd_obs_trajectory(args):
+    """Bench-trajectory dashboard (ISSUE 15): normalize the repo's
+    BENCH_*/MULTICHIP_*/benchmarks/results artifacts into per-
+    (device_kind, metric) series and name the first artifact that bent
+    the curve. Jax-free — runs over a bare checkout. ``--check`` exits
+    1 when any series regressed below the floor."""
+    from dpcorr.obs import trajectory as traj_mod
+
+    roots = args.root or traj_mod.default_roots(args.repo)
+    report = traj_mod.build_report(roots, args.floor)
+    if args.format == "json":
+        sys.stdout.write(traj_mod.render_json(report))
+    elif args.format == "markdown":
+        sys.stdout.write(traj_mod.render_markdown(report))
+    else:
+        sys.stdout.write(traj_mod.render_console(report))
+    if args.check and report.regressions:
+        sys.exit(1)
+
+
+def cmd_obs_hlo(args):
+    """HLO signature-dump tooling (ISSUE 15), jax-free: ``show`` lists
+    a persisted dump's signatures with their cost/memory/fingerprint;
+    ``diff`` explains what changed between two dumps — fingerprint
+    flips, FLOP/byte/memory deltas, and the op-count deltas (fusion /
+    copy / transpose) that mark layout or reshard boundaries."""
+    from dpcorr.obs import hlo as hlo_mod
+
+    try:
+        if args.hlo_cmd == "show":
+            sigs = hlo_mod.load_dump(args.path)
+            if args.json:
+                print(json.dumps(sigs, indent=2, sort_keys=True))
+                return
+            for key in sorted(sigs):
+                rec = sigs[key]
+                sig = rec.get("signature") or {}
+                label = ",".join(f"{k}={sig[k]}" for k in sorted(sig)) \
+                    or "<unsigned>"
+                cost = rec.get("cost") or {}
+                print(f"{key}  {label}")
+                print(f"    fingerprint={rec.get('fingerprint') or '-'} "
+                      f"flops={cost.get('flops', '-')} "
+                      f"bytes={cost.get('bytes', '-')} "
+                      f"cause={rec.get('cause') or '-'}")
+            return
+        diff = hlo_mod.diff_dumps(hlo_mod.load_dump(args.old),
+                                  hlo_mod.load_dump(args.new))
+        if args.json:
+            print(json.dumps(diff, indent=2, sort_keys=True))
+        else:
+            sys.stdout.write(hlo_mod.render_diff(diff))
+    except (OSError, ValueError) as e:
+        print(f"obs hlo: {e}", file=sys.stderr)
+        sys.exit(1)
+
+
+def cmd_obs_geometry(args):
+    """Print the geometry autotuner cache (ISSUE 15) per (device_kind,
+    family, n, dtype) with provenance: tuned entries with their probe
+    throughput and staleness, plus any live env pin
+    (``DPCORR_BENCH_CHUNK``/``DPCORR_BENCH_BLOCK_REPS``) that outranks
+    every tuned entry. Jax-free; exits 1 on a corrupt cache file (the
+    hot path deliberately shrugs — the CLI must not)."""
+    import os as _os
+
+    from dpcorr.utils import geometry as geo_mod
+
+    path = args.path or geo_mod.cache_path()
+    pin = {k: _os.environ[k] for k in ("DPCORR_BENCH_CHUNK",
+                                       "DPCORR_BENCH_BLOCK_REPS")
+           if _os.environ.get(k)}
+    if path is None:
+        print("geometry cache disabled (DPCORR_GEOMETRY_CACHE).")
+        rows = []
+    elif not _os.path.exists(path):
+        print(f"geometry cache {path}: not present (no run has tuned "
+              f"on this host yet).")
+        rows = []
+    else:
+        try:
+            rows = geo_mod.entries(geo_mod.load_strict(path))
+        except (OSError, ValueError) as e:
+            print(f"obs geometry: corrupt cache {path}: {e}",
+                  file=sys.stderr)
+            sys.exit(1)
+    if args.json:
+        print(json.dumps({"path": path, "env_pin": pin, "entries": rows},
+                         indent=2, sort_keys=True))
+        return
+    if pin:
+        print("env pin (outranks every tuned entry): "
+              + " ".join(f"{k}={v}" for k, v in sorted(pin.items())))
+    if rows:
+        print(f"geometry cache {path}: {len(rows)} tuned entries")
+        for row in rows:
+            if row.get("note"):
+                print(f"  {row['key']}: {row['note']}")
+                continue
+            age = row.get("age_s")
+            age_txt = "unstamped" if age is None else \
+                f"{age / 86400:.1f}d old" if age >= 86400 else \
+                f"{age / 3600:.1f}h old"
+            rps = row.get("reps_per_sec")
+            rps_txt = f"{rps:,.0f} reps/s probe" if rps else "no probe rate"
+            print(f"  [{row['device_kind']}] {row['family']} "
+                  f"n={row['n']} {row['dtype']}: "
+                  f"chunk={row['chunk_size']} block={row['block_reps']} "
+                  f"({rps_txt}, {age_txt}, source=tuned)")
+
+
 def cmd_obs_dump(args):
     """Replay a flight-recorder dump jax-free (docs/OBSERVABILITY.md):
     summary mode lists what the rings held at dump time; ``--trace-id``
@@ -1819,6 +1930,52 @@ def main(argv=None):
     pofr.add_argument("--json", action="store_true")
     pofr.set_defaults(fn=cmd_obs_fleet_replay, platform=None,
                       jax_free=True)
+    potr = obs_sub.add_parser(
+        "trajectory", help="bench-trajectory dashboard (ISSUE 15): "
+        "per-(device_kind, metric) series over the committed "
+        "BENCH_*/MULTICHIP_*/benchmarks-results artifacts; names the "
+        "first artifact that bent the curve; jax-free")
+    potr.add_argument("--root", action="append", default=None,
+                      help="artifact root (file or dir, repeatable); "
+                           "default: repo root + benchmarks/results")
+    potr.add_argument("--repo", default=".",
+                      help="repo root for the default artifact roots")
+    potr.add_argument("--floor", type=float, default=0.85,
+                      help="regression floor vs best-so-far (0.85 = "
+                           "flag a drop below 85%%)")
+    potr.add_argument("--format", choices=["console", "json", "markdown"],
+                      default="console")
+    potr.add_argument("--check", action="store_true",
+                      help="exit 1 when any series regressed")
+    potr.set_defaults(fn=cmd_obs_trajectory, platform=None,
+                      jax_free=True)
+    poh = obs_sub.add_parser(
+        "hlo", help="compiled-signature introspection (ISSUE 15): show "
+        "or diff persisted HLO signature dumps (cost, memory, "
+        "fingerprints, op histograms); jax-free")
+    hlo_sub = poh.add_subparsers(dest="hlo_cmd", required=True)
+    pohs = hlo_sub.add_parser("show", help="list one dump's signatures")
+    pohs.add_argument("path", help="dpcorr_hlo_dump JSON path")
+    pohs.add_argument("--json", action="store_true")
+    pohs.set_defaults(fn=cmd_obs_hlo, platform=None, jax_free=True)
+    pohd = hlo_sub.add_parser(
+        "diff", help="explain what changed between two dumps: "
+        "fingerprint flips, FLOP/byte/memory deltas, op-count deltas "
+        "(copy/transpose deltas mark layout/reshard boundaries)")
+    pohd.add_argument("old", help="baseline dump")
+    pohd.add_argument("new", help="candidate dump")
+    pohd.add_argument("--json", action="store_true")
+    pohd.set_defaults(fn=cmd_obs_hlo, platform=None, jax_free=True)
+    pog = obs_sub.add_parser(
+        "geometry", help="autotuner cache view (ISSUE 15): tuned "
+        "(chunk x block) per (device_kind, family, n, dtype) with "
+        "env-pin provenance and staleness; exit 1 on corrupt cache; "
+        "jax-free")
+    pog.add_argument("--path", default=None,
+                     help="cache path (default: the resolved "
+                          "DPCORR_GEOMETRY_CACHE / ~/.cache location)")
+    pog.add_argument("--json", action="store_true")
+    pog.set_defaults(fn=cmd_obs_geometry, platform=None, jax_free=True)
     def _add_spec_flags(p):
         p.add_argument("--family", default="ni_sign",
                        choices=["ni_sign", "int_sign", "ni_subg",

@@ -29,6 +29,7 @@ import numpy as np
 import pandas as pd
 
 from dpcorr import sim as sim_mod
+from dpcorr.obs import prof as prof_mod
 from dpcorr.obs import trace as obs_trace
 from dpcorr.sim import SimConfig
 from dpcorr.utils import compile as compile_mod
@@ -471,6 +472,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                              and (os.cpu_count() or 1) >= 2)))
     pool, pre_obs = None, None
     parent_sp = obs_trace.current_span()
+    t_scan0 = time.perf_counter()
     buckets = []
     bucket_keys = ["n"] if merged else ["n", "eps1", "eps2"]
     for _, grp in design.groupby(bucket_keys, sort=False):
@@ -551,6 +553,9 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
     # pool and the dispatch just picks up the executable. Outputs are a
     # few KB of metrics per point, so keeping all buckets in flight
     # costs almost no HBM.
+    prof_mod.note_phase("grid.scan", time.perf_counter() - t_scan0,
+                        buckets=len(buckets))
+    t_disp0 = time.perf_counter()
     pending = []
     try:
         for (rows, to_run, stamps, paths, fused, cfg, mk_stamps,
@@ -627,6 +632,8 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             # reaps worker threads (cancel covers an exceptional exit)
             pool.shutdown(wait=False, cancel_futures=True)
 
+    prof_mod.note_phase("grid.dispatch", time.perf_counter() - t_disp0,
+                        buckets=len(pending))
     # Phase 2 — fetch in dispatch order; device-side failures surface here.
     # Per-bucket wall times overlap under dispatch-ahead (a later bucket's
     # fetch_s is near zero because its device work ran during earlier
@@ -712,6 +719,8 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             "seconds": dispatch_s + fetch_s,
             "dispatch_s": dispatch_s, "fetch_s": fetch_s,
         })
+    prof_mod.note_phase("grid.fetch", time.perf_counter() - t_fetch0,
+                        points_run=total_ran)
     wall = (time.perf_counter() - t_fetch0) + sum(
         t[8] for t in pending)  # fetch phase + all dispatch times
     grid_rps = np.nan if not total_ran else total_ran * gcfg.b / wall

@@ -46,6 +46,23 @@ package is the one spine they now share:
   exactly-once charging and byte-identical reuse at the
   ``2·f·ε·(k−1)`` optimum; typed divergences name the offending
   party. ``dpcorr obs provenance`` exports JSON + DOT, jax-free.
+- :mod:`prof`    — the performance observability plane's hot-path half
+  (ISSUE 15): a cadence-bounded block-boundary profiler for
+  ``sim.RepBlockPipeline`` and the grid phases — per-segment device
+  timings via at most ``max_syncs`` host syncs per run (never any in
+  the unprofiled path), folded with the transfer counters into
+  ``dpcorr_prof_*`` metrics, spans and a per-run profile artifact;
+  gated at ≤3% p50 overhead by ``benchmarks/rep_pipeline_ab.py``.
+- :mod:`hlo`     — compile-time introspection riding ``utils/compile``:
+  per-signature ``cost_analysis`` (FLOPs, bytes), memory analysis, HLO
+  fingerprints and op histograms, persisted as signature dumps that
+  ``dpcorr obs hlo diff`` compares jax-free to explain layout/reshard
+  boundaries and recompiles.
+- :mod:`trajectory` — the bench-trajectory regression engine: the
+  committed ``BENCH_*``/``MULTICHIP_*``/``benchmarks/results``
+  artifacts normalized into per-(device_kind, metric) series; names
+  the FIRST artifact that bent the curve (wired into ``bench.py
+  --gate`` attribution and ``dpcorr obs trajectory``), jax-free.
 - :mod:`endpoint` — the mini scrape surface for non-serve processes
   (``dpcorr federation party --obs-port``): ``/metrics`` + ``/stats``
   + ``POST /obs/trigger``, byte-compatible with serve's routes so the
@@ -82,6 +99,12 @@ from dpcorr.obs.fleet import (  # noqa: F401
     parse_families,
     render_families,
 )
+from dpcorr.obs.hlo import (  # noqa: F401
+    HloStore,
+    diff_dumps,
+    load_dump,
+    render_diff,
+)
 from dpcorr.obs.metrics import (  # noqa: F401
     CONTENT_TYPE,
     LATENCY_BUCKETS,
@@ -91,6 +114,10 @@ from dpcorr.obs.metrics import (  # noqa: F401
     Registry,
     default_registry,
     parse_exposition,
+)
+from dpcorr.obs.prof import (  # noqa: F401
+    BlockProfiler,
+    read_profile,
 )
 from dpcorr.obs.provenance import (  # noqa: F401
     DIVERGENCE_KINDS,
@@ -125,3 +152,11 @@ from dpcorr.obs.trace import (  # noqa: F401
     wire_headers,
     write_chrome_trace,
 )
+from dpcorr.obs.trajectory import (  # noqa: F401
+    Point,
+    Regression,
+    build_report,
+    find_regressions,
+    gate_attribution,
+)
+

@@ -108,6 +108,13 @@ def render_frame(stats: dict, metrics: dict,
         f"{stats.get('kernel_compile_dedup', 0)} dedup   "
         f"cache {stats.get('kernel_cache_size', 0)}")
 
+    rec = stats.get("recompiles", {})
+    if rec and any(rec.values()):
+        lines.append(
+            f"recompiles  : {rec.get('new-signature', 0)} new-signature / "
+            f"{rec.get('cache-evict', 0)} cache-evict / "
+            f"{rec.get('jit-fallback', 0)} jit-fallback")
+
     lat = stats.get("latency_s", {})
     if lat:
         lines.append(f"latency     : p50 {lat.get('p50', 0.0) * 1e3:8.2f} ms"

@@ -1,0 +1,311 @@
+"""Compile-time HLO/cost introspection (ISSUE 15).
+
+``utils/compile.py`` is the repo's single compile choke point — every
+AOT lowering in sim, grid, serve and protocol flows through
+``aot_compile``.  This module rides that choke point: for each compiled
+signature it captures XLA's ``cost_analysis()`` (FLOPs, bytes accessed),
+the memory analysis, and a fingerprint + op histogram of the optimized
+HLO text.  The store is bounded and process-local; ``dump()`` persists
+it so two dumps (say, CPU vs TPU, or before/after a reshard fix) can be
+compared with the jax-free half of this module —
+``dpcorr obs hlo diff`` explains *what changed* between two compiles:
+fingerprint flips, FLOP/byte deltas, and op-count deltas (fusion /
+copy / transpose / reshape counts are how layout and reshard boundaries
+show up in optimized HLO).
+
+Import rule: this module must import WITHOUT jax.  All jax interaction
+happens through the ``compiled`` objects handed to the capture
+functions; the diff half touches nothing but JSON.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional
+
+_STORE_CAP = 256
+
+# Matches the op name in an HLO instruction line:
+#   %fusion.3 = f32[128]{0} fusion(%p0), kind=kLoop ...
+_OP_RE = re.compile(r"=\s*(?:[a-z0-9_\[\]{},:#\s]*?\s)?([a-z][a-z0-9\-]*)\(")
+
+
+def cost_summary(compiled: Any) -> Dict[str, float]:
+    """FLOPs / bytes-accessed from ``compiled.cost_analysis()``.
+
+    Tolerates every spelling jax has shipped: a dict, a list/tuple of
+    dicts, ``"bytes accessed"`` vs ``"bytes_accessed"``.  Returns an
+    empty dict when the backend offers no analysis.
+    """
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — backend-dependent, best effort
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return {}
+    out: Dict[str, float] = {}
+    flops = cost.get("flops")
+    if isinstance(flops, (int, float)) and flops >= 0:
+        out["flops"] = float(flops)
+    for key in ("bytes accessed", "bytes_accessed"):
+        val = cost.get(key)
+        if isinstance(val, (int, float)) and val >= 0:
+            out["bytes"] = float(val)
+            break
+    return out
+
+
+def memory_summary(compiled: Any) -> Dict[str, int]:
+    """Per-signature memory analysis, best effort."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001
+        return {}
+    if mem is None:
+        return {}
+    out: Dict[str, int] = {}
+    for attr in (
+        "temp_size_in_bytes",
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "alias_size_in_bytes",
+        "generated_code_size_in_bytes",
+    ):
+        val = getattr(mem, attr, None)
+        if isinstance(val, int) and val >= 0:
+            out[attr] = val
+    return out
+
+
+def hlo_text(compiled: Any) -> str:
+    """Optimized-HLO text of a compiled executable, or ''."""
+    try:
+        text = compiled.as_text()
+    except Exception:  # noqa: BLE001
+        return ""
+    return text if isinstance(text, str) else ""
+
+
+def fingerprint(text: str) -> str:
+    """Short stable digest of HLO text (16 hex chars)."""
+    if not text:
+        return ""
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()[:16]
+
+
+def op_histogram(text: str) -> Dict[str, int]:
+    """Count HLO ops per instruction line.
+
+    fusion/copy/transpose/reshape/convert/all-reduce counts are the
+    signal: a copy or transpose appearing between two dumps is a layout
+    or reshard boundary XLA inserted.
+    """
+    hist: collections.Counter = collections.Counter()
+    for line in text.splitlines():
+        if " = " not in line:
+            continue
+        m = _OP_RE.search(line)
+        if m:
+            hist[m.group(1)] += 1
+    return dict(hist)
+
+
+class HloStore:
+    """Bounded per-process store of compile records keyed by signature."""
+
+    def __init__(self, cap: int = _STORE_CAP) -> None:
+        self._cap = cap
+        self._lock = threading.Lock()
+        self._recs: "collections.OrderedDict[str, Dict[str, Any]]" = (
+            collections.OrderedDict()
+        )
+
+    @staticmethod
+    def _digest(signature: Optional[Dict[str, Any]]) -> str:
+        blob = json.dumps(signature or {}, sort_keys=True, default=str)
+        return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+    def record(
+        self,
+        signature: Optional[Dict[str, Any]],
+        compiled: Any,
+        *,
+        seconds: float = 0.0,
+        cause: str = "",
+    ) -> Dict[str, Any]:
+        """Capture one compile's analyses into the store."""
+        text = hlo_text(compiled)
+        rec = {
+            "signature": dict(signature or {}),
+            "fingerprint": fingerprint(text),
+            "cost": cost_summary(compiled),
+            "memory": memory_summary(compiled),
+            "ops": op_histogram(text),
+            "compile_seconds": float(seconds),
+            "cause": cause,
+        }
+        key = self._digest(signature)
+        with self._lock:
+            self._recs[key] = rec
+            self._recs.move_to_end(key)
+            while len(self._recs) > self._cap:
+                self._recs.popitem(last=False)
+        return rec
+
+    def records(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._recs.items()}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._recs)
+
+    def dump(self, path: str) -> str:
+        """Persist the store as a signature dump for later diffing."""
+        payload = {"kind": "dpcorr_hlo_dump", "signatures": self.records()}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+
+_default_store: Optional[HloStore] = None
+_default_lock = threading.Lock()
+
+
+def default_store() -> HloStore:
+    global _default_store
+    with _default_lock:
+        if _default_store is None:
+            _default_store = HloStore()
+        return _default_store
+
+
+# ---------------------------------------------------------------------------
+# jax-free half: load and diff persisted dumps
+
+
+def load_dump(path: str) -> Dict[str, Dict[str, Any]]:
+    """Read a persisted signature dump; raises ValueError on bad shape."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or data.get("kind") != "dpcorr_hlo_dump":
+        raise ValueError(f"{path}: not a dpcorr_hlo_dump artifact")
+    sigs = data.get("signatures")
+    if not isinstance(sigs, dict):
+        raise ValueError(f"{path}: missing signatures table")
+    return sigs
+
+
+def _sig_label(rec: Dict[str, Any]) -> str:
+    sig = rec.get("signature") or {}
+    if not sig:
+        return "<unsigned>"
+    return ",".join(f"{k}={sig[k]}" for k in sorted(sig))
+
+
+def diff_dumps(
+    a: Dict[str, Dict[str, Any]], b: Dict[str, Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Structural diff of two signature dumps (a = old, b = new)."""
+    added = sorted(set(b) - set(a))
+    removed = sorted(set(a) - set(b))
+    changed: List[Dict[str, Any]] = []
+    for key in sorted(set(a) & set(b)):
+        ra, rb = a[key], b[key]
+        entry: Dict[str, Any] = {
+            "signature": rb.get("signature") or ra.get("signature") or {},
+            "label": _sig_label(rb),
+        }
+        delta = False
+        if ra.get("fingerprint") != rb.get("fingerprint"):
+            entry["fingerprint"] = {
+                "old": ra.get("fingerprint"),
+                "new": rb.get("fingerprint"),
+            }
+            delta = True
+        cost_d: Dict[str, Dict[str, float]] = {}
+        ca, cb = ra.get("cost") or {}, rb.get("cost") or {}
+        for field in sorted(set(ca) | set(cb)):
+            va, vb = float(ca.get(field, 0.0)), float(cb.get(field, 0.0))
+            if va != vb:
+                cost_d[field] = {"old": va, "new": vb}
+        if cost_d:
+            entry["cost"] = cost_d
+            delta = True
+        mem_d: Dict[str, Dict[str, int]] = {}
+        ma, mb = ra.get("memory") or {}, rb.get("memory") or {}
+        for field in sorted(set(ma) | set(mb)):
+            va, vb = int(ma.get(field, 0)), int(mb.get(field, 0))
+            if va != vb:
+                mem_d[field] = {"old": va, "new": vb}
+        if mem_d:
+            entry["memory"] = mem_d
+            delta = True
+        ops_d: Dict[str, Dict[str, int]] = {}
+        oa, ob = ra.get("ops") or {}, rb.get("ops") or {}
+        for op in sorted(set(oa) | set(ob)):
+            va, vb = int(oa.get(op, 0)), int(ob.get(op, 0))
+            if va != vb:
+                ops_d[op] = {"old": va, "new": vb}
+        if ops_d:
+            entry["ops"] = ops_d
+            delta = True
+        if delta:
+            changed.append(entry)
+    return {
+        "added": [
+            {"label": _sig_label(b[k]), "signature": b[k].get("signature", {})}
+            for k in added
+        ],
+        "removed": [
+            {"label": _sig_label(a[k]), "signature": a[k].get("signature", {})}
+            for k in removed
+        ],
+        "changed": changed,
+    }
+
+
+def _fmt_num(v: float) -> str:
+    for unit, scale in (("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f}{unit}"
+    return f"{v:g}"
+
+
+def render_diff(diff: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    for rec in diff.get("added", []):
+        lines.append(f"+ {rec['label']}")
+    for rec in diff.get("removed", []):
+        lines.append(f"- {rec['label']}")
+    for rec in diff.get("changed", []):
+        lines.append(f"~ {rec['label']}")
+        fp = rec.get("fingerprint")
+        if fp:
+            lines.append(f"    hlo fingerprint {fp['old']} -> {fp['new']}")
+        for field, dd in (rec.get("cost") or {}).items():
+            lines.append(
+                f"    {field}: {_fmt_num(dd['old'])} -> {_fmt_num(dd['new'])}"
+            )
+        for field, dd in (rec.get("memory") or {}).items():
+            lines.append(
+                f"    {field}: {_fmt_num(dd['old'])} -> {_fmt_num(dd['new'])}"
+            )
+        ops = rec.get("ops") or {}
+        if ops:
+            parts = [
+                f"{op} {dd['old']}->{dd['new']}" for op, dd in sorted(ops.items())
+            ]
+            lines.append("    ops: " + ", ".join(parts))
+    if not lines:
+        lines.append("dumps are identical.")
+    return "\n".join(lines) + "\n"

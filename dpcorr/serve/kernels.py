@@ -179,7 +179,11 @@ class KernelCache:
                 self._fns[cache_key] = fn
                 self._fns.move_to_end(cache_key)
                 while len(self._fns) > self.max_kernels:
-                    self._fns.popitem(last=False)  # evict LRU
+                    evk, _ = self._fns.popitem(last=False)  # evict LRU
+                    # a later compile of this signature is a recompile
+                    # caused by eviction, not a new signature
+                    self._cobs.note_evicted(compile_mod.signature_key(
+                        self._signature(evk[0], evk[1], evk[2])))
                 self.stats.kernel(hit=False)
                 self.stats.set_kernel_cache_size(len(self._fns))
             return fn

@@ -402,6 +402,16 @@ class ServeStats:
                  for le, x in sorted(ex.items())]
         return body + "\n".join(lines) + "\n"
 
+    def _recompile_snapshot(self) -> dict:
+        # the KernelCache's CompileObserver registers this counter on
+        # our registry; before any compile it simply isn't there yet
+        from dpcorr.utils.compile import RECOMPILE_CAUSES
+
+        rc = self.registry.get("dpcorr_compile_recompile_total")
+        if rc is None:
+            return {}
+        return {c: int(rc.value(cause=c)) for c in RECOMPILE_CAUSES}
+
     def snapshot(self, ledger_snapshot: dict | None = None,
                  cost_aggregate: dict | None = None,
                  budget_dir: dict | None = None) -> dict:
@@ -445,6 +455,10 @@ class ServeStats:
             "exemplars": self.exemplars.snapshot(),
             # fleet identity (ISSUE 11): None for a standalone server
             "instance": self.instance,
+            # recompile attribution (ISSUE 15): why kernels compiled —
+            # a warm boot showing nonzero zero-traffic compiles is
+            # self-explaining through the cause split
+            "recompiles": self._recompile_snapshot(),
         }
         if cost_aggregate is not None:
             snap["costs"] = cost_aggregate

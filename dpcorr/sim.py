@@ -383,10 +383,14 @@ class RepBlockPipeline:
                  block_reps: int, chunk_size: int, family: str = "custom",
                  device=None, counters=None, aot: bool = True,
                  observer=None, impl: str | None = None,
-                 acc_dtype=jnp.float32):
+                 acc_dtype=jnp.float32, profiler=None):
         from dpcorr.obs import transfer as transfer_mod
         from dpcorr.utils import compile as compile_mod
 
+        #: optional obs.prof.BlockProfiler — strictly opt-in: every use
+        #: sits behind ``is not None`` so the unprofiled path costs
+        #: nothing and performs the same single host sync per run()
+        self.profiler = profiler
         self.rep_fn = rep_fn
         self.out_len = int(out_len)
         self.block_reps = int(block_reps)
@@ -486,13 +490,45 @@ class RepBlockPipeline:
         acc = tuple(jnp.zeros((), self.acc_dtype, device=self.sharding)
                     for _ in range(self.out_len))
         cur = self._keygen(jnp.uint32(start_block))
+        prof = self.profiler
+        pstate = None if prof is None else prof.run_start(
+            family=self.family, block_reps=self.block_reps,
+            n_blocks=int(n_blocks), start_block=int(start_block),
+            counters=self._counters)
         for i in range(start_block, start_block + int(n_blocks)):
             cur, acc = self._dispatch(cur, acc, jnp.uint32(i))
             self._counters.donated_blocks.inc()
+            if pstate is not None:
+                # cadence-bounded profiler sync — NEVER taken when no
+                # profiler is attached (the ≤3% A/B gate's invariant)
+                prof.block_boundary(pstate, i - start_block, acc)
         acc = jax.block_until_ready(acc)
         self._counters.fetches.inc()
+        if pstate is not None:
+            prof.run_end(pstate)
         return (tuple(float(a) for a in acc),
                 int(n_blocks) * self.block_reps)
+
+    def cost_summary(self) -> dict:
+        """XLA cost analysis of the compiled block kernel, normalized
+        per replication: ``{flops, bytes, flops_per_rep, bytes_per_rep}``
+        (empty when AOT fell back to lazy jit or the backend offers no
+        analysis). Feeds measured arithmetic intensity into bench
+        artifacts and ``benchmarks/roofline.py``."""
+        if not self.aot_ok or self._blk is self._blk_jit:
+            return {}
+        from dpcorr.obs import hlo as obs_hlo
+
+        cost = obs_hlo.cost_summary(self._blk)
+        if not cost:
+            return {}
+        out = dict(cost)
+        if self.block_reps > 0:
+            if "flops" in cost:
+                out["flops_per_rep"] = cost["flops"] / self.block_reps
+            if "bytes" in cost:
+                out["bytes_per_rep"] = cost["bytes"] / self.block_reps
+        return out
 
     def block_detail(self, i: int = 0):
         """Un-reduced per-rep outputs of block ``i`` — the verification

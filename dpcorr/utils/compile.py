@@ -54,6 +54,20 @@ log = logging.getLogger("dpcorr.compile")
 COMPILE_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                    30.0, 60.0, 120.0, 300.0)
 
+#: Why a compile happened (``dpcorr_compile_recompile_total{cause}``):
+#: ``new-signature`` — first time this signature was seen;
+#: ``cache-evict``  — the signature was compiled before but its entry
+#: was LRU-evicted (warm boots re-paying this are capacity problems);
+#: ``jit-fallback`` — AOT lowering failed and the lazy jit path will
+#: compile on first call instead.
+RECOMPILE_CAUSES = ("new-signature", "cache-evict", "jit-fallback")
+
+
+def signature_key(signature) -> tuple:
+    """Hashable identity of a compile signature dict (sorted items)."""
+    return tuple(sorted((str(k), str(v))
+                        for k, v in (signature or {}).items()))
+
 
 class _Flight:
     """One inflight build: the leader publishes ``value``/``error`` then
@@ -137,6 +151,34 @@ class CompileObserver:
             "dpcorr_compile_total",
             "Kernel compilations by outcome",
             labelnames=("result",))
+        self.recompiles = self.registry.counter(
+            "dpcorr_compile_recompile_total",
+            "Kernel compilations by cause",
+            labelnames=("cause",))
+        self._cause_lock = threading.Lock()
+        self._seen: set = set()     # signature keys ever compiled here
+        self._evicted: set = set()  # keys whose cache entry was dropped
+
+    def note_evicted(self, key) -> None:
+        """A consumer cache dropped this signature's entry — the next
+        compile for it is a recompile caused by eviction, not novelty."""
+        with self._cause_lock:
+            self._evicted.add(key)
+
+    def classify(self, key, ok: bool) -> str:
+        """Attribute one compile to a RECOMPILE_CAUSES cause and count
+        it. Called by :func:`aot_compile` after the outcome is known."""
+        with self._cause_lock:
+            if not ok:
+                cause = "jit-fallback"
+            elif key in self._evicted or key in self._seen:
+                cause = "cache-evict"
+            else:
+                cause = "new-signature"
+            self._seen.add(key)
+            self._evicted.discard(key)
+        self.recompiles.inc(cause=cause)
+        return cause
 
     def tracer(self) -> obs_trace.Tracer:
         # resolved per call, not at construction: the process tracer can
@@ -176,12 +218,25 @@ def aot_compile(jitted, lower_args, *, signature=None,
                 log.warning("AOT compile failed for %s: %s -- falling "
                             "back to lazy jit", attrs or "<kernel>", e)
                 fn, ok = jitted, False
-            sp.set(aot=ok)
+            cause = obs.classify(signature_key(signature), ok)
+            sp.set(aot=ok, cause=cause)
     finally:
         dt = time.perf_counter() - t0
         obs.inflight.dec()
     obs.seconds.observe(dt)
     obs.results.inc(result="aot" if ok else "jit-fallback")
+    if ok:
+        # Compile-time introspection (ISSUE 15): cost/memory analysis,
+        # HLO fingerprint and op histogram into the process store so
+        # `dpcorr obs hlo diff` can compare persisted dumps. Never a
+        # compile-path failure mode.
+        try:
+            from dpcorr.obs import hlo as obs_hlo
+
+            obs_hlo.default_store().record(signature, fn,
+                                           seconds=dt, cause=cause)
+        except Exception:  # noqa: BLE001 — introspection is best-effort
+            pass
     return fn, ok
 
 

@@ -122,6 +122,55 @@ def _load(path: str) -> dict:
         return {}
 
 
+def load_strict(path: str) -> dict:
+    """Like :func:`_load` but corrupt/unreadable raises — the CLI's
+    contract (``dpcorr obs geometry`` exits 1 on a corrupt cache where
+    the hot path deliberately shrugs and re-probes)."""
+    with open(path, encoding="utf-8") as f:
+        state = json.load(f)
+    if not isinstance(state, dict):
+        raise ValueError(f"{path}: geometry cache is not a JSON object")
+    return state
+
+
+def entries(state: dict, *, now: float | None = None) -> list[dict]:
+    """Decompose a cache dict into display rows for the CLI: the
+    ``device_kind|family|n=N|dtype`` key split back into its axes, plus
+    ``age_s`` staleness from ``captured_utc`` (None when unstamped).
+    Malformed keys/values become ``note``-carrying rows, never a crash.
+    """
+    now = time.time() if now is None else now
+    rows: list[dict] = []
+    for key in sorted(state):
+        val = state[key]
+        row: dict = {"key": key}
+        parts = key.split("|")
+        if len(parts) == 4 and parts[2].startswith("n="):
+            row.update(device_kind=parts[0], family=parts[1],
+                       n=parts[2][2:], dtype=parts[3])
+        else:
+            row["note"] = "unrecognized key shape"
+        if isinstance(val, dict):
+            row["chunk_size"] = val.get("chunk_size")
+            row["block_reps"] = val.get("block_reps")
+            row["reps_per_sec"] = val.get("reps_per_sec")
+            cap = val.get("captured_utc")
+            row["captured_utc"] = cap
+            row["age_s"] = None
+            if isinstance(cap, str) and cap:
+                try:
+                    import calendar
+
+                    row["age_s"] = max(0.0, now - calendar.timegm(
+                        time.strptime(cap, "%Y-%m-%dT%H:%M:%SZ")))
+                except ValueError:
+                    row["note"] = "unparseable captured_utc"
+        else:
+            row["note"] = "entry is not an object"
+        rows.append(row)
+    return rows
+
+
 def _store(path: str, key: str, geo: Geometry) -> None:
     state = _load(path)
     state[key] = {"chunk_size": geo.chunk_size,

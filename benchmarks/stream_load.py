@@ -1,0 +1,342 @@
+"""Load + chaos benchmark for the dpcorr stream subsystem (ISSUE 16).
+
+Three arms, one JSON document, exit 1 if any gate fails:
+
+1. **Sketch associativity** (in-process) — for every family,
+   ``release_window`` over several shard partitions of the chunk grid
+   must be *bitwise* identical to the monolithic pass
+   (``json.dumps(..., sort_keys=True)`` equality).
+2. **Reference run** (real process) — ``python -m dpcorr stream`` over
+   HTTP, a single-threaded client interleaving two shards' batches in a
+   fixed order plus a far-future heartbeat; records the release feed,
+   the ledger, and the windows/s throughput stamp.
+3. **Kill / restart** — for each registered ``stream.*`` chaos point,
+   a fresh server with ``DPCORR_CHAOS=point=...,mode=exit`` dies mid-run
+   (``os._exit(42)`` — no flushes, the honest kill); the harness
+   restarts the identical command line and the client re-sends ALL
+   batches in the same fixed order (acked ones dedup via the WAL
+   seen-set). Gates, per case:
+
+   - the server actually died with rc 42 at the planned point;
+   - the recovered ``/releases`` feed is **byte-identical** to the
+     uninterrupted reference;
+   - exact ε: every party's ledger spend equals
+     ``released_windows x per-window charge`` — the idempotent
+     ``stream:<id>:<window>`` charge ids absorbed every replay;
+   - the jax-free ``dpcorr obs budget`` audit replay reproduces the
+     ledger's spent table exactly (the laptop-auditor contract).
+
+Usage:
+    python benchmarks/stream_load.py [--rows-per-batch 48]
+        [--batches-per-window 3] [--windows 4] [--out-json PATH]
+        [--stamp PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FAMILIES = ("ni_sign", "ni_subg", "int_sign", "int_subg")
+STREAM_POINTS = ("stream.mid_window", "stream.pre_release",
+                 "stream.post_journal")
+WINDOW_S = 2.0
+EPS = 0.4
+
+
+# ---------------------------------------------------------- clients ----
+def _post(base: str, path: str, payload: dict, timeout: float = 30.0):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get(base: str, path: str, timeout: float = 30.0):
+    with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+        return resp.read()
+
+
+def _batches(args) -> list[tuple[str, float, list]]:
+    """The fixed batch plan: two shards' batches interleaved
+    deterministically across ``--windows`` tumbling windows, then one
+    far-future heartbeat that closes everything. The SAME list, in the
+    SAME order, is what every arm (and every recovery re-send) plays —
+    fixed order is what makes the feed a pure function of the plan."""
+    import numpy as np
+
+    r = np.random.default_rng(args.seed)
+    out = []
+    for w in range(args.windows):
+        for b in range(args.batches_per_window):
+            shard = "a" if b % 2 == 0 else "b"
+            ts = w * WINDOW_S + (b + 0.5) * WINDOW_S \
+                / (args.batches_per_window + 1)
+            xy = r.multivariate_normal(
+                [0.0, 0.0], [[1.0, 0.6], [0.6, 1.0]],
+                size=args.rows_per_batch)
+            rows = [[round(float(x), 6), round(float(y), 6)]
+                    for x, y in np.clip(xy, -3.0, 3.0)]
+            out.append((f"shard-{shard}:w{w}b{b}", ts, rows))
+    out.append(("heartbeat:final", args.windows * WINDOW_S + 1e6, []))
+    return out
+
+
+# ----------------------------------------------------------- server ----
+def _server_argv(workdir: str) -> list[str]:
+    return [sys.executable, "-m", "dpcorr", "stream",
+            "--workdir", workdir, "--port", "0",
+            "--window-s", str(WINDOW_S),
+            "--families", "ni_sign,int_subg",
+            "--eps1", str(EPS), "--eps2", str(EPS),
+            "--normalise", "on", "--budget", "100", "--seed", "2025"]
+
+
+def _start(workdir: str, chaos_spec: str | None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DPCORR_CHAOS", None)
+    if chaos_spec:
+        env["DPCORR_CHAOS"] = chaos_spec
+    proc = subprocess.Popen(
+        _server_argv(workdir), cwd=REPO, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+    banner = json.loads(proc.stdout.readline())["streaming"]
+    return proc, f"http://127.0.0.1:{banner['port']}", banner
+
+
+def _stop(proc) -> None:
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    if proc.stdout:
+        proc.stdout.close()
+
+
+def _drive(base: str, batches) -> tuple[bool, float]:
+    """Send the full plan; returns (server_died_mid_send, wall_s). A
+    dropped connection means the chaos kill fired — the real-client
+    contract is simply 'anything unacked gets re-sent after restart'."""
+    t0 = time.perf_counter()
+    for bid, ts, rows in batches:
+        try:
+            _post(base, "/ingest",
+                  {"batch_id": bid, "ts": ts, "rows": rows})
+        except (urllib.error.URLError, ConnectionError, OSError):
+            return True, time.perf_counter() - t0
+    return False, time.perf_counter() - t0
+
+
+def _feed_and_stats(base: str) -> tuple[str, dict]:
+    feed = json.loads(_get(base, "/releases?since=0"))["releases"]
+    stats = json.loads(_get(base, "/stats"))
+    return json.dumps(feed, sort_keys=True), stats
+
+
+def _audit_replay_spent(workdir: str) -> dict:
+    """The jax-free laptop audit: ``dpcorr obs budget`` replays the
+    durable trail with nothing but a checkout."""
+    out = subprocess.run(
+        [sys.executable, "-m", "dpcorr", "obs", "budget",
+         "--audit", os.path.join(workdir, "audit.jsonl"), "--json"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, check=True)
+    return json.loads(out.stdout)["spent"]
+
+
+# ------------------------------------------------------------- arms ----
+def _assoc_arm(args) -> dict:
+    from dpcorr.stream import sketch
+    from dpcorr.utils.rng import master_key
+
+    import numpy as np
+
+    r = np.random.default_rng(args.seed)
+    n = args.assoc_n
+    xy = np.clip(r.normal(size=(n, 2)), -3.0, 3.0).astype(np.float32)
+    wkey_master = master_key(args.seed)
+    out = {}
+    for family in FAMILIES:
+        params = sketch.ReleaseParams(family, 0.9, 0.7, normalise=True,
+                                      target_chunk=args.assoc_chunk)
+        grid = sketch.grid_for(params, n)
+        wkey = sketch.window_key(wkey_master, "0-2000")
+        t0 = time.perf_counter()
+        ref = json.dumps(sketch.release_window(xy, params, wkey),
+                         sort_keys=True)
+        dt = time.perf_counter() - t0
+        ids = list(range(grid.n_chunks))
+        splits = {"even_odd": [ids[0::2], ids[1::2]],
+                  "head_tail": [ids[:1], ids[1:]],
+                  "singletons_reversed": [[c] for c in reversed(ids)]}
+        ok = all(
+            json.dumps(sketch.release_window(xy, params, wkey,
+                                             shards=s),
+                       sort_keys=True) == ref
+            for s in splits.values())
+        out[family] = {"n": n, "chunks": grid.n_chunks,
+                       "partitions": len(splits),
+                       "monolithic_s": round(dt, 4), "bitwise_ok": ok}
+    return out
+
+
+def _expected_spent(released: int) -> dict:
+    """windows x per-window charge, from the same release_factor math
+    the service itself uses (an independent derivation would be a
+    second place the cost model could drift)."""
+    from dpcorr.stream.service import window_charges
+
+    per = window_charges(["ni_sign", "int_subg"], EPS, EPS, True,
+                         "party/x", "party/y")
+    return {p: released * v for p, v in per.items()}
+
+
+def _eps_gates(stats: dict, workdir: str, released: int) -> dict:
+    want = _expected_spent(released)
+    ledger = {p: v["spent"]
+              for p, v in stats["ledger"]["parties"].items()}
+    replay = _audit_replay_spent(workdir)
+    exact = all(abs(ledger.get(p, 0.0) - e) < 1e-9
+                for p, e in want.items()) and set(ledger) == set(want)
+    replay_eq = (set(replay) == set(ledger)
+                 and all(abs(replay[p] - ledger[p]) < 1e-9
+                         for p in ledger))
+    return {"expected": want, "ledger": ledger, "audit_replay": replay,
+            "eps_exact": exact, "audit_replay_equal": replay_eq}
+
+
+def _reference_arm(args, workdir: str, batches) -> dict:
+    proc, base, _banner = _start(workdir, None)
+    try:
+        died, wall = _drive(base, batches)
+        assert not died, "reference run lost its server"
+        feed, stats = _feed_and_stats(base)
+    finally:
+        _stop(proc)
+    released = stats["released"]
+    return {"feed": feed, "stats": stats, "ingest_wall_s": wall,
+            "released": released,
+            "windows_per_sec": round(released / wall, 3) if wall else None,
+            "eps": _eps_gates(stats, workdir, released)}
+
+
+def _chaos_case(args, workdir: str, batches, point: str,
+                ref_feed: str) -> dict:
+    spec = f"point={point},hit=1,mode=exit"
+    proc, base, _ = _start(workdir, spec)
+    died, _ = _drive(base, batches)
+    rc = proc.wait(timeout=60)
+    if proc.stdout:
+        proc.stdout.close()
+    case = {"point": point, "server_died_mid_send": died,
+            "kill_rc": rc, "kill_rc_42": rc == 42}
+    # identical command line, no chaos: recovery + full re-send
+    proc2, base2, banner2 = _start(workdir, None)
+    try:
+        died2, _ = _drive(base2, batches)
+        assert not died2, f"{point}: recovered server died again"
+        feed, stats = _feed_and_stats(base2)
+    finally:
+        _stop(proc2)
+    case["recovered_preexisting_releases"] = banner2["released"]
+    case["feed_bit_identical"] = feed == ref_feed
+    case.update(_eps_gates(stats, workdir, stats["released"]))
+    case["ok"] = bool(case["kill_rc_42"] and case["feed_bit_identical"]
+                      and case["eps_exact"]
+                      and case["audit_replay_equal"])
+    return case
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows-per-batch", type=int, default=48)
+    ap.add_argument("--batches-per-window", type=int, default=3)
+    ap.add_argument("--windows", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=777)
+    ap.add_argument("--assoc-n", type=int, default=2000)
+    ap.add_argument("--assoc-chunk", type=int, default=512)
+    ap.add_argument("--out-json", default=None)
+    ap.add_argument("--stamp", default=None,
+                    help="write a bench-trajectory point "
+                         "(stream_windows_per_sec) to this path")
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    batches = _batches(args)
+    doc = {"benchmark": "stream_load",
+           "config": {"rows_per_batch": args.rows_per_batch,
+                      "batches_per_window": args.batches_per_window,
+                      "windows": args.windows, "window_s": WINDOW_S,
+                      "families": ["ni_sign", "int_subg"], "eps": EPS,
+                      "seed": args.seed},
+           "ok": True}
+
+    doc["associativity"] = _assoc_arm(args)
+    assoc_ok = all(f["bitwise_ok"] for f in doc["associativity"].values())
+    print("associativity: " + " ".join(
+        f"{f}={v['bitwise_ok']}" for f, v in doc["associativity"].items()),
+        file=sys.stderr)
+
+    with tempfile.TemporaryDirectory() as td:
+        ref = _reference_arm(args, os.path.join(td, "ref"), batches)
+        doc["reference"] = {k: v for k, v in ref.items()
+                            if k not in ("feed", "stats")}
+        print(f"reference: released={ref['released']} "
+              f"windows/s={ref['windows_per_sec']} "
+              f"eps_exact={ref['eps']['eps_exact']} "
+              f"replay_equal={ref['eps']['audit_replay_equal']}",
+              file=sys.stderr)
+        doc["chaos"] = []
+        for point in STREAM_POINTS:
+            case = _chaos_case(args, os.path.join(td, point), batches,
+                               point, ref["feed"])
+            doc["chaos"].append(case)
+            print(f"{point}: rc42={case['kill_rc_42']} "
+                  f"feed_identical={case['feed_bit_identical']} "
+                  f"eps_exact={case['eps_exact']} "
+                  f"replay_equal={case['audit_replay_equal']}",
+                  file=sys.stderr)
+
+    doc["ok"] = bool(
+        assoc_ok
+        and ref["released"] == args.windows
+        and ref["eps"]["eps_exact"] and ref["eps"]["audit_replay_equal"]
+        and all(c["ok"] for c in doc["chaos"]))
+
+    if args.stamp and doc["ok"] and ref["windows_per_sec"]:
+        stamp = {"metric": "stream_windows_per_sec",
+                 "value": ref["windows_per_sec"],
+                 "unit": "windows/s", "device_kind": "cpu",
+                 "detail": {"windows": args.windows,
+                            "rows_per_window": args.rows_per_batch
+                            * args.batches_per_window,
+                            "families": ["ni_sign", "int_subg"],
+                            "benchmark": "stream_load"}}
+        with open(args.stamp, "w") as f:
+            json.dump(stamp, f, indent=2)
+            f.write("\n")
+
+    print(json.dumps(doc, indent=2))
+    if args.out_json:
+        with open(args.out_json, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

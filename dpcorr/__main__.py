@@ -344,6 +344,67 @@ def _build_server(args):
         instance=args.instance)
 
 
+def cmd_stream(args):
+    """Always-on windowed DP correlation over an ingest stream
+    (dpcorr.stream; docs/STREAMING.md): event-time windows, one atomic
+    ε charge per window, crash-exact releases."""
+    from dpcorr import chaos
+    from dpcorr.stream.http import make_stream_http_server
+    from dpcorr.stream.service import StreamService
+    from dpcorr.stream.windows import WindowSpec
+
+    plan = (chaos.plan_from_spec(args.chaos) if args.chaos
+            else chaos.plan_from_env())
+    if plan is not None:
+        chaos.install(plan)
+    rec = None
+    if args.flight_recorder:
+        import signal
+
+        from dpcorr.obs.recorder import FlightRecorder, install
+
+        rec = FlightRecorder(args.flight_recorder)
+        install(rec)
+        signal.signal(signal.SIGUSR2,
+                      lambda signum, frame: rec.dump("sigusr2"))
+    spec = WindowSpec(size_s=args.window_s, slide_s=args.slide_s,
+                      late_s=args.late_s)
+    service = StreamService(
+        args.workdir, spec, args.families.split(","),
+        args.eps1, args.eps2, normalise=args.normalise == "on",
+        budget=args.budget, seed=args.seed,
+        party_x=args.party_x, party_y=args.party_y,
+        stream_id=args.stream_id, user=args.user,
+        user_budget=args.user_budget, global_budget=args.global_budget,
+        max_pending_rows=args.max_pending_rows)
+    if rec is not None:
+        rec.watch_registry(service.registry)
+        rec.watch_costs(service.costs)
+    # bind BEFORE the banner so --port 0 (ephemeral) is discoverable:
+    # the load harness reads the bound port out of the banner line
+    httpd = make_stream_http_server(service, host=args.host,
+                                    port=args.port)
+    bound_port = httpd.server_address[1]
+    print(json.dumps({"streaming": {
+        "host": args.host, "port": bound_port,
+        "workdir": args.workdir, "stream_id": args.stream_id,
+        "families": list(service.families),
+        "window_s": args.window_s, "slide_s": args.slide_s,
+        "late_s": args.late_s, "eps1": args.eps1, "eps2": args.eps2,
+        "normalise": args.normalise == "on", "budget": args.budget,
+        "eps_per_window": service.per_window_charges,
+        "released": len(service.journal.entries()),
+        "chaos": plan.to_dict() if plan is not None else None,
+        "flight_recorder": args.flight_recorder}}), flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        service.close()
+
+
 def cmd_obs_budget(args):
     """Replay a privacy-budget audit trail (docs/OBSERVABILITY.md):
     per-event ε timeline plus the replayed per-party spend table, which
@@ -592,6 +653,12 @@ def cmd_obs_top(args):
         raise SystemExit(run_fleet_top(args.fleet,
                                        interval_s=args.interval,
                                        once=args.once))
+    if getattr(args, "stream", False):
+        from dpcorr.obs.console import run_stream_top
+
+        raise SystemExit(run_stream_top(args.url,
+                                        interval_s=args.interval,
+                                        once=args.once))
     from dpcorr.obs.console import run_top
 
     raise SystemExit(run_top(args.url, interval_s=args.interval,
@@ -1805,6 +1872,68 @@ def main(argv=None):
                           "SIGUSR2; replay with `dpcorr obs dump PATH`")
     ps_.set_defaults(fn=cmd_serve)
 
+    pst = sub.add_parser("stream", help="always-on windowed DP "
+                         "correlation over an ingest stream "
+                         "(docs/STREAMING.md)")
+    pst.add_argument("--workdir", required=True,
+                     help="durable state directory: ingest WAL, release "
+                          "journal, ledger snapshot, audit trail "
+                          "(restart-safe — a kill -9 resumes from here)")
+    pst.add_argument("--host", default="127.0.0.1")
+    pst.add_argument("--port", type=int, default=8324,
+                     help="HTTP ingest/subscribe port (0 = ephemeral; "
+                          "read the bound port from the banner)")
+    pst.add_argument("--window-s", dest="window_s", type=float,
+                     default=10.0, help="event-time window size")
+    pst.add_argument("--slide-s", dest="slide_s", type=float,
+                     default=None,
+                     help="sliding hop (default: tumbling)")
+    pst.add_argument("--late-s", dest="late_s", type=float, default=0.0,
+                     help="bounded lateness: watermark trails the max "
+                          "event time seen by this much")
+    pst.add_argument("--families", default="ni_sign",
+                     help="comma list of estimator families released "
+                          "per window")
+    pst.add_argument("--eps1", type=float, default=1.0)
+    pst.add_argument("--eps2", type=float, default=0.5)
+    pst.add_argument("--normalise", default="on", choices=["on", "off"])
+    pst.add_argument("--budget", type=float, default=100.0,
+                     help="per-party ε budget (refuse-before-release: "
+                          "an exhausted window is refused, never noised)")
+    pst.add_argument("--seed", type=int, default=2025)
+    pst.add_argument("--party-x", dest="party_x", default="party/x")
+    pst.add_argument("--party-y", dest="party_y", default="party/y")
+    pst.add_argument("--stream-id", dest="stream_id", default="stream",
+                     help="charge-id namespace: per-window charges are "
+                          "stream:<stream-id>:<window-id>")
+    pst.add_argument("--user", default=None,
+                     help="bind every window's charge to this user in a "
+                          "per-user budget directory under the workdir "
+                          "(renewal period = the window hop)")
+    pst.add_argument("--user-budget", dest="user_budget", type=float,
+                     default=None,
+                     help="per-renewal-window user ε budget "
+                          "(default: --budget)")
+    pst.add_argument("--global-budget", dest="global_budget", type=float,
+                     default=None,
+                     help="instance-wide ε cap across every principal")
+    pst.add_argument("--max-pending-rows", dest="max_pending_rows",
+                     type=int, default=1 << 20,
+                     help="bounded ingest: refuse batches (429 + "
+                          "Retry-After) past this many buffered rows")
+    pst.add_argument("--chaos", default=None, metavar="SPEC",
+                     help="install a chaos kill plan, e.g. "
+                          "'point=stream.pre_release,hit=1,mode=exit' "
+                          "(also honoured from DPCORR_CHAOS; testing "
+                          "only — dpcorr.chaos)")
+    pst.add_argument("--flight-recorder", dest="flight_recorder",
+                     default=None, metavar="PATH",
+                     help="flight-recorder dump path (armed for "
+                          "stream_release_failed and chaos kills; "
+                          "replay with `dpcorr obs dump PATH`)")
+    pst.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+    pst.set_defaults(fn=cmd_stream)
+
     po_ = sub.add_parser("obs", help="telemetry tooling: audit-trail "
                          "replay and Chrome-trace export "
                          "(docs/OBSERVABILITY.md)")
@@ -1852,6 +1981,9 @@ def main(argv=None):
                      help="federation view: comma-separated name=url "
                           "targets pointing at party --obs-port "
                           "endpoints; overrides --url and --fleet")
+    pot.add_argument("--stream", action="store_true",
+                     help="render the dpcorr-stream console (windows, "
+                          "watermark, ε/window) instead of the serve one")
     pot.add_argument("--once", action="store_true",
                      help="render one frame and exit (scripting/CI)")
     pot.set_defaults(fn=cmd_obs_top, platform=None, jax_free=True)

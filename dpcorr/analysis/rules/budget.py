@@ -1,5 +1,5 @@
 """Budget discipline: charge-before-noise, refund-on-refusal
-(serve/ and protocol/).
+(serve/, protocol/ and stream/).
 
 The serving layer's privacy invariant (serve.server module docstring)
 is structural: the ledger must be charged — and durably persisted —
@@ -10,8 +10,13 @@ the same invariant with the wire in place of the execution engine: a
 release may be handed to the transport (``channel.send``) only after
 ``ledger.charge``, and a transport failure must refund — that is
 exactly ``protocol.gate.ReleaseGate``, and these rules keep it the
-*only* shape that lints. Two rules, scoped to functions that *hold a
-ledger* (reference ``ledger``/``self.ledger``) — the admission layer —
+*only* shape that lints. The stream layer repeats it once more with
+the window releaser in place of the wire: a closable window reaches
+``releaser.release`` only after its one atomic per-window charge, and
+an in-process release failure must refund
+(``stream.service.StreamService._release_window``). Two rules, scoped
+to functions that *hold a ledger*
+(reference ``ledger``/``self.ledger``) — the admission layer —
 because below the admission boundary (the coalescer, the kernel cache,
 a channel handed in by the gate) requests are charged by contract:
 
@@ -58,11 +63,13 @@ from dpcorr.analysis.core import (
 )
 
 #: method names that hand an admitted request to the execution layer —
-#: or, in protocol/, a release to the transport.
-ENQUEUE_FNS = frozenset({"submit", "run_batch", "send", "send_release"})
+#: in protocol/, a release to the transport; in stream/, a charged
+#: window to the releaser.
+ENQUEUE_FNS = frozenset({"submit", "run_batch", "send", "send_release",
+                         "release"})
 #: receivers those methods count on (any element of the access chain).
 ENQUEUE_RECEIVERS = frozenset({"coalescer", "cache", "channel",
-                               "transport"})
+                               "transport", "releaser"})
 
 CHARGE_FNS = frozenset({"charge", "charge_request"})
 REFUND_FNS = frozenset({"refund"})
@@ -108,7 +115,8 @@ class BudgetChecker(Checker):
 
     def applies_to(self, relpath: str) -> bool:
         parts = relpath.split("/")
-        return "serve" in parts or "protocol" in parts
+        return ("serve" in parts or "protocol" in parts
+                or "stream" in parts)
 
     def check(self, module: Module) -> Iterator[Violation]:
         for fn in ast.walk(module.tree):

@@ -70,6 +70,12 @@ KNOWN_POINTS = (
     "federation.pre_release",  # column artifacts built, round not charged
     "federation.mid_matrix",   # some pair links finished, others pending
     "federation.pre_finish",   # round validated, finish kernel not run
+    # stream window release sequence (stream/service.py) — NOT in
+    # MATRIX_POINTS: the two-party chaos matrix never traverses them;
+    # benchmarks/stream_load.py and the CI stream-smoke job do
+    "stream.pre_release",      # window closable, nothing charged yet
+    "stream.mid_window",       # ingest batch in the WAL, not acked
+    "stream.post_journal",     # release journaled, window not closed
 )
 
 #: The step-kill matrix `dpcorr chaos` sweeps: the points every protocol

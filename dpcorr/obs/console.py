@@ -353,6 +353,99 @@ def run_fleet_top(targets, interval_s: float = 2.0, once: bool = False,
         time.sleep(interval_s)
 
 
+def render_stream_frame(stats: dict, metrics: dict,
+                        now: float | None = None) -> str:
+    """One ``obs top --stream`` frame over a ``dpcorr stream``
+    instance's /stats + /metrics — pure (canned-dict testable)."""
+    lines = []
+    ts = time.strftime("%H:%M:%S",
+                       time.localtime(now if now is not None
+                                      else time.time()))
+    lines.append(f"dpcorr obs top --stream  ·  {ts}")
+    lines.append("-" * 64)
+
+    win = stats.get("window", {})
+    shape = f"{win.get('size_s', 0):g}s"
+    if win.get("slide_s"):
+        shape += f" / slide {win['slide_s']:g}s"
+    shape += f"   late bound {win.get('late_s', 0):g}s"
+    lines.append(f"stream      : {stats.get('stream_id', '?')}   "
+                 f"families {','.join(stats.get('families', []))}")
+    lines.append(f"window      : {shape}")
+
+    wm = stats.get("watermark")
+    lines.append(
+        f"watermark   : {'—' if wm is None else f'{wm:.3f}'}   "
+        f"open {stats.get('open_windows', 0)} windows / "
+        f"{stats.get('pending_rows', 0)} pending rows")
+
+    eps_w = stats.get("eps_per_window", {})
+    released = stats.get("released", 0)
+    lines.append(
+        f"windows     : {released} released   "
+        f"{len(stats.get('refused', []))} refused   "
+        f"ε/window " + "  ".join(f"{p}={_fmt_eps(v)}"
+                                 for p, v in sorted(eps_w.items())))
+
+    overload_key = 'dpcorr_stream_batches_total{kind="overload"}'
+    lines.append(
+        f"ingest      : {stats.get('seen_batches', 0)} batches   "
+        f"{int(metrics.get('dpcorr_stream_rows_total', 0))} rows   "
+        f"{stats.get('late_refused', 0)} late refused   "
+        f"{int(metrics.get(overload_key, 0))} overload")
+
+    rel_count = metrics.get(
+        'dpcorr_stream_release_seconds_count', 0)
+    rel_sum = metrics.get('dpcorr_stream_release_seconds_sum', 0.0)
+    if rel_count:
+        lines.append(f"release     : {rel_sum / rel_count * 1e3:8.2f} ms"
+                     f" mean over {int(rel_count)} windows")
+
+    rows = top_parties(stats.get("ledger"))
+    if rows:
+        lines.append("top ε       : " + "   ".join(
+            f"{name}={_fmt_eps(spent)}"
+            + (f"/{_fmt_eps(budget)}" if budget else "")
+            for name, spent, budget in rows))
+
+    bd = stats.get("budget_dir")
+    if bd:
+        refusals = bd.get("refusals_by_level", {})
+        lines.append(
+            f"budget dir  : {bd.get('shards', 0)} shards   refusals "
+            + "  ".join(f"{lvl}={refusals.get(lvl, 0)}"
+                        for lvl in ("user", "party", "global")))
+    return "\n".join(lines)
+
+
+def run_stream_top(url: str, interval_s: float = 2.0,
+                   once: bool = False, out=None,
+                   max_frames: int | None = None) -> int:
+    """The ``dpcorr obs top --stream`` loop — same scrape/retry/exit
+    contract as :func:`run_top`, rendering the stream frame."""
+    emit = out if out is not None else print
+    frames = 0
+    while True:
+        try:
+            polled = scrape(url)
+        except (urllib.error.URLError, ValueError, OSError) as e:
+            if frames == 0:
+                emit(f"obs top: cannot scrape {url}: {e}")
+                return 1
+            emit(f"obs top: scrape failed ({e}); retrying")
+            time.sleep(interval_s)
+            continue
+        frame = render_stream_frame(polled["stats"], polled["metrics"])
+        if once:
+            emit(frame)
+            return 0
+        emit(_CLEAR + frame)
+        frames += 1
+        if max_frames is not None and frames >= max_frames:
+            return 0
+        time.sleep(interval_s)
+
+
 def run_top(url: str, interval_s: float = 2.0, once: bool = False,
             out=None, max_frames: int | None = None) -> int:
     """The ``dpcorr obs top`` loop. Returns a process exit code: 0 on

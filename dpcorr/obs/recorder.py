@@ -60,6 +60,7 @@ TRIGGER_REASONS = (
     "federation_unhandled",       # a federation party died unexpectedly
     "federation_resume_refused",  # a pair link's resume handshake refused
     "federation_scan_violation",  # cross-pair scan / provenance divergence
+    "stream_release_failed",      # a charged window's release raised
 )
 
 

@@ -1,0 +1,49 @@
+"""`dpcorr stream` — always-on windowed DP correlation (docs/STREAMING.md).
+
+The paper's estimate is one-shot; the ROADMAP's "continuous telemetry
+between two orgs" workload needs it *continuously*: per-window DP
+releases over an unbounded stream — DP under continual observation
+(Dwork et al., STOC 2010). This package is that service, grown from
+parts the repo already has:
+
+- :mod:`dpcorr.stream.sketch` — mergeable per-window sketch states
+  factored out of the chunked accumulators in
+  ``models/estimators/streaming.py``: an associative,
+  bit-deterministic ``merge`` over per-chunk sufficient statistics,
+  so shard sketches tree-reduce across processes and the shard split
+  can never change a release byte.
+- :mod:`dpcorr.stream.windows` — tumbling/sliding event-time windows
+  with a bounded late-data admission (watermark = max event time seen
+  minus the lateness bound). jax-free.
+- :mod:`dpcorr.stream.wal` — ingest WAL (fsynced append before ack)
+  and the released-window journal, the same durability discipline as
+  ``SessionJournal`` / ``BudgetDirectory``. jax-free.
+- :mod:`dpcorr.stream.service` — the window manager + per-window DP
+  release: one atomic :class:`~dpcorr.serve.budget_dir.CompositeLedger`
+  charge per window (refuse-before-release, idempotent
+  ``stream:<stream>:<window>`` charge ids), pinned per-window noise
+  streams (``stream/<window_id>`` subtree), crash-exact resume.
+- :mod:`dpcorr.stream.http` — the ingest/subscribe HTTP front end
+  with the serve stack's overload conventions (bounded ingest queue,
+  429 + ``Retry-After``, ``/metrics`` + ``/stats``).
+"""
+
+from dpcorr.stream.sketch import (  # noqa: F401
+    ChunkGrid,
+    ReleaseParams,
+    SketchState,
+    grid_for,
+    release_window,
+    window_key,
+)
+from dpcorr.stream.service import (  # noqa: F401
+    StreamOverloadedError,
+    StreamService,
+)
+from dpcorr.stream.windows import WindowManager, WindowSpec  # noqa: F401
+
+__all__ = [
+    "ChunkGrid", "ReleaseParams", "SketchState", "StreamOverloadedError",
+    "StreamService", "WindowManager", "WindowSpec", "grid_for",
+    "release_window", "window_key",
+]

@@ -1,0 +1,626 @@
+"""Mergeable window sketches: the streaming accumulators made explicit.
+
+``models/estimators/streaming.py`` already factors all four families
+into per-chunk sufficient statistics — but the chunk loop lives inside
+one ``lax.map``, so the partial sums never exist as values a second
+process could hold. This module reifies them: a :class:`SketchState`
+maps **chunk index → that chunk's stat tuple**, computed by one shared
+jitted kernel per family. Merging two sketches is a *disjoint dict
+union* — associative and commutative by construction, with no float
+reassociation anywhere — and :meth:`SketchState.merge` is therefore
+bit-deterministic under any shard split or tree-reduce order. The fold
+back to totals happens once, at finalize, in a fixed ascending-chunk
+left fold, so
+
+    finalize(merge(shard_a, shard_b)) == finalize(monolithic)
+
+holds **bitwise** for every partition of the chunk set (pinned by
+``tests/test_stream.py`` and gated in ``benchmarks/stream_load.py``).
+
+Noise addressing: every draw hangs off the per-window root
+``stream(master, "stream/<window_id>")`` using the *same substream
+names* as the monolithic streaming estimators (``ni_sign/lap_x``,
+``int_sign/est`` → ``int_sign/flips``, …), so a replayed window is a
+pure function of (master seed, window id, admitted rows) — byte-
+identical wherever and whenever it is recomputed. That is the whole
+crash-recovery contract of :mod:`dpcorr.stream.service`.
+
+Kernel builds go through the serve stack's compile layer
+(:class:`dpcorr.utils.compile.SingleFlight` dedup +
+:func:`dpcorr.utils.compile.aot_compile` under an optional
+:class:`~dpcorr.utils.compile.CompileObserver`), so a stream service's
+chunk kernels show up in the same ``dpcorr_compile_*`` series as the
+serve kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dpcorr.models.estimators import int_sign
+from dpcorr.models.estimators.common import batch_geometry
+from dpcorr.models.estimators.streaming import (
+    _int_subg_chunk_stats,
+    _int_subg_interval,
+    _int_subg_roles,
+    _ni_batch_noise,
+    _ni_chunk_stats,
+    _ni_from_sums,
+    _ni_subg_interval,
+    choose_n_chunk,
+)
+from dpcorr.models.estimators.registry import FAMILIES
+from dpcorr.ops.lambdas import lambda_n
+from dpcorr.ops.noise import clip_sym, laplace
+from dpcorr.ops.standardize import priv_moments_from_sums
+from dpcorr.utils import compile as dpc_compile
+from dpcorr.utils.rng import chunk_key, stream
+
+__all__ = [
+    "ChunkGrid", "ReleaseParams", "SketchState", "grid_for",
+    "moments_for_window", "release_from_sketch", "release_window",
+    "set_compile_observer", "sketch_window", "window_key",
+]
+
+
+def window_key(master: jax.Array, window_id: str) -> jax.Array:
+    """Per-window noise root: the ``stream/<window_id>`` subtree of the
+    party root. Every family substream below it keeps its monolithic
+    name, so a window's noise is addressed by (master, window id) alone
+    — the replay/crash-exactness contract."""
+    if not window_id:
+        raise ValueError("window_id must be non-empty")
+    return stream(master, f"stream/{window_id}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ReleaseParams:
+    """Everything that decides a window release besides the data and
+    the window key. Hashable so kernels cache on it."""
+
+    family: str
+    eps1: float
+    eps2: float
+    normalise: bool = True
+    alpha: float = 0.05
+    eta1: float = 1.0
+    eta2: float = 1.0
+    target_chunk: int = 65536
+
+    def __post_init__(self):
+        if self.family not in FAMILIES:
+            raise ValueError(f"unknown family {self.family!r}; "
+                             f"expected one of {FAMILIES}")
+        if self.eps1 <= 0.0 or self.eps2 <= 0.0:
+            raise ValueError(
+                f"eps must be positive, got ({self.eps1}, {self.eps2})")
+
+    @property
+    def needs_moments(self) -> bool:
+        """Sign families under ``normalise`` standardize privately
+        first — a second pass whose moments every shard must agree on
+        before any estimate chunk can be computed."""
+        return self.normalise and self.family in ("ni_sign", "int_sign")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkGrid:
+    """The chunk geometry of one window: fixed by (family, n, ε) alone,
+    so every shard derives the identical grid independently."""
+
+    family: str
+    n: int
+    n_chunk: int
+    n_chunks: int
+    m: int
+    k: int
+
+    @property
+    def kc(self) -> int:
+        return self.n_chunk // self.m
+
+
+def grid_for(params: ReleaseParams, n: int) -> ChunkGrid:
+    """Chunk grid for an n-row window. NI families align ``n_chunk`` to
+    the batch size m (:func:`choose_n_chunk`) so batches never straddle
+    chunks; INT families stream per-sample (m = 1)."""
+    if params.family in ("ni_sign", "ni_subg"):
+        m, k = batch_geometry(n, params.eps1, params.eps2)
+    else:
+        m, k = 1, n
+    n_chunk = choose_n_chunk(n, m, params.target_chunk)
+    return ChunkGrid(params.family, n, n_chunk, -(-n // n_chunk), m, k)
+
+
+# --------------------------------------------------------- sketches ----
+class SketchState:
+    """Per-chunk sufficient statistics of one window pass.
+
+    ``meta`` pins what the stats are a function of (family, pass, n,
+    grid, params digest, moments); ``chunks`` maps chunk index → a
+    tuple-of-tuples of floats (JSON-safe, exact for float32 values).
+    Two sketches merge only when their meta agrees; overlapping chunk
+    indices must carry identical stats (the same chunk computed twice
+    is fine, a *conflicting* recomputation is corruption)."""
+
+    __slots__ = ("meta", "chunks")
+
+    def __init__(self, meta: Mapping,
+                 chunks: Mapping[int, tuple] | None = None):
+        self.meta = dict(meta)
+        self.chunks: dict[int, tuple] = {
+            int(c): _freeze_stats(st) for c, st in (chunks or {}).items()}
+
+    def merge(self, other: "SketchState") -> "SketchState":
+        """Disjoint-union merge — associative, commutative and
+        bit-deterministic: no arithmetic happens here at all."""
+        if self.meta != other.meta:
+            raise ValueError(
+                f"cannot merge sketches of different windows/passes: "
+                f"{self.meta} != {other.meta}")
+        for c, st in other.chunks.items():
+            if c in self.chunks and self.chunks[c] != st:
+                raise ValueError(
+                    f"chunk {c} carries conflicting stats in the two "
+                    f"sketches — same window recomputed differently")
+        merged = dict(self.chunks)
+        merged.update(other.chunks)
+        return SketchState(self.meta, merged)
+
+    def missing(self, grid: ChunkGrid) -> list[int]:
+        return [c for c in range(grid.n_chunks) if c not in self.chunks]
+
+    def to_dict(self) -> dict:
+        """Wire/journal form (strict JSON; chunk keys as strings)."""
+        return {"meta": dict(self.meta),
+                "chunks": {str(c): [list(s) for s in st]
+                           for c, st in sorted(self.chunks.items())}}
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "SketchState":
+        return cls(d["meta"], {int(c): tuple(tuple(float(v) for v in s)
+                                             for s in st)
+                               for c, st in d["chunks"].items()})
+
+
+def _freeze_stats(st) -> tuple:
+    return tuple(tuple(float(v) for v in s) for s in st)
+
+
+def _fold(sketch: SketchState, grid: ChunkGrid) -> list[list[float]]:
+    """Canonical reduction: ascending-chunk left fold in float64. The
+    ONE place partial sums are combined, so the result cannot depend on
+    which shard held which chunk."""
+    miss = sketch.missing(grid)
+    if miss:
+        raise ValueError(f"sketch incomplete: missing chunks {miss[:8]}"
+                         f"{'…' if len(miss) > 8 else ''} of "
+                         f"{grid.n_chunks}")
+    totals: list[list[float]] | None = None
+    for c in range(grid.n_chunks):
+        st = sketch.chunks[c]
+        if totals is None:
+            totals = [list(s) for s in st]
+        else:
+            for t, s in zip(totals, st):
+                for i, v in enumerate(s):
+                    t[i] += v
+    return totals
+
+
+# ---------------------------------------------------- chunk kernels ----
+# Kernel builds share the serve compile layer: SingleFlight dedups
+# concurrent first-builds and aot_compile records each compile into the
+# (optionally service-owned) CompileObserver, so stream kernels appear
+# in the same dpcorr_compile_* series as serve kernels.
+_KERNELS: dict = {}
+_FLIGHT = dpc_compile.SingleFlight()
+_OBSERVER: dpc_compile.CompileObserver | None = None
+
+
+def set_compile_observer(obs) -> None:
+    """Route subsequent kernel compiles through a service's observer
+    (its /metrics registry). Process-wide, like the kernel cache."""
+    global _OBSERVER
+    _OBSERVER = obs
+
+
+def _get_kernel(kind: str, statics: tuple, build_jitted, example_args):
+    """Compile-once per (kind, statics) through SingleFlight +
+    aot_compile; falls back to the lazily-jitted callable when AOT
+    lowering is unavailable for the arg mix."""
+    key = (kind,) + statics
+    fn = _KERNELS.get(key)
+    if fn is not None:
+        return fn
+
+    def build():
+        jitted = build_jitted()
+        compiled, _ok = dpc_compile.aot_compile(
+            jitted, example_args,
+            signature={"kernel": f"stream.{kind}",
+                       "statics": repr(statics)},
+            observer=_OBSERVER)
+        _KERNELS[key] = compiled
+        return compiled
+
+    fn, _leader = _FLIGHT.do(key, build)
+    return fn
+
+
+def _row_mask(c, n, n_chunk: int, dtype):
+    return ((c * n_chunk + jnp.arange(n_chunk)) < n).astype(dtype)
+
+
+def _pass_a_kernel(n_chunk: int, example_args):
+    def fn(xy, c, n, l_raw):
+        xyc = clip_sym(xy, l_raw)
+        w = _row_mask(c, n, n_chunk, xyc.dtype)[:, None]
+        return jnp.sum(xyc * w, axis=0), jnp.sum(xyc * xyc * w, axis=0)
+
+    return _get_kernel("pass_a", (n_chunk,), lambda: jax.jit(fn),
+                       example_args)
+
+
+def _ni_kernel(mode: str, n_chunk: int, m: int, example_args):
+    kc = n_chunk // m
+
+    def fn(xy, c, k, lap_x, lap_y, mu_x, inv_x, mu_y, inv_y, l_clip,
+           lam1, lam2):
+        if mode == "sign_norm":
+            tx = lambda v: jnp.sign((clip_sym(v, l_clip) - mu_x) * inv_x)
+            ty = lambda v: jnp.sign((clip_sym(v, l_clip) - mu_y) * inv_y)
+        elif mode == "sign_raw":
+            tx = ty = jnp.sign
+        else:  # "clip": NI subG transforms
+            tx = lambda v: clip_sym(v, lam1)
+            ty = lambda v: clip_sym(v, lam2)
+        return _ni_chunk_stats(xy, c, tx, ty, m, kc, k, lap_x, lap_y)
+
+    return _get_kernel(f"ni.{mode}", (n_chunk, m), lambda: jax.jit(fn),
+                       example_args)
+
+
+def _int_sign_kernel(mode: str, n_chunk: int, example_args):
+    def fn(xy, c, n, flip_base, p_keep, mu_x, inv_x, mu_y, inv_y,
+           l_clip):
+        if mode == "sign_norm":
+            sx = lambda v: (clip_sym(v, l_clip) - mu_x) * inv_x
+            sy = lambda v: (clip_sym(v, l_clip) - mu_y) * inv_y
+        else:
+            sx = sy = lambda v: v
+        s = jax.random.bernoulli(chunk_key(flip_base, c), p_keep,
+                                 (n_chunk,))
+        core = ((2.0 * s.astype(jnp.float32) - 1.0)
+                * jnp.sign(sx(xy[:, 0])) * jnp.sign(sy(xy[:, 1])))
+        w = (c * n_chunk + jnp.arange(n_chunk)) < n
+        return (jnp.sum(jnp.where(w, core, 0.0)),)
+
+    return _get_kernel(f"int_sign.{mode}", (n_chunk,),
+                       lambda: jax.jit(fn), example_args)
+
+
+def _int_subg_kernel(sender_is_x: bool, n_chunk: int, example_args):
+    def fn(xy, c, n, noise_base, lam_s, lam_r, eps_s):
+        return _int_subg_chunk_stats(xy, c, noise_base, sender_is_x,
+                                     lam_s, lam_r, eps_s, n, n_chunk)
+
+    return _get_kernel("int_subg", (sender_is_x, n_chunk),
+                       lambda: jax.jit(fn), example_args)
+
+
+# -------------------------------------------------- window pipeline ----
+def _padded(xy: np.ndarray, grid: ChunkGrid) -> np.ndarray:
+    pad = grid.n_chunks * grid.n_chunk - grid.n
+    if pad:
+        xy = np.concatenate(
+            [xy, np.zeros((pad, 2), dtype=xy.dtype)], axis=0)
+    return xy
+
+
+def _chunk(xy_pad: np.ndarray, c: int, grid: ChunkGrid) -> jnp.ndarray:
+    return jnp.asarray(xy_pad[c * grid.n_chunk:(c + 1) * grid.n_chunk])
+
+
+def _f32(v) -> jnp.ndarray:
+    return jnp.asarray(v, jnp.float32)
+
+
+def _i32(v) -> jnp.ndarray:
+    return jnp.asarray(v, jnp.int32)
+
+
+def _meta(params: ReleaseParams, grid: ChunkGrid, pass_name: str,
+          moments: Mapping | None) -> dict:
+    meta = {"family": params.family, "pass": pass_name, "n": grid.n,
+            "n_chunk": grid.n_chunk, "m": grid.m, "k": grid.k,
+            "eps1": params.eps1, "eps2": params.eps2,
+            "normalise": params.normalise, "alpha": params.alpha}
+    if moments is not None:
+        meta["moments"] = {k: float(v) for k, v in sorted(moments.items())}
+    return meta
+
+
+def moments_for_window(pass_a: SketchState, params: ReleaseParams,
+                       grid: ChunkGrid, wkey: jax.Array) -> dict:
+    """DP standardization moments from a complete pass-A sketch: the
+    window's private (μ, 1/σ) per column, drawn from the window key at
+    the family's monolithic substream addresses
+    (``<ns>/std_x`` / ``<ns>/std_y``). Every shard computing pass B
+    must be handed these exact values (they ride the pass-B meta)."""
+    totals = _fold(pass_a, grid)
+    s1, s2 = totals
+    l_clip = math.sqrt(2.0 * math.log(grid.n))
+    ns = params.family
+    out = {}
+    for col, (eps, name) in enumerate(
+            ((params.eps1, "std_x"), (params.eps2, "std_y"))):
+        mu, var = priv_moments_from_sums(
+            stream(wkey, f"{ns}/{name}"), _f32(s1[col]), _f32(s2[col]),
+            grid.n, eps, l_clip)
+        suffix = "x" if col == 0 else "y"
+        out[f"mu_{suffix}"] = float(mu)
+        out[f"inv_{suffix}"] = float(1.0 / jnp.sqrt(var))
+    out["l_clip"] = l_clip
+    return out
+
+
+def sketch_window(xy, params: ReleaseParams, wkey: jax.Array,
+                  pass_name: str = "estimate",
+                  chunk_ids: Sequence[int] | None = None,
+                  moments: Mapping | None = None) -> SketchState:
+    """Sketch one pass over (a shard of) a window.
+
+    ``xy`` is the full (n, 2) admitted-row array — the shard split is
+    over *chunk indices* (``chunk_ids``; None = all), which is what
+    makes shard sketches mergeable: chunk c's stats are a pure function
+    of (rows of chunk c, window key, params), identical whichever shard
+    computes them. ``pass_name`` is ``"pass_a"`` (clipped moment sums,
+    normalise families) or ``"estimate"``; the estimate pass of a
+    normalise family requires ``moments`` from
+    :func:`moments_for_window`."""
+    xy = np.ascontiguousarray(np.asarray(xy, dtype=np.float32))
+    if xy.ndim != 2 or xy.shape[1] != 2:
+        raise ValueError(f"xy must be (n, 2), got {xy.shape}")
+    grid = grid_for(params, xy.shape[0])
+    if pass_name not in ("pass_a", "estimate"):
+        raise ValueError(f"unknown pass {pass_name!r}")
+    if pass_name == "pass_a" and not params.needs_moments:
+        raise ValueError(
+            f"family {params.family!r} (normalise={params.normalise}) "
+            f"has no standardization pass")
+    if pass_name == "estimate" and params.needs_moments \
+            and moments is None:
+        raise ValueError("estimate pass of a normalise family needs "
+                         "moments= from moments_for_window()")
+    ids = range(grid.n_chunks) if chunk_ids is None \
+        else sorted({int(c) for c in chunk_ids})
+    for c in ids:
+        if not 0 <= c < grid.n_chunks:
+            raise ValueError(f"chunk id {c} outside grid "
+                             f"[0, {grid.n_chunks})")
+    xy_pad = _padded(xy, grid)
+    if pass_name == "pass_a":
+        stats = _pass_a_stats(xy_pad, grid, ids)
+    else:
+        stats = _estimate_stats(xy_pad, params, grid, wkey, ids, moments)
+    return SketchState(
+        _meta(params, grid, pass_name,
+              moments if pass_name == "estimate" else None), stats)
+
+
+def _pass_a_stats(xy_pad, grid: ChunkGrid, ids) -> dict[int, tuple]:
+    l_raw = _f32(math.sqrt(2.0 * math.log(grid.n)))
+    n = _i32(grid.n)
+    out = {}
+    kern = None
+    for c in ids:
+        args = (_chunk(xy_pad, c, grid), _i32(c), n, l_raw)
+        if kern is None:
+            kern = _pass_a_kernel(grid.n_chunk, args)
+        s1, s2 = kern(*args)
+        out[c] = (tuple(np.asarray(s1, np.float64)),
+                  tuple(np.asarray(s2, np.float64)))
+    return out
+
+
+def _estimate_stats(xy_pad, params: ReleaseParams, grid: ChunkGrid,
+                    wkey, ids, moments) -> dict[int, tuple]:
+    fam = params.family
+    if fam in ("ni_sign", "ni_subg"):
+        return _ni_stats(xy_pad, params, grid, wkey, ids, moments)
+    if fam == "int_sign":
+        return _int_sign_stats(xy_pad, params, grid, wkey, ids, moments)
+    return _int_subg_stats(xy_pad, params, grid, wkey, ids)
+
+
+def _zero_moments() -> dict:
+    return {"mu_x": 0.0, "inv_x": 1.0, "mu_y": 0.0, "inv_y": 1.0,
+            "l_clip": 1.0}
+
+
+def _ni_stats(xy_pad, params, grid, wkey, ids, moments):
+    ns = "ni_sign" if params.family == "ni_sign" else "ni_subg"
+    if params.family == "ni_sign":
+        mode = "sign_norm" if params.normalise else "sign_raw"
+        scale_x = 2.0 / (grid.m * params.eps1)
+        scale_y = 2.0 / (grid.m * params.eps2)
+        lam1 = lam2 = 1.0
+    else:
+        mode = "clip"
+        lam1 = lambda_n(grid.n, params.eta1)
+        lam2 = lambda_n(grid.n, params.eta2)
+        scale_x = 2.0 * lam1 / (grid.m * params.eps1)
+        scale_y = 2.0 * lam2 / (grid.m * params.eps2)
+    # the (k,) batch-noise draws at the monolithic addresses, padded to
+    # the data-chunk grid (n_chunks*kc >= k) — every shard re-derives
+    # the identical vectors from the window key
+    lap_x, lap_y = _ni_batch_noise(
+        stream(wkey, f"{ns}/lap_x"), stream(wkey, f"{ns}/lap_y"),
+        grid.k, _f32(scale_x), _f32(scale_y), grid.n_chunks * grid.kc)
+    mo = dict(moments) if moments is not None else _zero_moments()
+    k = _i32(grid.k)
+    out = {}
+    kern = None
+    for c in ids:
+        args = (_chunk(xy_pad, c, grid), _i32(c), k, lap_x, lap_y,
+                _f32(mo["mu_x"]), _f32(mo["inv_x"]), _f32(mo["mu_y"]),
+                _f32(mo["inv_y"]), _f32(mo["l_clip"]), _f32(lam1),
+                _f32(lam2))
+        if kern is None:
+            kern = _ni_kernel(mode, grid.n_chunk, grid.m, args)
+        st, st2 = kern(*args)
+        out[c] = ((float(np.asarray(st, np.float64)),),
+                  (float(np.asarray(st2, np.float64)),))
+    return out
+
+
+def _int_sign_stats(xy_pad, params, grid, wkey, ids, moments):
+    mode = "sign_norm" if params.normalise else "sign_raw"
+    eps_s = max(params.eps1, params.eps2)
+    e_s = math.exp(eps_s)
+    p_keep = e_s / (e_s + 1.0)
+    flip_base = stream(stream(wkey, "int_sign/est"), "int_sign/flips")
+    mo = dict(moments) if moments is not None else _zero_moments()
+    n = _i32(grid.n)
+    out = {}
+    kern = None
+    for c in ids:
+        args = (_chunk(xy_pad, c, grid), _i32(c), n, flip_base,
+                _f32(p_keep), _f32(mo["mu_x"]), _f32(mo["inv_x"]),
+                _f32(mo["mu_y"]), _f32(mo["inv_y"]), _f32(mo["l_clip"]))
+        if kern is None:
+            kern = _int_sign_kernel(mode, grid.n_chunk, args)
+        (sum_core,) = kern(*args)
+        out[c] = ((float(np.asarray(sum_core, np.float64)),),)
+    return out
+
+
+def _int_subg_stats(xy_pad, params, grid, wkey, ids):
+    sender_is_x, eps_s, _eps_r, lam_s, lam_r = _int_subg_roles(
+        grid.n, params.eps1, params.eps2, params.eta1, params.eta2)
+    noise_base = stream(wkey, "int_subg/lap_sender")
+    n = _i32(grid.n)
+    out = {}
+    kern = None
+    for c in ids:
+        args = (_chunk(xy_pad, c, grid), _i32(c), n, noise_base,
+                _f32(lam_s), _f32(lam_r), _f32(eps_s))
+        if kern is None:
+            kern = _int_subg_kernel(bool(sender_is_x), grid.n_chunk, args)
+        s1, s2 = kern(*args)
+        out[c] = ((float(np.asarray(s1, np.float64)),),
+                  (float(np.asarray(s2, np.float64)),))
+    return out
+
+
+# ---------------------------------------------------------- release ----
+def release_from_sketch(sketch: SketchState, params: ReleaseParams,
+                        wkey: jax.Array) -> dict:
+    """Fold a complete estimate sketch and finish the release: the
+    window-level noise draws (central Laplace, CI construction) at
+    their monolithic substream addresses under the window key. Returns
+    the strict-JSON release record; ``json.dumps(..., sort_keys=True)``
+    of it is the byte-identity surface the crash gates compare."""
+    grid = ChunkGrid(params.family, int(sketch.meta["n"]),
+                     int(sketch.meta["n_chunk"]), -1,
+                     int(sketch.meta["m"]), int(sketch.meta["k"]))
+    grid = dataclasses.replace(
+        grid, n_chunks=-(-grid.n // grid.n_chunk))
+    totals = _fold(sketch, grid)
+    fam = params.family
+    if fam == "ni_sign":
+        res = _finish_ni_sign(totals, params, grid)
+    elif fam == "ni_subg":
+        res = _finish_ni_subg(totals, params, grid)
+    elif fam == "int_sign":
+        res = _finish_int_sign(totals, params, grid, wkey)
+    else:
+        res = _finish_int_subg(totals, params, grid, wkey)
+    rho, lo, hi = res
+    return {"family": fam, "n": grid.n, "m": grid.m, "k": grid.k,
+            "eps1": params.eps1, "eps2": params.eps2,
+            "normalise": params.normalise, "alpha": params.alpha,
+            "rho": float(rho), "lo": float(lo), "hi": float(hi)}
+
+
+def _finish_ni_sign(totals, params, grid):
+    from jax.scipy.special import ndtri
+
+    (st,), (st2,) = totals
+    eta_hat, s_eta = _ni_from_sums(_f32(st), _f32(st2), grid.k)
+    rho_hat = jnp.sin(jnp.pi * eta_hat / 2.0)
+    half = (float(ndtri(1.0 - params.alpha / 2.0)) * s_eta
+            / jnp.sqrt(float(grid.k)))
+    lo = jnp.sin(jnp.pi / 2.0 * jnp.maximum(eta_hat - half, -1.0))
+    hi = jnp.sin(jnp.pi / 2.0 * jnp.minimum(eta_hat + half, 1.0))
+    return rho_hat, lo, hi
+
+
+def _finish_ni_subg(totals, params, grid):
+    (st,), (st2,) = totals
+    eta_hat, s_t = _ni_from_sums(_f32(st), _f32(st2), grid.k)
+    lam1 = lambda_n(grid.n, params.eta1)
+    lam2 = lambda_n(grid.n, params.eta2)
+    res = _ni_subg_interval(eta_hat, s_t, grid.k, grid.m, lam1, lam2,
+                            params.alpha)
+    return res.rho_hat, res.ci_low, res.ci_high
+
+
+def _finish_int_sign(totals, params, grid, wkey):
+    ((sum_core,),) = totals
+    eps_s = max(params.eps1, params.eps2)
+    eps_r = min(params.eps1, params.eps2)
+    e_s = math.exp(eps_s)
+    est_key = stream(wkey, "int_sign/est")
+    scale_z = 2.0 * (e_s + 1.0) / (grid.n * (e_s - 1.0) * eps_r)
+    z = laplace(stream(est_key, "int_sign/lap_z"), (), scale_z)
+    eta_hat = (e_s + 1.0) / (grid.n * (e_s - 1.0)) * _f32(sum_core) + z
+    rho_hat = jnp.sin(jnp.pi * eta_hat / 2.0)
+    res = int_sign.interval_from_rho(wkey, rho_hat, grid.n, eps_s,
+                                     eps_r, params.alpha, "auto", "det")
+    return res.rho_hat, res.ci_low, res.ci_high
+
+
+def _finish_int_subg(totals, params, grid, wkey):
+    (s1,), (s2,) = totals
+    _sx, eps_s, eps_r, lam_s, lam_r = _int_subg_roles(
+        grid.n, params.eps1, params.eps2, params.eta1, params.eta2)
+    res = _int_subg_interval(wkey, _f32(s1), _f32(s2), grid.n, eps_s,
+                             eps_r, lam_s, lam_r, params.alpha, "det")
+    return res.rho_hat, res.ci_low, res.ci_high
+
+
+def release_window(xy, params: ReleaseParams, wkey: jax.Array,
+                   shards: Sequence[Sequence[int]] | None = None
+                   ) -> dict:
+    """Full window pipeline: (pass A → moments →) estimate sketch →
+    fold → release. ``shards`` splits every pass's chunk set (e.g.
+    ``[[0, 2], [1, 3]]``) and merges the shard sketches — the release
+    is bitwise identical for every partition, which is exactly what the
+    associativity gate runs this function to prove."""
+    xy = np.ascontiguousarray(np.asarray(xy, dtype=np.float32))
+    grid = grid_for(params, xy.shape[0])
+    if shards is None:
+        shards = [list(range(grid.n_chunks))]
+    moments = None
+    if params.needs_moments:
+        pass_a = _merged(xy, params, wkey, "pass_a", shards, None)
+        moments = moments_for_window(pass_a, params, grid, wkey)
+    est = _merged(xy, params, wkey, "estimate", shards, moments)
+    return release_from_sketch(est, params, wkey)
+
+
+def _merged(xy, params, wkey, pass_name, shards, moments) -> SketchState:
+    merged: SketchState | None = None
+    for ids in shards:
+        sk = sketch_window(xy, params, wkey, pass_name, chunk_ids=ids,
+                           moments=moments)
+        merged = sk if merged is None else merged.merge(sk)
+    return merged

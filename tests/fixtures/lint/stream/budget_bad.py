@@ -1,0 +1,13 @@
+"""Violating fixture: both budget rules fire on the stream release
+shape (the `stream/` path segment puts this file in the checker's
+scope, and `releaser.release` is an enqueue site)."""
+
+
+class StreamService:
+    def release_uncharged(self, window):
+        self.releaser.release(window)  # budget-uncharged-noise
+        self.ledger.charge(self.charges, charge_id=window.id)
+
+    def release_no_refund(self, window):
+        self.ledger.charge(self.charges, charge_id=window.id)
+        self.releaser.release(window)  # budget-missing-refund

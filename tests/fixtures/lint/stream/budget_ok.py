@@ -1,0 +1,20 @@
+"""Clean fixture: the stream release shape that lints — one write-ahead
+ledger charge per window, the releaser handoff refund-guarded; and a
+below-admission releaser (no ledger in scope) that executes freely."""
+
+
+class StreamService:
+    def release_window(self, window):
+        self.ledger.charge(self.charges, charge_id=window.id)
+        try:
+            self.releaser.release(window)
+        except RuntimeError:
+            self.ledger.refund(self.charges, charge_id=window.id)
+            raise
+
+
+class Releaser:
+    def release(self, window):
+        # execution layer: windows arriving here are charged by
+        # contract, and no ledger is in scope
+        return self.sketch(window.rows)

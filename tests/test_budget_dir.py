@@ -174,6 +174,60 @@ def test_renewal_policy_validation():
         RenewalPolicy(burst_cap=-1.0)
 
 
+def test_renewal_boundary_charge_lands_in_new_window_only(tmp_path):
+    """A charge whose clock sits *exactly* on the renewal boundary
+    (now == window_start + period_s) renews first and then charges: the
+    spend belongs entirely to the new window, never to both. This is
+    the alignment contract the stream service leans on when it pins the
+    directory clock to window starts with period_s == hop_s — the epoch
+    boundary IS the renewal boundary."""
+    now = {"t": 1000.0}
+    d = _dir(tmp_path, user_budget=0.5,
+             renewal=RenewalPolicy(period_s=100.0),
+             clock=lambda: now["t"])
+    d.charge("u", 0.3)
+    assert d.spent("u") == pytest.approx(0.3)
+    now["t"] = 1100.0  # exactly w + period_s: boundary-inclusive renewal
+    d.charge("u", 0.2)
+    # the new window holds only the new charge — 0.3 did not leak in
+    assert d.spent("u") == pytest.approx(0.2)
+    assert d.headroom("u") == pytest.approx(0.3)
+    # and the old window's spend was not forgotten either: lifetime
+    # counts both, renewals fired exactly once
+    assert d.lifetime("u") == pytest.approx(0.5)
+    assert d.counters()["renewals"] == 1
+    # one tick *before* the next boundary stays in the current window
+    now["t"] = 1199.0
+    d.charge("u", 0.1)
+    assert d.spent("u") == pytest.approx(0.3)
+    assert d.counters()["renewals"] == 1
+
+
+def test_renewal_epoch_aligned_stream_of_window_releases(tmp_path):
+    """Stream-service alignment: the directory clock steps through
+    window-start epochs (0, hop, 2*hop, ...) with period_s == hop_s, so
+    each release epoch maps to exactly one renewal window. Every epoch
+    sees the full per-window headroom and each window's charge is
+    counted exactly once (lifetime == sum of all charges)."""
+    hop = 10.0
+    per_window = 0.4
+    now = {"t": 0.0}
+    d = _dir(tmp_path, user_budget=0.5,
+             renewal=RenewalPolicy(period_s=hop),
+             clock=lambda: now["t"])
+    for epoch in range(5):
+        now["t"] = epoch * hop
+        # without a boundary renewal the second epoch would already be
+        # refused (0.4 + 0.4 > 0.5) — every admission past epoch 0 is
+        # itself proof the charge landed in a fresh window
+        d.charge("u", per_window)
+        # ... and the fresh window holds exactly this epoch's charge
+        assert d.spent("u") == pytest.approx(per_window)
+        assert d.headroom("u") == pytest.approx(0.5 - per_window)
+    assert d.lifetime("u") == pytest.approx(5 * per_window)
+    assert d.counters()["renewals"] == 4  # epochs 1..4 each renewed once
+
+
 # ------------------------------------------- persistence / routing ----
 def test_reopen_recovers_exact_balances(tmp_path):
     d = _dir(tmp_path, shards=4)

@@ -130,6 +130,21 @@ def test_protocol_budget_ok_fixture_is_clean():
     assert lint_fixture("protocol/budget_ok.py") == []
 
 
+def test_stream_budget_bad_fixture_fires_both_budget_rules():
+    """The budget rules extend to stream/: handing a window to the
+    releaser is an enqueue, so it needs a dominating per-window charge
+    and a refund guard (stream.service._release_window's shape)."""
+    vs = lint_fixture("stream/budget_bad.py")
+    assert fired(vs) == [
+        ("budget-missing-refund", 13),
+        ("budget-uncharged-noise", 8),
+    ]
+
+
+def test_stream_budget_ok_fixture_is_clean():
+    assert lint_fixture("stream/budget_ok.py") == []
+
+
 def test_rawdata_bad_fixture_fires_on_aliased_columns():
     vs = lint_fixture("protocol/rawdata_bad.py")
     assert fired(vs) == [

@@ -1,0 +1,644 @@
+"""The dpcorr stream subsystem: mergeable sketches, event-time
+windows, WAL/journal durability, and the crash-exact release sequence.
+
+The load-bearing properties, each pinned here:
+
+- **Sketch associativity** — ``release_window`` is *bitwise* identical
+  under every shard partition of the chunk grid (merge is a disjoint
+  dict union; the fold is one fixed-order reduction).
+- **Crash exactness** — a ``SimulatedCrash`` at each registered stream
+  chaos point, followed by recovery + full client re-send, yields a
+  byte-identical release feed and exactly-once ε (idempotent per-window
+  charge ids). The subprocess/kill -9 form of the same gate lives in
+  ``benchmarks/stream_load.py`` and the CI stream-smoke job.
+- **Durability discipline** — the WAL/journal tolerate exactly one torn
+  tail line and quarantine anything worse.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpcorr import chaos
+from dpcorr.obs.console import render_stream_frame
+from dpcorr.serve.ledger import release_factor
+from dpcorr.stream import sketch
+from dpcorr.stream.http import make_stream_http_server
+from dpcorr.stream.service import (
+    StreamOverloadedError,
+    StreamService,
+    window_charges,
+)
+from dpcorr.stream.sketch import ReleaseParams, SketchState, release_window
+from dpcorr.stream.wal import IngestWAL, ReleaseJournal, StreamCorruptError
+from dpcorr.stream.windows import (
+    LateRecordError,
+    WindowManager,
+    WindowSpec,
+)
+from dpcorr.utils.rng import master_key
+
+FAMILIES = ("ni_sign", "ni_subg", "int_sign", "int_subg")
+
+
+def _rows(n, seed=0):
+    r = np.random.default_rng(seed)
+    return np.clip(r.normal(size=(n, 2)), -3.0, 3.0).astype(np.float32)
+
+
+# ---------------------------------------------------------- windows ----
+class TestWindowSpec:
+    def test_tumbling_spans(self):
+        spec = WindowSpec(size_s=10.0)
+        assert spec.spans_for(25.0) == [(20.0, 30.0)]
+        assert spec.spans_for(20.0) == [(20.0, 30.0)]  # half-open start
+        assert spec.hop_s == 10.0
+
+    def test_sliding_spans(self):
+        spec = WindowSpec(size_s=10.0, slide_s=5.0)
+        assert spec.spans_for(12.0) == [(5.0, 15.0), (10.0, 20.0)]
+        # near the origin the negative-start spans are clipped away
+        assert spec.spans_for(3.0) == [(0.0, 10.0)]
+        assert spec.hop_s == 5.0
+
+    def test_window_id_is_millisecond_exact(self):
+        assert WindowSpec.window_id((7.5, 17.5)) == "7500-17500"
+        assert WindowSpec.window_id((0.0, 10.0)) == "0-10000"
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            WindowSpec(size_s=0.0)
+        with pytest.raises(ValueError):
+            WindowSpec(size_s=10.0, slide_s=11.0)  # slide > size
+        with pytest.raises(ValueError):
+            WindowSpec(size_s=10.0, late_s=-1.0)
+        with pytest.raises(ValueError):
+            WindowSpec(size_s=10.0).spans_for(-1.0)
+
+
+class TestWindowManager:
+    def test_heartbeat_advances_watermark_without_windows(self):
+        m = WindowManager(WindowSpec(size_s=10.0))
+        assert m.admit(42.0, []) == []
+        assert m.watermark == 42.0
+        assert not m.windows
+
+    def test_late_refusal_counts_and_raises(self):
+        m = WindowManager(WindowSpec(size_s=10.0))
+        m.admit(20.0, [(1.0, 2.0)])
+        with pytest.raises(LateRecordError) as ei:
+            m.admit(5.0, [(1.0, 2.0)])
+        assert ei.value.watermark == 20.0
+        assert m.late_refused == 1
+        # an old-ts heartbeat is harmless: nothing to admit
+        m.admit(5.0, [])
+        assert m.watermark == 20.0
+
+    def test_bounded_lateness_admits_between_watermark_and_max(self):
+        m = WindowManager(WindowSpec(size_s=10.0, late_s=5.0))
+        m.admit(20.0, [(0.0, 0.0)])
+        assert m.watermark == 15.0
+        m.admit(16.0, [(0.0, 0.0)])  # late but inside the bound
+        with pytest.raises(LateRecordError):
+            m.admit(14.0, [(0.0, 0.0)])
+
+    def test_closable_is_watermark_gated_and_ordered(self):
+        m = WindowManager(WindowSpec(size_s=10.0))
+        m.admit(5.0, [(0.0, 0.0)])
+        assert [w.id for w in m.closable()] == []  # watermark == 5 < 10
+        m.admit(15.0, [(0.0, 0.0)])
+        # watermark 15 passed the first window's end but not the second
+        assert [w.id for w in m.closable()] == ["0-10000"]
+        m.admit(25.0, [])
+        assert [w.id for w in m.closable()] == ["0-10000", "10000-20000"]
+        m.close("0-10000")
+        assert [w.id for w in m.closable()] == ["10000-20000"]
+
+    def test_closed_span_skip_still_feeds_open_siblings(self):
+        """Recovery replay: rows whose earlier (journaled) span is
+        closed must still land in the open sliding siblings."""
+        m = WindowManager(WindowSpec(size_s=10.0, slide_s=5.0))
+        m.close("5000-15000")
+        hit = m.admit(12.0, [(1.0, 1.0)])
+        assert hit == ["10000-20000"]
+        assert m.reclosed_skips == 1
+        assert "5000-15000" not in m.windows  # never resurrected
+
+
+# --------------------------------------------------------- sketches ----
+class TestSketchAssociativity:
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_shard_split_is_bitwise_identical(self, family):
+        """The tentpole determinism claim: every partition of the chunk
+        set releases the same bytes as the monolithic pass."""
+        n = 600
+        xy = _rows(n, seed=3)
+        params = ReleaseParams(family, 0.9, 0.7, normalise=True,
+                               target_chunk=128)
+        grid = sketch.grid_for(params, n)
+        assert grid.n_chunks >= 3, "need a real multi-chunk grid"
+        wkey = sketch.window_key(master_key(77), "0-10000")
+        ref = json.dumps(release_window(xy, params, wkey), sort_keys=True)
+        ids = list(range(grid.n_chunks))
+        partitions = [
+            [ids[0::2], ids[1::2]],             # even/odd
+            [ids[:1], ids[1:]],                 # head/tail
+            [[c] for c in reversed(ids)],       # singletons, reversed
+        ]
+        for shards in partitions:
+            got = json.dumps(release_window(xy, params, wkey,
+                                            shards=shards),
+                             sort_keys=True)
+            assert got == ref, f"shard split {shards} diverged"
+
+    def test_normalise_off_single_pass(self):
+        n = 400
+        xy = _rows(n, seed=4)
+        params = ReleaseParams("ni_sign", 1.0, 1.0, normalise=False,
+                               target_chunk=128)
+        wkey = sketch.window_key(master_key(5), "w")
+        ref = json.dumps(release_window(xy, params, wkey), sort_keys=True)
+        grid = sketch.grid_for(params, n)
+        ids = list(range(grid.n_chunks))
+        got = json.dumps(
+            release_window(xy, params, wkey, shards=[ids[1:], ids[:1]]),
+            sort_keys=True)
+        assert got == ref
+
+
+class TestSketchState:
+    def _sketches(self):
+        xy = _rows(200, seed=9)
+        params = ReleaseParams("int_subg", 1.0, 0.5, target_chunk=64)
+        wkey = sketch.window_key(master_key(1), "w")
+        grid = sketch.grid_for(params, 200)
+        ids = list(range(grid.n_chunks))
+        a = sketch.sketch_window(xy, params, wkey, chunk_ids=ids[0::2])
+        b = sketch.sketch_window(xy, params, wkey, chunk_ids=ids[1::2])
+        return a, b, params, wkey, grid
+
+    def test_merge_order_invariant(self):
+        a, b, params, wkey, _ = self._sketches()
+        ab = json.dumps(sketch.release_from_sketch(a.merge(b), params,
+                                                   wkey), sort_keys=True)
+        ba = json.dumps(sketch.release_from_sketch(b.merge(a), params,
+                                                   wkey), sort_keys=True)
+        assert ab == ba
+
+    def test_merge_rejects_meta_mismatch(self):
+        a, _, params, wkey, _ = self._sketches()
+        other = sketch.sketch_window(
+            _rows(200, seed=9),
+            ReleaseParams("int_subg", 2.0, 0.5, target_chunk=64), wkey)
+        with pytest.raises(ValueError, match="different windows"):
+            a.merge(other)
+
+    def test_merge_rejects_conflicting_chunk(self):
+        a, b, *_ = self._sketches()
+        evil = SketchState(b.meta, dict(b.chunks))
+        some = next(iter(evil.chunks))
+        evil.chunks[some] = ((123.0,), (456.0,))
+        merged = a.merge(b)
+        with pytest.raises(ValueError, match="conflicting stats"):
+            merged.merge(evil)
+
+    def test_overlapping_identical_chunks_merge_fine(self):
+        a, b, *_ = self._sketches()
+        # recomputing the same chunk on two shards is legal
+        assert a.merge(b).chunks == a.merge(b).merge(b).chunks
+
+    def test_dict_roundtrip_preserves_bytes(self):
+        a, b, params, wkey, _ = self._sketches()
+        merged = a.merge(b)
+        back = SketchState.from_dict(
+            json.loads(json.dumps(merged.to_dict())))
+        assert json.dumps(
+            sketch.release_from_sketch(back, params, wkey),
+            sort_keys=True) == json.dumps(
+            sketch.release_from_sketch(merged, params, wkey),
+            sort_keys=True)
+
+    def test_incomplete_fold_refuses(self):
+        a, _, params, wkey, _ = self._sketches()
+        with pytest.raises(ValueError, match="incomplete"):
+            sketch.release_from_sketch(a, params, wkey)
+
+    def test_window_key_rejects_empty_id(self):
+        with pytest.raises(ValueError):
+            sketch.window_key(master_key(0), "")
+
+
+# ------------------------------------------------------- durability ----
+class TestIngestWAL:
+    def test_append_replay_seq_continuity(self, tmp_path):
+        p = str(tmp_path / "wal.jsonl")
+        w = IngestWAL(p, fsync=False)
+        assert w.append("b1", 1.0, [[1.0, 2.0]]) == 1
+        assert w.append("b2", 2.0, []) == 2
+        w.close()
+        w2 = IngestWAL(p, fsync=False)
+        recs = list(w2.replay())
+        assert [r["batch_id"] for r in recs] == ["b1", "b2"]
+        assert w2.append("b3", 3.0, []) == 3  # continues past replayed
+
+    def test_torn_tail_is_dropped_silently(self, tmp_path):
+        p = str(tmp_path / "wal.jsonl")
+        w = IngestWAL(p, fsync=False)
+        w.append("b1", 1.0, [])
+        w.close()
+        with open(p, "a") as fh:
+            fh.write('{"seq": 2, "batch_id": "to')  # kill mid-append
+        recs = list(IngestWAL(p, fsync=False).replay())
+        assert [r["batch_id"] for r in recs] == ["b1"]
+
+    def test_midfile_corruption_quarantines_and_raises(self, tmp_path):
+        p = str(tmp_path / "wal.jsonl")
+        w = IngestWAL(p, fsync=False)
+        w.append("b1", 1.0, [])
+        w.append("b2", 2.0, [])
+        w.close()
+        lines = open(p).read().splitlines()
+        lines[0] = "NOT JSON"
+        with open(p, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        with pytest.raises(StreamCorruptError):
+            list(IngestWAL(p, fsync=False).replay())
+        assert not os.path.exists(p)  # moved aside, not half-read
+        assert any(f.startswith("wal.jsonl.corrupt")
+                   for f in os.listdir(tmp_path))
+
+    def test_compact_keeps_selected(self, tmp_path):
+        p = str(tmp_path / "wal.jsonl")
+        w = IngestWAL(p, fsync=False)
+        for i in range(4):
+            w.append(f"b{i}", float(i), [])
+        w.compact(lambda r: r["batch_id"] in ("b2", "b3"))
+        recs = list(IngestWAL(p, fsync=False).replay())
+        assert [r["batch_id"] for r in recs] == ["b2", "b3"]
+
+
+class TestReleaseJournal:
+    def test_idempotent_append_and_seq(self, tmp_path):
+        p = str(tmp_path / "rel.jsonl")
+        j = ReleaseJournal(p, fsync=False)
+        e1 = j.append("w1", {"rows": 3})
+        assert e1["release_seq"] == 1
+        again = j.append("w1", {"rows": 999})  # replayed release
+        assert again == e1
+        e2 = j.append("w2", {"rows": 5})
+        assert e2["release_seq"] == 2
+        j.close()
+        j2 = ReleaseJournal(p, fsync=False)
+        assert [e["window_id"] for e in j2.entries()] == ["w1", "w2"]
+        assert "w1" in j2 and j2.get("w1")["rows"] == 3
+
+
+# ---------------------------------------------------------- service ----
+def _service(workdir, **kw):
+    defaults = dict(
+        spec=WindowSpec(size_s=10.0), families=("ni_sign",),
+        eps1=0.8, eps2=0.8, normalise=False, budget=10.0, seed=7,
+        fsync=False)
+    defaults.update(kw)
+    return StreamService(str(workdir), **defaults)
+
+
+def _feed(sv, batches):
+    """Send every batch, swallowing refusals the way a client would."""
+    acks = []
+    for bid, ts, rows in batches:
+        try:
+            acks.append(sv.ingest(bid, ts, rows))
+        except (LateRecordError, StreamOverloadedError):
+            acks.append(None)
+    return acks
+
+
+BATCHES = [
+    ("b1", 1.0, [[0.5, 0.4], [-0.2, 0.3], [1.0, -1.0], [0.1, 0.2]]),
+    ("b2", 4.0, [[0.3, 0.3], [-0.4, -0.5], [0.8, 0.9], [-1.0, 0.7]]),
+    ("b3", 12.0, [[0.2, -0.2], [0.6, 0.5], [-0.7, -0.6], [0.9, 0.1]]),
+    ("hb", 50.0, []),  # far-future heartbeat closes everything
+]
+
+
+class TestStreamService:
+    def test_release_eps_and_feed(self, tmp_path):
+        sv = _service(tmp_path)
+        acks = _feed(sv, BATCHES)
+        assert acks[-1]["released"]  # the heartbeat closed windows
+        feed = sv.releases()
+        assert [e["window_id"] for e in feed] == ["0-10000",
+                                                 "10000-20000"]
+        per = sv.per_window_charges
+        assert per == {"party/x": 0.8, "party/y": 0.8}
+        snap = sv.ledger.snapshot()
+        for p in ("party/x", "party/y"):
+            assert snap["parties"][p]["spent"] == pytest.approx(
+                2 * per[p])
+        e = feed[0]
+        assert e["rows"] == 8 and e["eps_window"] == pytest.approx(1.6)
+        assert e["charge_id"] == "stream:stream:0-10000"
+        assert set(e["releases"]) == {"ni_sign"}
+        assert {"rho", "lo", "hi"} <= set(e["releases"]["ni_sign"])
+        # the subscribe cursor works
+        assert [x["window_id"] for x in sv.releases(since=1)] == [
+            "10000-20000"]
+        sv.close()
+
+    def test_dedup_is_free(self, tmp_path):
+        sv = _service(tmp_path)
+        sv.ingest("b1", 1.0, [[0.1, 0.2]])
+        ack = sv.ingest("b1", 1.0, [[0.1, 0.2]])
+        assert ack["deduped"] and ack["seq"] is None
+        assert sv.stats()["seen_batches"] == 1
+        sv.close()
+
+    def test_refuse_before_release_spends_nothing(self, tmp_path):
+        sv = _service(tmp_path, budget=0.5)  # < the 0.8 window charge
+        _feed(sv, BATCHES)
+        st = sv.stats()
+        assert st["released"] == 0
+        assert st["refused"] == ["0-10000", "10000-20000"]
+        snap = sv.ledger.snapshot()
+        assert snap["parties"] == {} or all(
+            v["spent"] == 0.0 for v in snap["parties"].values())
+        sv.close()
+
+    def test_overload_backpressure(self, tmp_path):
+        sv = _service(tmp_path, max_pending_rows=6)
+        sv.ingest("b1", 1.0, [[0.0, 0.0]] * 5)
+        with pytest.raises(StreamOverloadedError) as ei:
+            sv.ingest("b2", 2.0, [[0.0, 0.0]] * 5)
+        assert ei.value.retry_after_s > 0.0
+        # the refused batch was NOT recorded: re-send succeeds later
+        assert "b2" not in sv._seen
+        sv.close()
+
+    def test_late_refusal_maps_through(self, tmp_path):
+        sv = _service(tmp_path)
+        sv.ingest("b1", 100.0, [[0.0, 0.0]])
+        with pytest.raises(LateRecordError):
+            sv.ingest("b2", 5.0, [[0.0, 0.0]])
+        assert sv.stats()["late_refused"] == 1
+        sv.close()
+
+    def test_stats_shape(self, tmp_path):
+        sv = _service(tmp_path)
+        st = sv.stats()
+        assert st["eps_per_window"] == {"party/x": 0.8, "party/y": 0.8}
+        assert st["watermark"] is None
+        assert st["window"]["size_s"] == 10.0
+        assert "dpcorr_stream_rows_total" in sv.render_metrics()
+        sv.close()
+
+
+class TestCrashExactRecovery:
+    """SimulatedCrash at each stream chaos point; recovery + full
+    client re-send must reproduce the reference feed byte-for-byte and
+    spend each window's ε exactly once."""
+
+    def _run_reference(self, workdir):
+        sv = _service(workdir)
+        _feed(sv, BATCHES)
+        feed = json.dumps(sv.releases(), sort_keys=True)
+        spent = {p: v["spent"]
+                 for p, v in sv.ledger.snapshot()["parties"].items()}
+        sv.close()
+        return feed, spent
+
+    @pytest.mark.parametrize("point,hit", [
+        ("stream.mid_window", 1),   # first batch in WAL, not acked
+        ("stream.mid_window", 3),   # mid-stream
+        ("stream.pre_release", 1),  # window closable, nothing charged
+        ("stream.post_journal", 1),  # journaled, not closed
+    ])
+    def test_crash_then_recover_bit_identical(self, tmp_path, point, hit):
+        ref_feed, ref_spent = self._run_reference(tmp_path / "ref")
+        work = tmp_path / "crash"
+        chaos.install(chaos.ChaosPlan(point, hit=hit, mode="raise"))
+        try:
+            sv = _service(work)
+            crashed = False
+            for bid, ts, rows in BATCHES:
+                try:
+                    sv.ingest(bid, ts, rows)
+                except chaos.SimulatedCrash:
+                    crashed = True
+                    break
+            assert crashed, f"plan {point}#{hit} never fired"
+        finally:
+            chaos.clear()
+        # recovery process: fresh service over the same workdir, client
+        # re-sends EVERYTHING (acked batches dedup via the WAL seen-set)
+        sv2 = _service(work)
+        _feed(sv2, BATCHES)
+        assert json.dumps(sv2.releases(), sort_keys=True) == ref_feed
+        spent = {p: v["spent"]
+                 for p, v in sv2.ledger.snapshot()["parties"].items()}
+        assert spent == pytest.approx(ref_spent)  # exactly-once ε
+        sv2.close()
+
+    def test_post_journal_recovery_serves_from_journal(self, tmp_path):
+        """A window journaled but not closed is NOT recomputed: the
+        recovered feed entry is the journal's object, same release_seq,
+        and the charge dedups."""
+        work = tmp_path / "w"
+        chaos.install(chaos.ChaosPlan("stream.post_journal", hit=1,
+                                      mode="raise"))
+        try:
+            sv = _service(work)
+            with pytest.raises(chaos.SimulatedCrash):
+                _feed_raise(sv, BATCHES)
+        finally:
+            chaos.clear()
+        journal_before = json.dumps(
+            ReleaseJournal(str(work / "releases.jsonl"),
+                           fsync=False).entries(), sort_keys=True)
+        sv2 = _service(work)
+        _feed(sv2, BATCHES)
+        after = [e for e in sv2.releases()
+                 if e["window_id"] == "0-10000"]
+        assert json.dumps(after, sort_keys=True) == journal_before
+        sv2.close()
+
+
+def _feed_raise(sv, batches):
+    for bid, ts, rows in batches:
+        try:
+            sv.ingest(bid, ts, rows)
+        except (LateRecordError, StreamOverloadedError):
+            pass
+
+
+# ----------------------------------------------------------- charge ----
+class TestWindowCharges:
+    def test_matches_release_factor(self):
+        got = window_charges(["ni_sign", "int_subg"], 0.4, 0.4, True,
+                             "party/x", "party/y")
+        want = 0.4 * release_factor("ni_sign", True) \
+            + 0.4 * release_factor("int_subg", True)
+        assert got == {"party/x": pytest.approx(want),
+                       "party/y": pytest.approx(want)}
+        assert want == pytest.approx(1.2)  # 2x sign + 1x subg
+
+    def test_no_normalise_no_premium(self):
+        got = window_charges(["ni_sign"], 0.4, 0.3, False, "x", "y")
+        assert got == {"x": pytest.approx(0.4), "y": pytest.approx(0.3)}
+
+    def test_asymmetric_parties_not_merged(self):
+        got = window_charges(["int_sign"], 1.0, 0.5, False, "x", "y")
+        assert got["x"] == pytest.approx(1.0)
+        assert got["y"] == pytest.approx(0.5)
+
+
+# ------------------------------------------------------------- chaos ----
+class TestChaosRegistration:
+    def test_stream_points_registered_not_in_matrix(self):
+        for p in ("stream.pre_release", "stream.mid_window",
+                  "stream.post_journal"):
+            assert p in chaos.KNOWN_POINTS
+            assert p not in chaos.MATRIX_POINTS  # the 2-party sweep
+            chaos.ChaosPlan(p)  # constructible
+
+
+# -------------------------------------------------------------- http ----
+@pytest.fixture
+def http_stream(tmp_path):
+    sv = _service(tmp_path, max_pending_rows=64)
+    srv = make_stream_http_server(sv, host="127.0.0.1", port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield base, sv
+    srv.shutdown()
+    srv.server_close()
+    t.join(timeout=5)
+    sv.close()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, dict(resp.headers), json.loads(
+                resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+class TestStreamHTTP:
+    def test_ingest_release_subscribe(self, http_stream):
+        base, _sv = http_stream
+        code, _, ack = _post(base, "/ingest", {
+            "batch_id": "b1", "ts": 1.0,
+            "rows": [[0.1, 0.2], [0.3, -0.4], [0.5, 0.6]]})
+        assert code == 200 and ack["ok"] and ack["seq"] == 1
+        code, _, ack = _post(base, "/ingest",
+                             {"batch_id": "hb", "ts": 50.0})
+        assert code == 200 and ack["released"] == ["0-10000"]
+        code, _, body = _get(base, "/releases?since=0")
+        feed = json.loads(body)["releases"]
+        assert [e["window_id"] for e in feed] == ["0-10000"]
+        code, _, body = _get(base, "/releases?since=1")
+        assert json.loads(body)["releases"] == []
+
+    def test_dedup_over_http(self, http_stream):
+        base, _ = http_stream
+        _post(base, "/ingest", {"batch_id": "b", "ts": 1.0,
+                                "rows": [[0.0, 0.0]]})
+        code, _, ack = _post(base, "/ingest",
+                             {"batch_id": "b", "ts": 1.0,
+                              "rows": [[0.0, 0.0]]})
+        assert code == 200 and ack["deduped"]
+
+    def test_late_is_400_with_watermark(self, http_stream):
+        base, _ = http_stream
+        _post(base, "/ingest", {"batch_id": "b1", "ts": 100.0,
+                                "rows": [[0.0, 0.0]]})
+        code, _, err = _post(base, "/ingest",
+                             {"batch_id": "b2", "ts": 5.0,
+                              "rows": [[0.0, 0.0]]})
+        assert code == 400
+        assert err["refused"] == "late" and err["watermark"] == 100.0
+
+    def test_overload_is_429_with_retry_after(self, http_stream):
+        base, _ = http_stream
+        _post(base, "/ingest", {"batch_id": "b1", "ts": 1.0,
+                                "rows": [[0.0, 0.0]] * 60})
+        code, headers, err = _post(
+            base, "/ingest", {"batch_id": "b2", "ts": 2.0,
+                              "rows": [[0.0, 0.0]] * 10})
+        assert code == 429 and err["refused"] == "overload"
+        assert int(headers["Retry-After"]) >= 1
+
+    def test_invalid_body_is_400(self, http_stream):
+        base, _ = http_stream
+        code, _, err = _post(base, "/ingest", {"ts": 1.0})
+        assert code == 400 and "invalid ingest body" in err["error"]
+
+    def test_stats_metrics_healthz_and_404(self, http_stream):
+        base, _ = http_stream
+        code, _, body = _get(base, "/stats")
+        assert code == 200
+        assert json.loads(body)["stream_id"] == "stream"
+        code, headers, body = _get(base, "/metrics")
+        assert code == 200 and b"dpcorr_stream_rows_total" in body
+        assert headers["Content-Type"].startswith("text/plain")
+        assert _get(base, "/healthz")[0] == 200
+        assert _get(base, "/nope")[0] == 404
+
+    def test_trigger_validates_reason(self, http_stream):
+        base, _ = http_stream
+        code, _, err = _post(base, "/obs/trigger",
+                             {"reason": "not_a_reason"})
+        assert code == 400 and "unknown trigger reason" in err["error"]
+
+
+# ----------------------------------------------------------- console ----
+class TestStreamConsole:
+    def test_render_stream_frame_canned(self):
+        stats = {
+            "stream_id": "s1", "families": ["ni_sign", "int_subg"],
+            "window": {"size_s": 10.0, "slide_s": 5.0, "late_s": 2.0},
+            "watermark": 48.0, "open_windows": 2, "pending_rows": 37,
+            "eps_per_window": {"party/x": 1.2, "party/y": 1.2},
+            "released": 4, "refused": ["w9"], "late_refused": 3,
+            "seen_batches": 11,
+            "ledger": {"budget_default": 10.0, "parties": {
+                "party/x": {"spent": 4.8, "budget": 10.0,
+                            "remaining": 5.2}}},
+        }
+        metrics = {
+            "dpcorr_stream_rows_total": 123.0,
+            'dpcorr_stream_batches_total{kind="overload"}': 2.0,
+            "dpcorr_stream_release_seconds_count": 4.0,
+            "dpcorr_stream_release_seconds_sum": 0.8,
+        }
+        frame = render_stream_frame(stats, metrics, now=0.0)
+        assert "s1" in frame and "ni_sign,int_subg" in frame
+        assert "slide 5s" in frame and "late bound 2s" in frame
+        assert "4 released" in frame and "1 refused" in frame
+        assert "123 rows" in frame and "2 overload" in frame
+        assert "3 late refused" in frame
+        assert "200.00 ms mean over 4 windows" in frame
+        assert "party/x" in frame
+
+    def test_retry_after_attribute(self):
+        e = StreamOverloadedError(1.5)
+        assert e.retry_after_s == 1.5 and "retry after" in str(e)

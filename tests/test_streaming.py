@@ -55,6 +55,37 @@ class TestChunkPlumbing:
         assert choose_n_chunk(10_000, 7, 1000) == 994
         assert choose_n_chunk(100, 64, 16) == 64  # never below m
 
+    def test_choose_n_chunk_n_below_target(self):
+        """n < target: the chunk covers the whole sample in one go —
+        the n+m-1 ceiling rounds a ragged n UP to a multiple of m, so
+        no second chunk exists just for a sub-batch tail."""
+        assert choose_n_chunk(100, 8, 65536) == 104  # ceil(100/8)*8
+        assert choose_n_chunk(96, 8, 65536) == 96    # already aligned
+        assert choose_n_chunk(5, 3, 65536) == 6
+        # a single chunk of the returned size always covers n rows
+        for n, m in ((100, 8), (97, 7), (5, 3), (65535, 64)):
+            assert choose_n_chunk(n, m, 65536) >= n
+
+    def test_choose_n_chunk_n_equals_one(self):
+        """The degenerate stream: one row, batch of one."""
+        assert choose_n_chunk(1, 1, 65536) == 1
+        assert choose_n_chunk(1, 1, 1) == 1
+        # m > n (INT families clamp m = min(m, n) before calling, but
+        # the function itself must still honour the >= m floor)
+        assert choose_n_chunk(1, 4, 65536) == 4
+
+    def test_choose_n_chunk_non_dividing_counts(self):
+        """target not a multiple of m: align DOWN to the m grid (a
+        batch must never straddle chunks), but never below m itself."""
+        assert choose_n_chunk(10**6, 48, 1000) == 960
+        assert choose_n_chunk(10**6, 1000, 999) == 1000  # floor wins
+        assert choose_n_chunk(10**6, 7, 10) == 7
+        for target in (10, 100, 1000, 65536):
+            for m in (1, 3, 7, 48, 1000):
+                nc = choose_n_chunk(10**6, m, target)
+                assert nc % m == 0 and nc >= m
+                assert nc <= max(target, m)
+
     def test_array_chunk_fn_tiles_and_pads(self):
         xy = jnp.arange(20.0).reshape(10, 2)
         fn = array_chunk_fn(xy, 4)

@@ -1,0 +1,372 @@
+"""Sentinel live-detection probe: chaos-clean × tamper-hot (ISSUE 17).
+
+The acceptance surface for the live invariant sentinel
+(dpcorr.obs.sentinel / ``dpcorr obs watch``), proven against real
+processes and real durable artifacts, in four arms:
+
+1. **stream chaos-clean** — for every registered ``stream.*`` chaos
+   point: a sentinel tails the workdir while the live server is
+   killed at the point, restarted, and fed the *full* batch plan again
+   (acked-batch dedup replay included). Gate: **zero** violations,
+   from the attached sentinel and from a cold sentinel replaying the
+   final artifacts.
+2. **serve chaos-clean** — a serve replica under estimate traffic with
+   its audit trail tailed and its ledger gauges scraped (the live
+   ε-conservation check), killed with SIGKILL mid-run and restarted
+   on the same trail. Gate: zero violations.
+3. **tamper matrix** — per tamper class (WAL byte flip, duplicated
+   charge line, re-noised release substitution, release-seq rewind):
+   a fresh copy of a clean reference workdir is served by a live
+   stream instance with a flight recorder armed; the sentinel polls
+   clean, the tamper is injected, and the gate asserts the class is
+   detected within 2 s as the expected typed violation naming the
+   offending artifact, that the offender's recorder dumped with
+   reason ``sentinel_violation``, and that a *restarted* sentinel on
+   the same checkpoint stays silent (no re-alert).
+4. **jax-free proof** — ``dpcorr obs watch`` runs to rc 0 in a
+   subprocess where ``sys.modules['jax'] = None`` (any jax import
+   explodes), and this driver itself never imports jax.
+
+The JSON artifact carries every gate; CI (``sentinel-smoke``)
+re-asserts from the artifact alone.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import stream_load as sl  # noqa: E402  (the reusable stream harness)
+
+from dpcorr.obs.recorder import read_dump  # noqa: E402
+from dpcorr.obs.sentinel import Sentinel  # noqa: E402
+
+REPO = sl.REPO
+
+#: tamper class → (expected violation kind, offending artifact suffix)
+TAMPER_CLASSES = {
+    "wal_byte_flip": ("wal-regression", "wal.jsonl"),
+    "duplicate_charge": ("double-charged-artifact", "audit.jsonl"),
+    "renoised_release": ("re-noised-artifact", "releases.jsonl"),
+    "seq_rewind": ("wal-regression", "releases.jsonl"),
+}
+
+DETECT_WITHIN_S = 2.0
+
+
+class _BatchArgs:
+    seed = 2025
+    windows = 4
+    batches_per_window = 3
+    rows_per_batch = 48
+
+
+def _poll_n(sent, n, interval_s=0.2):
+    for _ in range(n):
+        sent.poll()
+        time.sleep(interval_s)
+
+
+# ------------------------------------------------ arm 1: stream chaos ----
+def stream_chaos_clean(root: str) -> list[dict]:
+    cases = []
+    batches = sl._batches(_BatchArgs())
+    for point in sl.STREAM_POINTS:
+        tag = point.split(".")[-1]
+        wd = os.path.join(root, f"chaos-{tag}")
+        sent = Sentinel(os.path.join(root, f"ck-{tag}.json"))
+        sent.add_stream("stream1", wd)
+        proc, base, _ = sl._start(wd, f"point={point},hit=2,mode=exit")
+        # everything but the far-future heartbeat: enough closed
+        # windows that per-release points reach their second hit
+        died, _ = sl._drive(base, batches[:-1])
+        _poll_n(sent, 3)
+        sl._stop(proc)
+        # recover on the same workdir, then resend the FULL plan —
+        # every already-acked batch replays as a dedup
+        proc, base, _ = sl._start(wd, None)
+        sent.poll()
+        sl._drive(base, batches)
+        _poll_n(sent, 5)
+        sl._stop(proc)
+        cold = Sentinel(os.path.join(root, f"ck-{tag}-cold.json"))
+        cold.add_stream("stream1", wd)
+        cold.poll()
+        cases.append({
+            "point": point, "died": bool(died),
+            "violations": [v.to_dict() for v in sent.violations],
+            "cold_violations": [v.to_dict() for v in cold.violations],
+            "ok": (bool(died) and not sent.violations
+                   and not cold.violations),
+        })
+    return cases
+
+
+# ------------------------------------------------- arm 2: serve chaos ----
+def _start_serve(audit: str, log_path: str):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DPCORR_CHAOS", None)
+    log = open(log_path, "a")
+    # the persisted ledger snapshot is what makes the scraped gauge
+    # comparable to the trail fold ACROSS restarts — without it a
+    # restarted replica legitimately starts its gauge from zero
+    ledger = audit.replace("audit.jsonl", "ledger.json")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dpcorr", "serve", "--port", "0",
+         "--platform", "cpu", "--budget", "1e9", "--audit", audit,
+         "--ledger", ledger,
+         "--aot", "off", "--max-delay-ms", "5"],
+        cwd=REPO, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=log)
+    banner = json.loads(proc.stdout.readline())["serving"]
+    return proc, f"http://127.0.0.1:{banner['port']}", log
+
+
+def _estimate(base: str, seed: int) -> dict:
+    import random
+
+    rs = random.Random(seed)
+    x = [rs.gauss(0.0, 1.0) for _ in range(64)]
+    y = [xi * 0.5 + rs.gauss(0.0, 1.0) for xi in x]
+    req = urllib.request.Request(
+        base + "/estimate",
+        data=json.dumps({"family": "ni_sign", "x": x, "y": y,
+                         "eps1": 0.5, "eps2": 0.5, "seed": seed}
+                        ).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as resp:
+        return json.loads(resp.read())
+
+
+def serve_chaos_clean(root: str) -> dict:
+    audit = os.path.join(root, "serve-audit.jsonl")
+    log_path = os.path.join(root, "serve.log")
+    sent = Sentinel(os.path.join(root, "ck-serve.json"))
+    proc, base, log = _start_serve(audit, log_path)
+    sent.add_audit("serve1", audit, url=base)
+    try:
+        for seed in range(3):
+            _estimate(base, seed)
+        _poll_n(sent, 3)
+        # hard kill mid-service: the trail may carry a torn tail
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        _poll_n(sent, 2)
+    finally:
+        sl._stop(proc)
+        log.close()
+    # restart on the same trail (seq resumes contiguously), more load;
+    # the sentinel keeps its fold across the restart, so the scraped
+    # gauge (which resets to the persisted ledger) is exercised too
+    proc, base, log = _start_serve(audit, log_path)
+    try:
+        sent2 = Sentinel(os.path.join(root, "ck-serve.json"))
+        sent2.add_audit("serve1", audit, url=base)
+        for seed in range(3, 6):
+            _estimate(base, seed + 100)
+        _poll_n(sent2, 4)
+    finally:
+        sl._stop(proc)
+        log.close()
+    violations = ([v.to_dict() for v in sent.violations]
+                  + [v.to_dict() for v in sent2.violations])
+    return {"violations": violations, "ok": not violations}
+
+
+# ------------------------------------------------ arm 3: tamper matrix ----
+def _inject(cls: str, wd: str) -> str:
+    """Apply one tamper class to a quiescent workdir; returns the
+    tampered artifact path."""
+    wal = os.path.join(wd, "wal.jsonl")
+    audit = os.path.join(wd, "audit.jsonl")
+    journal = os.path.join(wd, "releases.jsonl")
+    if cls == "wal_byte_flip":
+        with open(wal, "r+b") as f:
+            f.seek(4)
+            byte = f.read(1)
+            f.seek(4)
+            f.write(b"X" if byte != b"X" else b"Y")
+        return wal
+    if cls == "duplicate_charge":
+        with open(audit, encoding="utf-8") as f:
+            for line in f:
+                if '"charge"' in line:
+                    break
+        with open(audit, "a", encoding="utf-8") as f:
+            f.write(line)
+        return audit
+    with open(journal, encoding="utf-8") as f:
+        entries = [json.loads(line) for line in f if line.strip()]
+    if cls == "renoised_release":
+        sub = dict(entries[0])
+        sub["releases"] = {k: {"tampered": 1}
+                           for k in sub.get("releases", {})} or \
+            {"ni_sign": {"tampered": 1}}
+        sub["release_seq"] = max(e["release_seq"] for e in entries) + 1
+    else:  # seq_rewind: fresh window id, stale seq
+        sub = dict(entries[-1])
+        sub["window_id"] = "999000-999999"
+        sub["charge_id"] = "stream:bench:999000-999999"
+        sub["release_seq"] = 1
+    with open(journal, "a", encoding="utf-8") as f:
+        f.write(json.dumps(sub) + "\n")
+    return journal
+
+
+def _make_reference(root: str) -> str:
+    """One clean completed stream run — the tamper arms each copy it."""
+    ref = os.path.join(root, "reference")
+    proc, base, _ = sl._start(ref, None)
+    sl._drive(base, sl._batches(_BatchArgs()))
+    time.sleep(0.5)
+    sl._stop(proc)
+    return ref
+
+def tamper_matrix(root: str, interval_s: float = 0.25) -> list[dict]:
+    ref = _make_reference(root)
+    cases = []
+    for cls, (want_kind, want_artifact) in TAMPER_CLASSES.items():
+        wd = os.path.join(root, f"tamper-{cls}")
+        shutil.copytree(ref, wd)
+        rec = os.path.join(root, f"rec-{cls}.json")
+        ck = os.path.join(root, f"ck-{cls}.json")
+        # a live server on the copied workdir, flight recorder armed —
+        # the offender the sentinel must page and arm
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("DPCORR_CHAOS", None)
+        proc = subprocess.Popen(
+            sl._server_argv(wd) + ["--flight-recorder", rec,
+                                   "--instance", f"stream-{cls}"],
+            cwd=REPO, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        banner = json.loads(proc.stdout.readline())["streaming"]
+        base = f"http://127.0.0.1:{banner['port']}"
+        try:
+            sent = Sentinel(ck, urls={"stream1": base})
+            sent.add_stream("stream1", wd, url=base)
+            _poll_n(sent, 2, interval_s)
+            clean = not sent.violations
+            artifact = _inject(cls, wd)
+            t0 = time.monotonic()
+            detected_s = None
+            while time.monotonic() - t0 < DETECT_WITHIN_S + 1.0:
+                if sent.poll():
+                    detected_s = time.monotonic() - t0
+                    break
+                time.sleep(interval_s)
+            kinds = sorted({v.kind for v in sent.violations})
+            named = any(v.artifact == artifact or "party" in v.artifact
+                        for v in sent.violations)
+            time.sleep(0.3)  # let the trigger POST land + dump fsync
+            try:
+                armed = read_dump(rec).get("reason") == \
+                    "sentinel_violation"
+            except (OSError, ValueError):
+                armed = False
+            # crash-exactness of the auditor itself: a restarted
+            # sentinel on the same checkpoint never re-alerts
+            resumed = Sentinel(ck, urls={"stream1": base})
+            resumed.add_stream("stream1", wd, url=base)
+            resumed.poll()
+            silent_after_restart = not resumed.violations
+        finally:
+            sl._stop(proc)
+        cases.append({
+            "class": cls, "expected_kind": want_kind,
+            "expected_artifact": want_artifact,
+            "clean_before_tamper": clean,
+            "detected_s": detected_s, "kinds": kinds,
+            "artifact_named": named,
+            "recorder_armed": armed,
+            "silent_after_restart": silent_after_restart,
+            "violations": [v.to_dict() for v in sent.violations],
+            "ok": (clean and detected_s is not None
+                   and detected_s <= DETECT_WITHIN_S
+                   and want_kind in kinds and named and armed
+                   and silent_after_restart),
+        })
+    return cases
+
+
+# ---------------------------------------------------- arm 4: jax-free ----
+def jax_free_proof(root: str) -> dict:
+    wd = os.path.join(root, "reference")
+    ck = os.path.join(root, "ck-jaxfree.json")
+    script = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"  # any jax import explodes
+        "sys.argv = ['dpcorr', 'obs', 'watch', '--checkpoint', %r,"
+        " '--stream', 'ize=%s', '--once', '--json']\n"
+        "from dpcorr.__main__ import main\n"
+        "main()\n" % (ck, wd))
+    run = subprocess.run([sys.executable, "-c", script], cwd=REPO,
+                         capture_output=True, text=True, timeout=300)
+    return {"rc": run.returncode, "stderr": run.stderr[-2000:],
+            "ok": run.returncode == 0}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workdir", default="sentinel-probe-artifacts")
+    ap.add_argument("--out-json", dest="out_json", default=None)
+    ap.add_argument("--skip-serve", action="store_true",
+                    help="skip the serve arm (it needs the jax stack; "
+                         "the stream arms are jax-free end to end)")
+    args = ap.parse_args()
+    root = os.path.abspath(args.workdir)
+    os.makedirs(root, exist_ok=True)
+
+    t0 = time.monotonic()
+    chaos = stream_chaos_clean(root)
+    tampers = tamper_matrix(root)
+    jaxfree = jax_free_proof(root)
+    serve = ({"skipped": True, "ok": True} if args.skip_serve
+             else serve_chaos_clean(root))
+
+    doc = {
+        "bench": "sentinel_probe", "version": 1,
+        "wall_s": time.monotonic() - t0,
+        "detect_within_s": DETECT_WITHIN_S,
+        "stream_chaos": chaos,
+        "serve_chaos": serve,
+        "tampers": tampers,
+        "jax_free": jaxfree,
+        "ok": (all(c["ok"] for c in chaos)
+               and all(c["ok"] for c in tampers)
+               and jaxfree["ok"] and serve["ok"]),
+    }
+    # the driver itself must never have pulled in jax: the sentinel is
+    # an operator tool, usable where no accelerator stack exists
+    doc["driver_jax_free"] = "jax" not in sys.modules
+    doc["ok"] = doc["ok"] and doc["driver_jax_free"]
+
+    if args.out_json:
+        with open(args.out_json, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    print(json.dumps({k: doc[k] for k in
+                      ("bench", "ok", "wall_s", "driver_jax_free")},
+                     indent=2))
+    for c in chaos:
+        print(f"  chaos {c['point']}: "
+              f"{'clean' if c['ok'] else 'VIOLATIONS'}")
+    for c in tampers:
+        print(f"  tamper {c['class']}: kinds={c['kinds']} "
+              f"in {c['detected_s'] if c['detected_s'] is not None else '—'}s "
+              f"armed={c['recorder_armed']} "
+              f"restart-silent={c['silent_after_restart']}")
+    print(f"  serve: {'clean' if serve['ok'] else 'VIOLATIONS'}"
+          f"{' (skipped)' if serve.get('skipped') else ''}")
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -380,6 +380,20 @@ def cmd_stream(args):
     if rec is not None:
         rec.watch_registry(service.registry)
         rec.watch_costs(service.costs)
+    # fleet identity: the self-claim gauge FleetCollector verifies
+    # against the target name (serve/party parity)
+    instance = args.instance or args.stream_id
+    service.registry.gauge(
+        "dpcorr_stream_instance_info",
+        "stream identity: constant 1 labelled by instance name",
+        labelnames=("instance",)).set(1, instance=instance)
+    obs_server = obs_port = None
+    if args.obs_port is not None:
+        from dpcorr.obs.endpoint import start_obs_server
+
+        obs_server, obs_port = start_obs_server(
+            service.registry, stats_fn=service.stats,
+            host=args.host, port=args.obs_port)
     # bind BEFORE the banner so --port 0 (ephemeral) is discoverable:
     # the load harness reads the bound port out of the banner line
     httpd = make_stream_http_server(service, host=args.host,
@@ -387,6 +401,7 @@ def cmd_stream(args):
     bound_port = httpd.server_address[1]
     print(json.dumps({"streaming": {
         "host": args.host, "port": bound_port,
+        "instance": instance, "obs_port": obs_port,
         "workdir": args.workdir, "stream_id": args.stream_id,
         "families": list(service.families),
         "window_s": args.window_s, "slide_s": args.slide_s,
@@ -402,6 +417,8 @@ def cmd_stream(args):
         pass
     finally:
         httpd.shutdown()
+        if obs_server is not None:
+            obs_server.shutdown()
         service.close()
 
 
@@ -719,6 +736,85 @@ def cmd_obs_provenance(args):
             divergences=[{"kind": d["kind"], "party": d["party"]}
                          for d in prov.divergences])
         sys.exit(1)
+
+
+def cmd_obs_watch(args):
+    """Live invariant sentinel (docs/OBSERVABILITY.md §Sentinel): tail
+    the durable artifacts live subsystems write — audit trails, stream
+    ingest WAL + release journal, budget directories, federation
+    transcripts + session journals — and re-prove ε-conservation and
+    durability invariants incrementally, within a poll of the write.
+    Typed violations name the offending artifact, arm the offender's
+    flight recorder and page through the burn-rate engine; exit 1 when
+    this run detected anything. jax-free, restart-safe from its own
+    checkpoint."""
+    from dpcorr.obs.sentinel import Sentinel
+
+    def specs(pairs, flag):
+        out = {}
+        for spec in pairs or ():
+            name, sep, value = spec.partition("=")
+            if not sep or not name or not value:
+                raise SystemExit(f"{flag} {spec!r}: expected NAME=PATH")
+            out[name] = value
+        return out
+
+    streams = specs(args.stream, "--stream")
+    audits = specs(args.audit, "--audit")
+    budget_dirs = specs(args.budget_dir, "--budget-dir")
+    transcripts = specs(args.transcripts, "--transcripts")
+    journals = specs(args.journals, "--journals")
+    urls = specs(args.url, "--url")
+    if not (streams or audits or transcripts or journals):
+        raise SystemExit("nothing to watch: pass --stream/--audit/"
+                         "--transcripts/--journals NAME=PATH")
+    for name in budget_dirs:
+        if name not in audits:
+            raise SystemExit(f"--budget-dir {name}=...: no matching "
+                             f"--audit {name}=... to fold against")
+    sentinel = Sentinel(args.checkpoint, urls=urls,
+                        instance=args.instance)
+    for name, workdir in sorted(streams.items()):
+        sentinel.add_stream(name, workdir, url=urls.get(name))
+    for name, path in sorted(audits.items()):
+        sentinel.add_audit(name, path, url=urls.get(name),
+                           budget_dir=budget_dirs.get(name))
+    for name, d in sorted(transcripts.items()):
+        sentinel.add_transcripts(name, d)
+    for name, d in sorted(journals.items()):
+        sentinel.add_journals(name, d)
+
+    obs_server = None
+    banner = {"instance": args.instance,
+              "checkpoint": args.checkpoint,
+              "watchers": sentinel.stats()["watchers"]}
+    if args.obs_port is not None:
+        from dpcorr.obs.endpoint import start_obs_server
+
+        obs_server, obs_port = start_obs_server(
+            sentinel.registry, stats_fn=sentinel.stats,
+            port=args.obs_port)
+        banner["obs_port"] = obs_port
+    print(json.dumps({"sentinel": banner}), flush=True)
+
+    def on_violation(v):
+        if args.json:
+            print(json.dumps({"violation": v.to_dict()}), flush=True)
+        else:
+            print(f"VIOLATION [{v.kind}] source={v.source} "
+                  f"artifact={v.artifact}: {v.detail}", flush=True)
+    sentinel.on_violation = on_violation
+    try:
+        rc = sentinel.run(interval_s=args.interval,
+                          max_polls=1 if args.once else args.max_polls)
+    except KeyboardInterrupt:
+        rc = sentinel.rc
+    finally:
+        if obs_server is not None:
+            obs_server.shutdown()
+    if args.json:
+        print(json.dumps({"summary": sentinel.stats()}, indent=2))
+    sys.exit(rc)
 
 
 def cmd_obs_fleet_snapshot(args):
@@ -1931,6 +2027,16 @@ def main(argv=None):
                      help="flight-recorder dump path (armed for "
                           "stream_release_failed and chaos kills; "
                           "replay with `dpcorr obs dump PATH`)")
+    pst.add_argument("--instance", default=None,
+                     help="fleet identity claimed in the "
+                          "dpcorr_stream_instance_info gauge "
+                          "(default: --stream-id)")
+    pst.add_argument("--obs-port", dest="obs_port", type=int,
+                     default=None,
+                     help="observability endpoint port (0 = ephemeral; "
+                          "/metrics, /stats, /healthz, POST "
+                          "/obs/trigger) for FleetCollector and "
+                          "obs top --fleet")
     pst.add_argument("--platform", default=None, choices=["cpu", "tpu"])
     pst.set_defaults(fn=cmd_stream)
 
@@ -2108,6 +2214,52 @@ def main(argv=None):
                           "DPCORR_GEOMETRY_CACHE / ~/.cache location)")
     pog.add_argument("--json", action="store_true")
     pog.set_defaults(fn=cmd_obs_geometry, platform=None, jax_free=True)
+    pow_ = obs_sub.add_parser(
+        "watch", help="live invariant sentinel: tail audit trails, "
+        "stream WAL/journal, budget dirs and transcripts; typed "
+        "violations page, arm the offender's flight recorder and set "
+        "exit 1 (docs/OBSERVABILITY.md §Sentinel); jax-free")
+    pow_.add_argument("--checkpoint", required=True,
+                      help="the sentinel's own fsynced offset/state "
+                           "checkpoint: restarts resume mid-file and "
+                           "never re-alert on re-read")
+    pow_.add_argument("--stream", action="append",
+                      metavar="NAME=WORKDIR",
+                      help="watch a stream workdir (wal.jsonl, "
+                           "releases.jsonl, audit.jsonl, budget_dir)")
+    pow_.add_argument("--audit", action="append", metavar="NAME=PATH",
+                      help="watch a bare audit trail (serve --audit / "
+                           "party --audit)")
+    pow_.add_argument("--budget-dir", dest="budget_dir",
+                      action="append", metavar="NAME=ROOT",
+                      help="ε-conservation leg for --audit NAME: the "
+                           "directory's on-disk user balances must "
+                           "equal the trail's user/ fold")
+    pow_.add_argument("--transcripts", action="append",
+                      metavar="NAME=DIR",
+                      help="watch pair-link transcripts for re-noised "
+                           "or double-charged artifacts")
+    pow_.add_argument("--journals", action="append", metavar="NAME=DIR",
+                      help="watch session-journal snapshots for "
+                           "resume-breaking corruption")
+    pow_.add_argument("--url", action="append", metavar="NAME=URL",
+                      help="NAME's live base URL: its ledger gauges "
+                           "are scraped for the conservation check and "
+                           "its flight recorder armed (POST "
+                           "/obs/trigger) on violation")
+    pow_.add_argument("--interval", type=float, default=1.0,
+                      help="poll seconds (detection latency bound)")
+    pow_.add_argument("--max-polls", dest="max_polls", type=int,
+                      default=None, help="stop after N polls (CI)")
+    pow_.add_argument("--once", action="store_true",
+                      help="one poll, then exit with the rc")
+    pow_.add_argument("--instance", default="sentinel")
+    pow_.add_argument("--obs-port", dest="obs_port", type=int,
+                      default=None,
+                      help="the sentinel's own scrape surface "
+                           "(dpcorr_sentinel_* metrics + /stats)")
+    pow_.add_argument("--json", action="store_true")
+    pow_.set_defaults(fn=cmd_obs_watch, platform=None, jax_free=True)
     def _add_spec_flags(p):
         p.add_argument("--family", default="ni_sign",
                        choices=["ni_sign", "int_sign", "ni_subg",

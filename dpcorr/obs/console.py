@@ -374,8 +374,12 @@ def render_stream_frame(stats: dict, metrics: dict,
     lines.append(f"window      : {shape}")
 
     wm = stats.get("watermark")
+    lag = stats.get("watermark_lag_s")
+    if lag is None:
+        lag = metrics.get("dpcorr_stream_watermark_lag_seconds")
     lines.append(
         f"watermark   : {'—' if wm is None else f'{wm:.3f}'}   "
+        f"lag {'—' if lag is None else f'{lag:.1f}s'}   "
         f"open {stats.get('open_windows', 0)} windows / "
         f"{stats.get('pending_rows', 0)} pending rows")
 

@@ -61,6 +61,8 @@ TRIGGER_REASONS = (
     "federation_resume_refused",  # a pair link's resume handshake refused
     "federation_scan_violation",  # cross-pair scan / provenance divergence
     "stream_release_failed",      # a charged window's release raised
+    "sentinel_violation",         # the live invariant sentinel caught
+                                  # an ε/durability break (obs.sentinel)
 )
 
 

@@ -58,7 +58,7 @@ DEFAULT_WINDOWS = (
     ("warn", 1800.0, 21600.0, 6.0),
 )
 
-_KINDS = ("latency", "error", "eps_burn")
+_KINDS = ("latency", "error", "eps_burn", "gauge")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,6 +82,9 @@ class Objective:
     #: eps_burn kind: spend gauge family + sustainable rate
     eps_series: str = "dpcorr_ledger_spent_eps"
     eps_per_s: float = 0.0
+    #: gauge kind: an instantaneous level (e.g. watermark lag) whose
+    #: budget is ``threshold_s`` — burn rate is worst-in-window / budget
+    gauge_series: str = "dpcorr_stream_watermark_lag_seconds"
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -96,6 +99,10 @@ class Objective:
         if self.kind == "eps_burn" and self.eps_per_s <= 0:
             raise ValueError(f"objective {self.name!r}: eps_burn kind "
                              f"needs eps_per_s > 0")
+        if self.kind == "gauge" and (self.threshold_s is None
+                                     or self.threshold_s <= 0):
+            raise ValueError(f"objective {self.name!r}: gauge kind "
+                             f"needs threshold_s > 0 (the level budget)")
 
     # -- cumulative (bad, total) off one instance's parsed families ----
     def cumulative(self, families: Mapping[str, MetricFamily],
@@ -132,6 +139,14 @@ class Objective:
             bad = sum(_sum_samples(families.get(n)) or 0.0
                       for n in self.bad_series)
             return bad, total
+        if self.kind == "gauge":
+            # a level, not a rate: "bad" is the gauge itself (worst
+            # sample when labelled), and there is no denominator
+            fam = families.get(self.gauge_series)
+            if fam is None:
+                return 0.0, None
+            vals = [v for _n, _ls, v in fam.samples]
+            return (max(vals) if vals else 0.0), None
         # eps_burn: cumulative spend over every party the series carries
         fam = families.get(self.eps_series)
         return (_sum_samples(fam) or 0.0), None
@@ -232,6 +247,15 @@ class BurnRateEngine:
         what it is, not as zero)."""
         if len(ring) < 2:
             return 0.0
+        if obj.kind == "gauge":
+            # a gauge has no delta arithmetic: its burn over a window
+            # is the worst level observed in [t - window_s, t] as a
+            # multiple of the budget (threshold_s × target)
+            worst = max((bad for ts, bad, _total in ring
+                         if ts >= t - window_s),
+                        default=ring[-1][1])
+            budget = (obj.threshold_s or 0.0) * obj.target
+            return worst / budget if budget > 0 else 0.0
         newest = ring[-1]
         anchor = ring[0]
         for sample in ring:
@@ -332,6 +356,41 @@ def federation_eps_burn_objectives(plan, makespan_s: float,
                   eps_series="dpcorr_federation_ledger_spent_eps",
                   eps_per_s=shares[party] / makespan_s)
         for party, _cols in plan.parties if shares[party] > 0)
+
+
+# --------------------------------------------- stream objectives ----
+def stream_release_latency_objective(
+        name: str = "stream-release-latency", threshold_s: float = 1.0,
+        target: float = 0.05) -> Objective:
+    """Release-latency objective over a stream instance's
+    ``dpcorr_stream_release_seconds`` histogram: a window release is
+    *bad* above ``threshold_s`` (which must be an exact
+    ``LATENCY_BUCKETS`` bound — cumulative buckets only answer
+    exact-bound questions), ``target`` the tolerated bad fraction.
+    Scrape the stream's ``--obs-port`` into the same
+    :class:`BurnRateEngine` as serve and federation; a page through
+    :func:`http_trigger_hook` dumps the stream's own flight
+    recorder."""
+    return Objective(
+        name=name, kind="latency", target=target,
+        histogram="dpcorr_stream_release_seconds",
+        threshold_s=threshold_s)
+
+
+def stream_watermark_lag_objective(
+        name: str = "stream-watermark-lag", max_lag_s: float = 30.0,
+        target: float = 1.0) -> Objective:
+    """Freshness objective over ``dpcorr_stream_watermark_lag_seconds``
+    (the gauge :mod:`dpcorr.stream.service` publishes alongside the
+    absolute watermark — lag, not position, is what an SLO can
+    threshold). ``max_lag_s × target`` is the lag *budget*: the burn
+    rate is the worst lag observed in each evaluation window divided
+    by that budget, so with the default multi-window thresholds a page
+    means the watermark sustained ≥14.4× its budget in both windows —
+    size ``max_lag_s`` as the budget, not as the page line."""
+    return Objective(
+        name=name, kind="gauge", target=target, threshold_s=max_lag_s,
+        gauge_series="dpcorr_stream_watermark_lag_seconds")
 
 
 # ------------------------------------------------- recorder arming ----

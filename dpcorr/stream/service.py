@@ -144,8 +144,10 @@ class StreamService:
                  global_budget: float | None = None,
                  max_pending_rows: int = 1 << 20,
                  fsync: bool = True,
-                 registry: Registry | None = None):
+                 registry: Registry | None = None,
+                 clock=time.time):
         self.workdir = str(workdir)
+        self.clock = clock
         os.makedirs(self.workdir, exist_ok=True)
         self.spec = spec
         self.families = tuple(families)
@@ -202,6 +204,10 @@ class StreamService:
         self._wm_g = self.registry.gauge(
             "dpcorr_stream_watermark_ts",
             "Event-time watermark (seconds)")
+        self._wm_lag_g = self.registry.gauge(
+            "dpcorr_stream_watermark_lag_seconds",
+            "Ingest-clock seconds the watermark trails now "
+            "(the thresholdable form of freshness)")
         self._release_h = self.registry.histogram(
             "dpcorr_stream_release_seconds",
             "Wall seconds per window release (all families)")
@@ -345,6 +351,7 @@ class StreamService:
         wm = self.manager.watermark
         if wm != float("-inf"):
             self._wm_g.set(wm)
+            self._wm_lag_g.set(max(0.0, float(self.clock()) - wm))
 
     def releases(self, since: int = 0) -> list[dict]:
         """Journal entries with ``release_seq > since`` — the subscribe
@@ -366,6 +373,9 @@ class StreamService:
                 "pending_rows": sum(
                     len(w) for w in self.manager.windows.values()),
                 "watermark": None if wm == float("-inf") else wm,
+                "watermark_lag_s": (
+                    None if wm == float("-inf")
+                    else max(0.0, float(self.clock()) - wm)),
                 "released": len(self.journal.entries()),
                 "refused": list(self._refused),
                 "late_refused": self.manager.late_refused,

@@ -1,0 +1,540 @@
+"""Live invariant sentinel (dpcorr.obs.sentinel, ISSUE 17).
+
+The contract under test, in the order it matters operationally:
+
+1. **Chaos-clean**: every legal artifact of crash recovery — a torn
+   final line, a ``dedup``-flagged replay charge, a refused
+   (never-journaled) window — raises nothing.
+2. **Tamper-hot**: each injected tamper class is detected on the next
+   poll as a typed violation naming the offending artifact.
+3. **Crash-exact itself**: a sentinel restarted from its checkpoint
+   resumes at its offsets and never re-alerts on re-read.
+4. A violation pages through the standard burn-rate engine and arms
+   the offender's flight recorder over POST /obs/trigger.
+5. The ``dpcorr obs watch`` CLI is jax-free and its exit code carries
+   the verdict.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dpcorr.obs.provenance import DIVERGENCE_KINDS
+from dpcorr.obs.sentinel import (
+    VIOLATION_KINDS,
+    Sentinel,
+    Violation,
+    arm_offender_hook,
+)
+
+
+def _wline(path, obj):
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(obj) + "\n")
+
+
+def _mk_stream_workdir(root, windows=2):
+    """Script the durable artifacts of a healthy stream run: per
+    window one (charge, wal batch, journal entry) triple with the
+    service's real shapes and id discipline."""
+    wd = os.path.join(str(root), "wd")
+    os.makedirs(wd, exist_ok=True)
+    audit = os.path.join(wd, "audit.jsonl")
+    wal = os.path.join(wd, "wal.jsonl")
+    journal = os.path.join(wd, "releases.jsonl")
+    for w in range(windows):
+        wid = f"{w * 2000}-{(w + 1) * 2000}"
+        cid = f"stream:s:{wid}"
+        _wline(audit, {"seq": w, "ts": float(w), "kind": "charge",
+                       "charge_id": cid,
+                       "charges": {"party/x": 0.4, "party/y": 0.4},
+                       "trace_id": cid})
+        _wline(wal, {"seq": w + 1, "batch_id": f"b{w}",
+                     "ts": w * 2.0, "rows": [[0.1, 0.2]]})
+        _wline(journal, {"start": w * 2.0, "end": (w + 1) * 2.0,
+                         "rows": 1, "releases": {"ni_sign": {"r": w}},
+                         "charge_id": cid, "eps_window": 0.8,
+                         "window_id": wid, "release_seq": w + 1})
+    return wd
+
+
+def _sentinel(tmp_path, wd=None, name="ck.json", **kw):
+    s = Sentinel(str(tmp_path / name), **kw)
+    if wd is not None:
+        s.add_stream("s1", wd)
+    return s
+
+
+class TestTaxonomy:
+    def test_kinds_extend_divergence_kinds(self):
+        for k in DIVERGENCE_KINDS:
+            assert k in VIOLATION_KINDS
+        for k in ("conservation-drift", "double-release",
+                  "wal-regression", "checkpoint-gap"):
+            assert k in VIOLATION_KINDS
+
+    def test_violation_signature_is_stable_and_kind_checked(self):
+        v = Violation(kind="wal-regression", source="s", artifact="a",
+                      detail="d", at=1.0)
+        w = Violation(kind="wal-regression", source="s", artifact="a",
+                      detail="d", at=99.0)  # time does not identify
+        assert v.signature == w.signature
+        with pytest.raises(AssertionError):
+            Violation(kind="nope", source="s", artifact="a",
+                      detail="d", at=0.0)
+
+
+class TestChaosClean:
+    def test_healthy_run_is_silent(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path, windows=3)
+        s = _sentinel(tmp_path, wd)
+        assert s.poll() == [] and s.poll() == [] and s.rc == 0
+
+    def test_torn_tail_is_not_a_violation(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        with open(os.path.join(wd, "wal.jsonl"), "a") as f:
+            f.write('{"seq": 3, "batch_id": "torn')  # crash mid-append
+        s = _sentinel(tmp_path, wd)
+        assert s.poll() == []
+        # the torn fragment completes later — consumed, still silent
+        with open(os.path.join(wd, "wal.jsonl"), "a") as f:
+            f.write('3", "ts": 4.0, "rows": []}\n')
+        assert s.poll() == []
+
+    def test_dedup_replay_charge_is_not_a_violation(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        # crash-recovery re-charge: same charge_id, dedup-flagged,
+        # fresh seq — exactly what the ledger writes on replay
+        _wline(os.path.join(wd, "audit.jsonl"),
+               {"seq": 2, "ts": 9.0, "kind": "charge",
+                "charge_id": "stream:s:0-2000",
+                "charges": {"party/x": 0.4, "party/y": 0.4},
+                "trace_id": "t", "dedup": True})
+        s = _sentinel(tmp_path, wd)
+        assert s.poll() == []
+
+    def test_refusal_event_is_not_a_violation(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        _wline(os.path.join(wd, "audit.jsonl"),
+               {"seq": 2, "ts": 9.0, "kind": "refusal",
+                "charges": {"party/x": 0.4}, "trace_id": "t",
+                "party": "party/x", "spent": 99.0, "budget": 100.0})
+        s = _sentinel(tmp_path, wd)
+        assert s.poll() == []
+
+
+class TestTamperDetection:
+    """One typed, artifact-naming violation per injected tamper class
+    — the four classes the acceptance gate names, plus the mid-file
+    corruption and gap cases only a tailer can classify."""
+
+    def _clean_sentinel(self, tmp_path, wd):
+        s = _sentinel(tmp_path, wd)
+        assert s.poll() == []
+        return s
+
+    def test_wal_byte_flip(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        s = self._clean_sentinel(tmp_path, wd)
+        with open(os.path.join(wd, "wal.jsonl"), "r+b") as f:
+            f.seek(3)
+            f.write(b"X")
+        kinds = {(v.kind, v.artifact) for v in s.poll()}
+        assert ("wal-regression", os.path.join(wd, "wal.jsonl")) in kinds
+
+    def test_duplicate_charge_line(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        s = self._clean_sentinel(tmp_path, wd)
+        audit = os.path.join(wd, "audit.jsonl")
+        with open(audit) as f:
+            first = f.readline()
+        with open(audit, "a") as f:
+            f.write(first)
+        kinds = {v.kind for v in s.poll()}
+        # the duplicated line is both an un-flagged double spend and a
+        # seq regression — both named, both on the trail
+        assert "double-charged-artifact" in kinds
+        assert "wal-regression" in kinds
+        assert all(v.artifact == audit for v in s.violations)
+
+    def test_renoised_release_substitution(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        s = self._clean_sentinel(tmp_path, wd)
+        _wline(os.path.join(wd, "releases.jsonl"),
+               {"start": 0.0, "end": 2.0, "rows": 1,
+                "releases": {"ni_sign": {"r": 777}},  # re-drawn noise
+                "charge_id": "stream:s:0-2000", "eps_window": 0.8,
+                "window_id": "0-2000", "release_seq": 3})
+        kinds = {(v.kind, v.artifact) for v in s.poll()}
+        assert ("re-noised-artifact",
+                os.path.join(wd, "releases.jsonl")) in kinds
+
+    def test_identical_double_release(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        s = self._clean_sentinel(tmp_path, wd)
+        journal = os.path.join(wd, "releases.jsonl")
+        with open(journal) as f:
+            first = f.readline()
+        with open(journal, "a") as f:
+            f.write(first)
+        kinds = {v.kind for v in s.poll()}
+        assert "double-release" in kinds
+
+    def test_release_seq_rewind(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        s = self._clean_sentinel(tmp_path, wd)
+        _wline(os.path.join(wd, "releases.jsonl"),
+               {"start": 4.0, "end": 6.0, "rows": 0, "releases": {},
+                "charge_id": "stream:s:4000-6000", "eps_window": 0.8,
+                "window_id": "4000-6000", "release_seq": 1})
+        kinds = {(v.kind, v.artifact) for v in s.poll()}
+        assert ("wal-regression",
+                os.path.join(wd, "releases.jsonl")) in kinds
+
+    def test_audit_seq_gap(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        s = self._clean_sentinel(tmp_path, wd)
+        _wline(os.path.join(wd, "audit.jsonl"),
+               {"seq": 9, "ts": 9.0, "kind": "charge",
+                "charge_id": "c9", "charges": {"party/x": 0.1},
+                "trace_id": "t"})
+        kinds = {v.kind for v in s.poll()}
+        assert "checkpoint-gap" in kinds
+
+    def test_complete_garbage_line_mid_file(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        s = self._clean_sentinel(tmp_path, wd)
+        with open(os.path.join(wd, "wal.jsonl"), "a") as f:
+            f.write("not json at all\n")  # newline: complete line
+        kinds = {v.kind for v in s.poll()}
+        assert "checkpoint-gap" in kinds
+
+    def test_journal_charge_never_audited(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        s = self._clean_sentinel(tmp_path, wd)
+        _wline(os.path.join(wd, "releases.jsonl"),
+               {"start": 4.0, "end": 6.0, "rows": 0, "releases": {},
+                "charge_id": "stream:s:4000-6000", "eps_window": 0.8,
+                "window_id": "4000-6000", "release_seq": 3})
+        assert s.poll() == []  # one-round grace for the audit append
+        kinds = {v.kind for v in s.poll()}
+        assert "tampered-charge" in kinds
+
+    def test_journal_eps_disagrees_with_trail(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        wid, cid = "4000-6000", "stream:s:4000-6000"
+        _wline(os.path.join(wd, "audit.jsonl"),
+               {"seq": 2, "ts": 9.0, "kind": "charge",
+                "charge_id": cid, "charges": {"party/x": 0.1},
+                "trace_id": cid})
+        _wline(os.path.join(wd, "releases.jsonl"),
+               {"start": 4.0, "end": 6.0, "rows": 0, "releases": {},
+                "charge_id": cid, "eps_window": 0.8,
+                "window_id": wid, "release_seq": 3})
+        s = _sentinel(tmp_path, wd)
+        kinds = {v.kind for v in s.poll()}
+        assert "eps-total-mismatch" in kinds
+
+
+class TestCheckpointRestart:
+    def test_restart_never_realerts(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        s = _sentinel(tmp_path, wd)
+        s.poll()
+        with open(os.path.join(wd, "wal.jsonl"), "r+b") as f:
+            f.seek(3)
+            f.write(b"X")
+        assert {v.kind for v in s.poll()} == {"wal-regression"}
+        # new process, same checkpoint: silent, rc 0
+        s2 = _sentinel(tmp_path, wd)
+        assert s2.poll() == [] and s2.rc == 0
+
+    def test_restart_resumes_offsets_and_still_detects(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        s = _sentinel(tmp_path, wd)
+        s.poll()
+        s2 = _sentinel(tmp_path, wd)
+        # fresh tamper after the restart is still hot
+        audit = os.path.join(wd, "audit.jsonl")
+        with open(audit) as f:
+            first = f.readline()
+        with open(audit, "a") as f:
+            f.write(first)
+        assert "double-charged-artifact" in {v.kind for v in s2.poll()}
+        assert s2.rc == 1
+
+    def test_checkpoint_is_fsynced_json(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        s = _sentinel(tmp_path, wd)
+        s.poll()
+        doc = json.load(open(s.checkpoint_path))
+        assert doc["version"] == Sentinel.CHECKPOINT_VERSION
+        assert "s1/stream" in doc["watchers"]
+
+
+class TestConservation:
+    def _forge(self, wd, seq):
+        _wline(os.path.join(wd, "audit.jsonl"),
+               {"seq": seq, "ts": 9.0, "kind": "charge",
+                "charge_id": "forged", "charges": {"user/alice": 3.0},
+                "trace_id": "z"})
+
+    def test_budget_dir_drift_fires_after_debounce(self, tmp_path):
+        from dpcorr.serve.budget_dir import BudgetDirectory
+
+        wd = _mk_stream_workdir(tmp_path)
+        bd = BudgetDirectory(os.path.join(wd, "budget_dir"),
+                             user_budget=50.0)
+        bd.charge("alice", 0.8, charge_id="c1")
+        bd.close()
+        _wline(os.path.join(wd, "audit.jsonl"),
+               {"seq": 2, "ts": 9.0, "kind": "charge",
+                "charge_id": "c1", "charges": {"user/alice": 0.8},
+                "trace_id": "c1"})
+        s = _sentinel(tmp_path, wd)
+        assert s.poll() == [] and s.poll() == []  # folds agree
+        # forge a user charge the directory never saw
+        self._forge(wd, seq=3)
+        assert s.poll() == []  # first mismatched observation: debounce
+        kinds = {v.kind for v in s.poll()}
+        assert kinds == {"conservation-drift"}
+        assert any("alice" in v.artifact for v in s.violations)
+
+    def test_scrape_drift_against_canned_metrics(self, tmp_path):
+        exposition = ('# TYPE dpcorr_ledger_spent_eps gauge\n'
+                      'dpcorr_ledger_spent_eps{party="party/x"} 0.8\n'
+                      'dpcorr_ledger_spent_eps{party="party/y"} 0.8\n')
+        httpd = _canned_server(exposition, {})
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}"
+            wd = _mk_stream_workdir(tmp_path)  # trail: 0.8 + 0.8
+            s = Sentinel(str(tmp_path / "ck.json"))
+            s.add_stream("s1", wd, url=url)
+            assert s.poll() == [] and s.poll() == []
+            # forge a party charge the gauge never saw
+            _wline(os.path.join(wd, "audit.jsonl"),
+                   {"seq": 2, "ts": 9.0, "kind": "charge",
+                    "charge_id": "forged",
+                    "charges": {"party/x": 3.0}, "trace_id": "z"})
+            assert s.poll() == []  # debounce
+            assert {v.kind for v in s.poll()} == {"conservation-drift"}
+            assert any(v.artifact == "party/x" for v in s.violations)
+        finally:
+            httpd.shutdown()
+
+    def test_down_instance_is_not_drift(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        s = Sentinel(str(tmp_path / "ck.json"),
+                     scrape_timeout_s=0.2)
+        s.add_stream("s1", wd, url="http://127.0.0.1:1")
+        assert s.poll() == [] and s.poll() == []
+
+
+class TestTranscriptsAndJournals:
+    def _rel(self, sess, rnd, label, group, charged):
+        return {"wire": {"session": sess, "msg_type": "release",
+                         "payload": {"round": rnd,
+                                     "artifacts": {label: group},
+                                     "charged": charged}}}
+
+    def test_renoised_artifact_across_sessions(self, tmp_path):
+        d = tmp_path / "tx"
+        d.mkdir()
+        _wline(str(d / "a.jsonl"),
+               self._rel("s1", 0, "col0", {"noise": 1}, ["col0"]))
+        s = Sentinel(str(tmp_path / "ck.json"))
+        s.add_transcripts("fed", str(d))
+        assert s.poll() == []
+        _wline(str(d / "b.jsonl"),
+               self._rel("s2", 0, "col0", {"noise": 2}, []))
+        v = s.poll()
+        assert [x.kind for x in v] == ["re-noised-artifact"]
+        assert v[0].artifact == "col0"
+
+    def test_double_charged_artifact_across_venues(self, tmp_path):
+        d = tmp_path / "tx"
+        d.mkdir()
+        _wline(str(d / "a.jsonl"),
+               self._rel("s1", 0, "col0", {"noise": 1}, ["col0"]))
+        s = Sentinel(str(tmp_path / "ck.json"))
+        s.add_transcripts("fed", str(d))
+        assert s.poll() == []
+        _wline(str(d / "a.jsonl"),
+               self._rel("s1", 1, "col1", {"noise": 1}, ["col0"]))
+        v = s.poll()
+        assert [x.kind for x in v] == ["double-charged-artifact"]
+
+    def test_corrupt_session_journal(self, tmp_path):
+        d = tmp_path / "j"
+        d.mkdir()
+        (d / "journal.alice.json").write_text('{"version": 1}')
+        s = Sentinel(str(tmp_path / "ck.json"))
+        s.add_journals("fed", str(d))
+        assert s.poll() == []
+        (d / "journal.alice.json").write_text('{"torn')
+        kinds = {v.kind for v in s.poll()}
+        assert kinds == {"checkpoint-gap"}
+
+
+class TestPagingAndArming:
+    def test_violation_pages_burn_rate_engine(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        pages = []
+        clock = [1000.0]
+        s = Sentinel(str(tmp_path / "ck.json"),
+                     clock=lambda: clock[0], on_page=pages.append)
+        s.add_stream("s1", wd)
+        for _ in range(3):
+            s.poll()
+            clock[0] += 1.0
+        assert pages == []  # clean polls never page
+        with open(os.path.join(wd, "wal.jsonl"), "r+b") as f:
+            f.seek(3)
+            f.write(b"X")
+        for _ in range(3):
+            s.poll()
+            clock[0] += 1.0
+        assert [a.severity for a in pages] == ["page"]
+        assert pages[0].objective == "sentinel-violations"
+
+    def test_arm_offender_hook_posts_trigger(self, tmp_path):
+        seen = []
+        httpd = _canned_server("", {}, posts=seen)
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}"
+            hook = arm_offender_hook({"s1": url})
+            hook(Violation(kind="wal-regression", source="s1",
+                           artifact="a", detail="d", at=0.0))
+            hook(Violation(kind="wal-regression", source="unknown",
+                           artifact="a", detail="d", at=0.0))
+            assert len(seen) == 1
+            body = json.loads(seen[0])
+            assert body["reason"] == "sentinel_violation"
+            assert body["detail"]["kind"] == "wal-regression"
+        finally:
+            httpd.shutdown()
+
+    def test_sentinel_violation_is_a_trigger_reason(self):
+        from dpcorr.obs.recorder import TRIGGER_REASONS
+
+        assert "sentinel_violation" in TRIGGER_REASONS
+
+
+class TestStreamSLOFactories:
+    def _fams(self, text):
+        from dpcorr.obs.fleet import parse_families
+
+        return parse_families(text)
+
+    def test_watermark_lag_objective_pages_on_sustained_lag(self):
+        from dpcorr.obs.metrics import Registry
+        from dpcorr.obs.slo import (
+            BurnRateEngine,
+            stream_watermark_lag_objective,
+        )
+
+        obj = stream_watermark_lag_objective(max_lag_s=1.0)
+        eng = BurnRateEngine([obj], clock=lambda: 0.0)
+
+        def fams(lag):
+            r = Registry()
+            r.gauge("dpcorr_stream_watermark_lag_seconds", "l").set(lag)
+            return self._fams(r.render())
+
+        eng.observe({"s1": fams(0.5)}, at=0.0)
+        eng.observe({"s1": fams(0.5)}, at=60.0)
+        assert eng.evaluate(at=60.0) == []  # within budget
+        eng.observe({"s1": fams(30.0)}, at=120.0)  # ≫ 14.4× budget
+        fired = eng.evaluate(at=120.0)
+        assert [a.severity for a in fired] == ["page"]
+
+    def test_release_latency_objective_uses_exact_bucket(self):
+        from dpcorr.obs.slo import stream_release_latency_objective
+
+        obj = stream_release_latency_objective(threshold_s=1.0)
+        assert obj.histogram == "dpcorr_stream_release_seconds"
+        assert obj.kind == "latency"
+        with pytest.raises(ValueError):
+            stream_release_latency_objective(target=0.0)
+
+    def test_gauge_kind_requires_threshold(self):
+        from dpcorr.obs.slo import Objective
+
+        with pytest.raises(ValueError, match="gauge"):
+            Objective(name="g", kind="gauge", target=1.0)
+
+
+class TestWatchCLI:
+    def test_obs_watch_cli_is_jax_free_and_sets_rc(self, tmp_path):
+        wd = _mk_stream_workdir(tmp_path)
+        ck = str(tmp_path / "ck.json")
+        script_tpl = (
+            "import sys\n"
+            "sys.modules['jax'] = None\n"  # any jax import explodes
+            "sys.argv = ['dpcorr', 'obs', 'watch', '--checkpoint', %r,"
+            " '--stream', 'ize=%s', '--once', '--json']\n"
+            "from dpcorr.__main__ import main\n"
+            "main()\n")
+        run = subprocess.run(
+            [sys.executable, "-c", script_tpl % (ck, wd)],
+            capture_output=True, text=True, timeout=120)
+        assert run.returncode == 0, run.stderr
+        # tamper, re-run from the same checkpoint: rc 1, typed + named
+        with open(os.path.join(wd, "wal.jsonl"), "r+b") as f:
+            f.seek(3)
+            f.write(b"X")
+        run = subprocess.run(
+            [sys.executable, "-c", script_tpl % (ck, wd)],
+            capture_output=True, text=True, timeout=120)
+        assert run.returncode == 1, run.stderr
+        lines = [json.loads(line) for line in run.stdout.splitlines()
+                 if line.startswith('{"violation"')]
+        assert lines and lines[0]["violation"]["kind"] == "wal-regression"
+        assert "wal.jsonl" in lines[0]["violation"]["artifact"]
+        # third run, same checkpoint, no new tamper: silent again
+        run = subprocess.run(
+            [sys.executable, "-c", script_tpl % (ck, wd)],
+            capture_output=True, text=True, timeout=120)
+        assert run.returncode == 0, run.stderr
+
+    def test_obs_watch_refuses_empty_watchlist(self, tmp_path):
+        run = subprocess.run(
+            [sys.executable, "-m", "dpcorr", "obs", "watch",
+             "--checkpoint", str(tmp_path / "ck.json"), "--once"],
+            capture_output=True, text=True, timeout=120)
+        assert run.returncode != 0
+        assert "nothing to watch" in run.stderr
+
+
+def _canned_server(exposition: str, stats: dict, posts=None):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            blob = (exposition.encode() if self.path == "/metrics"
+                    else json.dumps(stats).encode())
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            if posts is not None:
+                posts.append(self.rfile.read(n).decode())
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd

@@ -639,6 +639,55 @@ class TestStreamConsole:
         assert "200.00 ms mean over 4 windows" in frame
         assert "party/x" in frame
 
+    def test_render_stream_frame_shows_watermark_lag(self):
+        stats = {"stream_id": "s1", "families": ["ni_sign"],
+                 "window": {"size_s": 10.0, "late_s": 0.0},
+                 "watermark": 48.0, "watermark_lag_s": 7.25,
+                 "open_windows": 0, "pending_rows": 0,
+                 "eps_per_window": {}, "released": 0, "refused": [],
+                 "late_refused": 0, "seen_batches": 0, "ledger": {}}
+        frame = render_stream_frame(stats, {}, now=0.0)
+        assert "lag 7.2s" in frame
+        # older /stats without the key falls back to the gauge
+        del stats["watermark_lag_s"]
+        frame = render_stream_frame(
+            stats, {"dpcorr_stream_watermark_lag_seconds": 3.0},
+            now=0.0)
+        assert "lag 3.0s" in frame
+
+    def test_render_stream_frame_empty_window_table(self):
+        # a just-started stream: no watermark, nothing released —
+        # every line must still render (no KeyError, no math on None)
+        stats = {"stream_id": "s1", "families": ["ni_sign"],
+                 "window": {"size_s": 10.0, "late_s": 0.0},
+                 "watermark": None, "open_windows": 0,
+                 "pending_rows": 0, "eps_per_window": {},
+                 "released": 0, "refused": [], "late_refused": 0,
+                 "seen_batches": 0, "ledger": {}}
+        frame = render_stream_frame(stats, {}, now=0.0)
+        assert "watermark   : —   lag —" in frame
+        assert "0 released" in frame and "0 batches" in frame
+        assert "release     :" not in frame  # no windows → no mean
+
+    def test_run_stream_top_down_target_rc_1(self, capsys):
+        from dpcorr.obs.console import run_stream_top
+
+        rc = run_stream_top("http://127.0.0.1:1", once=True)
+        assert rc == 1
+        assert "cannot scrape" in capsys.readouterr().out
+
+    def test_run_stream_top_once_rc_0(self, http_stream, capsys):
+        from dpcorr.obs.console import run_stream_top
+
+        base, sv = http_stream
+        _post(base, "/ingest", {"batch_id": "b1", "ts": 5.0,
+                                "rows": [[0.1, 0.2]]})
+        rc = run_stream_top(base, once=True)
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "dpcorr obs top --stream" in out
+        assert "watermark" in out and "lag" in out
+
     def test_retry_after_attribute(self):
         e = StreamOverloadedError(1.5)
         assert e.retry_after_s == 1.5 and "retry after" in str(e)

@@ -29,6 +29,16 @@ Package map (see SURVEY.md §7 for the blueprint):
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("DPCORR_SYNCWATCH") == "1":
+    # must run before any dpcorr submodule allocates a lock: syncwatch
+    # wraps the threading.Lock/RLock factories, and only locks created
+    # *after* enable() are witnessed (docs/STATIC_ANALYSIS.md §Deep).
+    from dpcorr.utils import syncwatch as _syncwatch
+
+    _syncwatch.enable()
+
 
 def __getattr__(name):  # PEP 562: lazy re-export
     """``dpcorr.MASTER_SEED`` without importing JAX at package-import

@@ -45,7 +45,18 @@ def add_arguments(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--rules", default=None,
                     help="comma-separated checker families to run "
                          "(rng,budget,locks,purity,rawdata,sync,"
-                         "metrics; default: all)")
+                         "metrics; with --deep also lockorder,"
+                         "durability,deepbudget,coverage; default: all)")
+    ap.add_argument("--deep", action="store_true",
+                    help="also run the interprocedural families over "
+                         "the whole-repo call graph (lock-order "
+                         "cycles, blocking-under-lock, durability, "
+                         "deep budget, chaos coverage)")
+    ap.add_argument("--witness", default=None, metavar="DIR",
+                    help="diff runtime syncwatch witness artifacts in "
+                         "DIR against the static lock model and exit "
+                         "(1 on unpredicted edges, inversions or "
+                         "observed cycles)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     ap.add_argument("--strict", action="store_true",
@@ -64,7 +75,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _list_rules() -> int:
-    for checker in core.default_checkers():
+    for checker in core.default_checkers(deep=True):
         print(f"{checker.name}:")
         for rule, desc in checker.rules.items():
             print(f"  {rule:<24} {desc}")
@@ -87,8 +98,14 @@ def run(args: argparse.Namespace) -> int:
             return 2
     rule_filter = ([s.strip() for s in args.rules.split(",") if s.strip()]
                    if args.rules else None)
+    if args.witness is not None:
+        from dpcorr.analysis import witness
+
+        return witness.run_witness_check(paths, root, args.witness,
+                                         as_json=args.json)
     try:
-        violations = core.run_lint(paths, root, rule_filter=rule_filter)
+        violations = core.run_lint(paths, root, rule_filter=rule_filter,
+                                   deep=args.deep)
     except ValueError as e:
         print(f"dpcorr lint: {e}", file=sys.stderr)
         return 2

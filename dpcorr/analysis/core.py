@@ -58,9 +58,15 @@ class Violation:
     line: int
     message: str
     code: str = ""  # the stripped source line (baseline match key)
+    #: interprocedural findings carry the file:line hop chain that
+    #: reaches the offending site (``--deep``; empty for local rules).
+    chain: tuple = ()
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        out = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        for hop in self.chain:
+            out += f"\n      via {hop}"
+        return out
 
 
 class Module:
@@ -74,10 +80,14 @@ class Module:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         # parent links let rules see an access site's enclosing context
-        # (e.g. "is this Attribute the receiver of a mutating call")
-        for node in ast.walk(self.tree):
+        # (e.g. "is this Attribute the receiver of a mutating call");
+        # single-stack traversal: one iter_child_nodes pass per node
+        stack: list[ast.AST] = [self.tree]
+        while stack:
+            node = stack.pop()
             for child in ast.iter_child_nodes(node):
                 child._dpcorr_parent = node  # type: ignore[attr-defined]
+                stack.append(child)
         self.suppressions = _suppression_table(self.lines)
 
     def line_text(self, lineno: int) -> str:
@@ -101,6 +111,8 @@ class Module:
 def _suppression_table(lines: Sequence[str]) -> dict[int, set[str]]:
     table: dict[int, set[str]] = {}
     for i, line in enumerate(lines, 1):
+        if "dpcorr-lint" not in line:  # fast path: regex only on hits
+            continue
         m = _SUPPRESS_RE.search(line)
         if not m:
             continue
@@ -131,6 +143,20 @@ class Checker:
         raise NotImplementedError
 
 
+class ProjectChecker(Checker):
+    """A ``--deep`` checker: sees the whole parsed project at once (the
+    interprocedural model from :mod:`dpcorr.analysis.callgraph`) instead
+    of one module at a time. ``applies_to`` still scopes which findings
+    survive (by the *finding's* path), so fixtures compose the same way
+    as for per-module rules."""
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        return iter(())
+
+    def check_project(self, model) -> Iterator[Violation]:
+        raise NotImplementedError
+
+
 # -------------------------------------------------------- AST helpers ----
 def attr_chain(node: ast.AST) -> tuple[str, ...]:
     """``self.coalescer.submit`` → ``("self", "coalescer", "submit")``;
@@ -157,7 +183,11 @@ def imported_names(tree: ast.Module) -> dict[str, str]:
     (``import numpy as np`` → ``{"np": "numpy"}``; ``from jax.random
     import fold_in`` → ``{"fold_in": "jax.random.fold_in"}``). Rules
     use this to tell stdlib ``random`` from ``jax.random`` and to spot
-    re-exported draw wrappers."""
+    re-exported draw wrappers. Cached on the tree: several rule
+    families ask for the same module's imports."""
+    cached = getattr(tree, "_dpcorr_imports", None)
+    if cached is not None:
+        return cached
     out: dict[str, str] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
@@ -167,6 +197,7 @@ def imported_names(tree: ast.Module) -> dict[str, str]:
             for alias in node.names:
                 out[alias.asname or alias.name] = \
                     f"{node.module}.{alias.name}"
+    tree._dpcorr_imports = out  # type: ignore[attr-defined]
     return out
 
 
@@ -174,19 +205,43 @@ def parent(node: ast.AST) -> ast.AST | None:
     return getattr(node, "_dpcorr_parent", None)
 
 
+def walk_all(tree: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk``, memoized on the root node. Nearly every rule
+    family sweeps the full module tree at least once; Module keeps the
+    trees alive, so the first sweep pays for all of them."""
+    cached = getattr(tree, "_dpcorr_all", None)
+    if cached is None:
+        cached = list(ast.walk(tree))
+        try:
+            tree._dpcorr_all = cached  # type: ignore[attr-defined]
+        except AttributeError:
+            pass
+    return iter(cached)
+
+
 def walk_same_scope(node: ast.AST) -> Iterator[ast.AST]:
     """Like ``ast.walk`` but does not descend into nested function
     scopes (defs/lambdas) — the unit most rules reason over. The root
     node itself is yielded (and descended into) even when it is a
-    function."""
-    yield node
-    stack = [node]
-    while stack:
-        for child in ast.iter_child_nodes(stack.pop()):
-            yield child
-            if not isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef, ast.Lambda)):
-                stack.append(child)
+    function. Memoized on the root node: every rule family walks the
+    same function scopes, and the trees outlive the walk (Module holds
+    them), so one traversal serves all checkers."""
+    cached = getattr(node, "_dpcorr_scope", None)
+    if cached is None:
+        cached = [node]
+        stack = [node]
+        while stack:
+            for child in ast.iter_child_nodes(stack.pop()):
+                cached.append(child)
+                if not isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Lambda)):
+                    stack.append(child)
+        try:
+            node._dpcorr_scope = cached  # type: ignore[attr-defined]
+        except AttributeError:
+            pass
+    return iter(cached)
 
 
 # ------------------------------------------------------------ running ----
@@ -207,22 +262,29 @@ def iter_py_files(paths: Iterable[str], root: str) -> Iterator[str]:
                     yield os.path.relpath(os.path.join(dirpath, fn), root)
 
 
-def default_checkers() -> list[Checker]:
+def default_checkers(deep: bool = False) -> list[Checker]:
     """The shipped checker families (imported lazily so ``core`` has no
-    import cycle with the rule modules)."""
-    from dpcorr.analysis.rules import ALL_CHECKERS
+    import cycle with the rule modules). ``deep`` adds the
+    interprocedural families (``--deep``)."""
+    from dpcorr.analysis.rules import ALL_CHECKERS, DEEP_CHECKERS
 
-    return [cls() for cls in ALL_CHECKERS]
+    out = [cls() for cls in ALL_CHECKERS]
+    if deep:
+        out.extend(cls() for cls in DEEP_CHECKERS)
+    return out
 
 
 def run_lint(paths: Sequence[str], root: str,
              checkers: Sequence[Checker] | None = None,
-             rule_filter: Sequence[str] | None = None) -> list[Violation]:
+             rule_filter: Sequence[str] | None = None,
+             deep: bool = False) -> list[Violation]:
     """Lint every ``.py`` under ``paths`` (relative to ``root``) and
     return suppression-filtered violations in (path, line) order.
-    ``rule_filter`` restricts to the named checker families."""
+    ``rule_filter`` restricts to the named checker families. ``deep``
+    additionally builds the interprocedural model over every parsed
+    module and runs the :class:`ProjectChecker` families on it."""
     if checkers is None:
-        checkers = default_checkers()
+        checkers = default_checkers(deep=deep)
     if rule_filter:
         wanted = set(rule_filter)
         unknown = wanted - {c.name for c in checkers}
@@ -230,6 +292,7 @@ def run_lint(paths: Sequence[str], root: str,
             raise ValueError(f"unknown checker families: {sorted(unknown)}")
         checkers = [c for c in checkers if c.name in wanted]
     violations: list[Violation] = []
+    modules: list[Module] = []
     for relpath in iter_py_files(paths, root):
         full = os.path.join(root, relpath)
         with open(full, encoding="utf-8") as f:
@@ -241,13 +304,32 @@ def run_lint(paths: Sequence[str], root: str,
                 "syntax-error", relpath.replace(os.sep, "/"),
                 e.lineno or 1, f"cannot parse: {e.msg}"))
             continue
+        modules.append(module)
         for checker in checkers:
+            if isinstance(checker, ProjectChecker):
+                continue
             if not checker.applies_to(module.relpath):
                 continue
             for v in checker.check(module):
                 if not module.suppressed(v.rule, v.line):
                     violations.append(dataclasses.replace(
                         v, code=module.line_text(v.line)))
+    if deep:
+        from dpcorr.analysis.callgraph import ProjectModel
+
+        model = ProjectModel(modules, root)
+        by_relpath = {m.relpath: m for m in modules}
+        for checker in checkers:
+            if not isinstance(checker, ProjectChecker):
+                continue
+            for v in checker.check_project(model):
+                if not checker.applies_to(v.path):
+                    continue
+                mod = by_relpath.get(v.path)
+                if mod is not None and mod.suppressed(v.rule, v.line):
+                    continue
+                code = mod.line_text(v.line) if mod is not None else ""
+                violations.append(dataclasses.replace(v, code=code))
     violations.sort(key=lambda v: (v.path, v.line, v.rule))
     return violations
 

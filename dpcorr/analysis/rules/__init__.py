@@ -8,6 +8,10 @@ derive from this list.
 """
 
 from dpcorr.analysis.rules.budget import BudgetChecker
+from dpcorr.analysis.rules.coverage import ChaosCoverageChecker
+from dpcorr.analysis.rules.deepbudget import DeepBudgetChecker
+from dpcorr.analysis.rules.durability import DurabilityChecker
+from dpcorr.analysis.rules.lockorder import LockOrderChecker
 from dpcorr.analysis.rules.locks import LockChecker
 from dpcorr.analysis.rules.metrics import MetricsChecker
 from dpcorr.analysis.rules.purity import PurityChecker
@@ -18,3 +22,8 @@ from dpcorr.analysis.rules.sync import SyncChecker
 #: registration order is report order for equal (path, line).
 ALL_CHECKERS = (RngChecker, BudgetChecker, LockChecker, PurityChecker,
                 RawDataChecker, SyncChecker, MetricsChecker)
+
+#: the interprocedural (``--deep``) families — ProjectChecker
+#: subclasses run over the callgraph model after the per-module pass.
+DEEP_CHECKERS = (LockOrderChecker, DurabilityChecker, DeepBudgetChecker,
+                 ChaosCoverageChecker)

@@ -14,7 +14,7 @@ exactly ``protocol.gate.ReleaseGate``, and these rules keep it the
 the window releaser in place of the wire: a closable window reaches
 ``releaser.release`` only after its one atomic per-window charge, and
 an in-process release failure must refund
-(``stream.service.StreamService._release_window``). Two rules, scoped
+(``stream.service.StreamService._release_window_locked``). Two rules, scoped
 to functions that *hold a ledger*
 (reference ``ledger``/``self.ledger``) — the admission layer —
 because below the admission boundary (the coalescer, the kernel cache,
@@ -59,6 +59,7 @@ from dpcorr.analysis.core import (
     Module,
     Violation,
     attr_chain,
+    walk_all,
     walk_same_scope,
 )
 
@@ -119,7 +120,7 @@ class BudgetChecker(Checker):
                 or "stream" in parts)
 
     def check(self, module: Module) -> Iterator[Violation]:
-        for fn in ast.walk(module.tree):
+        for fn in walk_all(module.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             yield from self._check_shed_sites(module, fn)

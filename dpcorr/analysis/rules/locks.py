@@ -34,7 +34,8 @@ import ast
 import re
 from typing import Iterator
 
-from dpcorr.analysis.core import Checker, Module, Violation, parent
+from dpcorr.analysis.core import Checker, Module, Violation, parent, \
+    walk_all
 
 _DECL_RE = re.compile(r"#\s*guarded by:\s*(\w+)")
 
@@ -57,20 +58,22 @@ class LockChecker(Checker):
     }
 
     def applies_to(self, relpath: str) -> bool:
-        # the threaded layers: serve, obs, the protocol runtime (two
-        # party threads share transcript/channel state in-process), and
-        # the compile-ahead module (its SingleFlight inflight map is
-        # raced by design — ISSUE 4)
+        # every package under dpcorr/ (ISSUE 18 widened this from the
+        # serve/obs/protocol subset: the stream service, chaos plans
+        # and the compile cache all share state across threads too);
+        # the bare segment names keep the test fixtures, which mirror
+        # the layout without the leading dpcorr/, in scope
         parts = relpath.split("/")
-        return ("serve" in parts or "obs" in parts
-                or "protocol" in parts
+        return ("dpcorr" in parts or "serve" in parts or "obs" in parts
+                or "protocol" in parts or "stream" in parts
                 or relpath.endswith("utils/compile.py"))
 
     def check(self, module: Module) -> Iterator[Violation]:
-        classes = {cls.name: cls for cls in ast.walk(module.tree)
+        classes = {cls.name: cls for cls in walk_all(module.tree)
                    if isinstance(cls, ast.ClassDef)}
         for cls in classes.values():
             yield from self._check_class(module, cls, classes)
+        yield from self._check_module(module)
 
     # ------------------------------------------------- declarations ----
     def _declared(self, module: Module, cls: ast.ClassDef,
@@ -165,6 +168,99 @@ class LockChecker(Checker):
                     f"self.{sub.attr} is declared `# guarded by: "
                     f"{guard}` but this {kind} is outside "
                     f"`with self.{guard}`")
+
+    # --------------------------------------- module-level globals ----
+    def _check_module(self, module: Module) -> Iterator[Violation]:
+        """Module globals declared ``NAME = ...  # guarded by: _LOCK``
+        (the chaos plan registry is the motivating case) are held to
+        ``with <lock>:`` inside every module-level function. Import
+        time is single-threaded, so top-level statements are exempt —
+        like ``__init__`` for instance attributes."""
+        declared: dict[str, str] = {}
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            else:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    m = _DECL_RE.search(module.line_text(node.lineno))
+                    if m:
+                        declared[t.id] = m.group(1)
+        if not declared:
+            return
+        for item in module.tree.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and not item.name.endswith("_locked"):
+                yield from self._scan_globals(module, declared,
+                                              item.body, frozenset())
+
+    def _scan_globals(self, module: Module, declared: dict[str, str],
+                      stmts, held: frozenset[str],
+                      ) -> Iterator[Violation]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._scan_globals(module, declared,
+                                              stmt.body, frozenset())
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                now = set(held)
+                for it in stmt.items:
+                    if isinstance(it.context_expr, ast.Name):
+                        now.add(it.context_expr.id)
+                yield from self._scan_globals(module, declared,
+                                              stmt.body, frozenset(now))
+                continue
+            for field, value in ast.iter_fields(stmt):
+                blocks = {"body", "orelse", "finalbody"}
+                if field in blocks and isinstance(value, list):
+                    yield from self._scan_globals(module, declared,
+                                                  value, held)
+                elif field == "handlers":
+                    for h in value:
+                        yield from self._scan_globals(module, declared,
+                                                      h.body, held)
+                else:
+                    yield from self._scan_global_expr(module, declared,
+                                                      value, held)
+
+    def _scan_global_expr(self, module: Module, declared, value,
+                          held: frozenset[str]) -> Iterator[Violation]:
+        nodes = value if isinstance(value, list) else [value]
+        for node in nodes:
+            if not isinstance(node, ast.AST):
+                continue
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Name)
+                        and sub.id in declared):
+                    continue
+                guard = declared[sub.id]
+                if guard in held:
+                    continue
+                kind = self._name_access_kind(sub)
+                yield Violation(
+                    f"lock-unguarded-{kind}", module.relpath, sub.lineno,
+                    f"module global {sub.id} is declared `# guarded "
+                    f"by: {guard}` but this {kind} is outside "
+                    f"`with {guard}`")
+
+    @staticmethod
+    def _name_access_kind(name_node: ast.Name) -> str:
+        if isinstance(name_node.ctx, (ast.Store, ast.Del)):
+            return "write"
+        up = parent(name_node)
+        if isinstance(up, ast.Subscript) \
+                and isinstance(up.ctx, (ast.Store, ast.Del)):
+            return "write"
+        if isinstance(up, ast.AugAssign) and up.target is name_node:
+            return "write"
+        if isinstance(up, ast.Attribute) and up.attr in MUTATOR_FNS:
+            call = parent(up)
+            if isinstance(call, ast.Call) and call.func is up:
+                return "write"
+        return "read"
 
     @staticmethod
     def _mro_local(cls: ast.ClassDef,

@@ -34,6 +34,7 @@ from dpcorr.analysis.core import (
     Violation,
     call_chain,
     imported_names,
+    walk_all,
 )
 
 #: what a series published through the shared registry must look like
@@ -81,7 +82,7 @@ class MetricsChecker(Checker):
     # -- metric-name-style ----------------------------------------------
     def _check_names(self, module: Module, imports,
                      ) -> Iterator[Violation]:
-        for node in ast.walk(module.tree):
+        for node in walk_all(module.tree):
             if not isinstance(node, ast.Call):
                 continue
             chain = call_chain(node)
@@ -117,7 +118,7 @@ class MetricsChecker(Checker):
     # -- span-no-finally ------------------------------------------------
     def _check_spans(self, module: Module, imports,
                      ) -> Iterator[Violation]:
-        scopes = [module.tree] + [n for n in ast.walk(module.tree)
+        scopes = [module.tree] + [n for n in walk_all(module.tree)
                                   if isinstance(n, _SCOPES)]
         for scope in scopes:
             yield from self._scan_scope(module, scope)

@@ -39,6 +39,7 @@ from dpcorr.analysis.core import (
     attr_chain,
     call_chain,
     imported_names,
+    walk_all,
     walk_same_scope,
 )
 
@@ -84,7 +85,7 @@ class PurityChecker(Checker):
                 seen.add(id(fn_node))
                 traced.append(fn_node)
 
-        for node in ast.walk(module.tree):
+        for node in walk_all(module.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for deco in node.decorator_list:
                     if self._is_tracer(deco, imports):

@@ -31,7 +31,8 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from dpcorr.analysis.core import Checker, Module, Violation, attr_chain
+from dpcorr.analysis.core import Checker, Module, Violation, \
+    attr_chain, walk_all
 
 #: names that hold raw sample data by repo convention.
 RAW_NAMES = frozenset({
@@ -71,7 +72,7 @@ class RawDataChecker(Checker):
         return "protocol" in relpath.split("/")
 
     def check(self, module: Module) -> Iterator[Violation]:
-        for fn in ast.walk(module.tree):
+        for fn in walk_all(module.tree):
             if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 yield from self._check_fn(module, fn)
 

@@ -32,6 +32,7 @@ from dpcorr.analysis.core import (
     Violation,
     call_chain,
     imported_names,
+    walk_all,
     walk_same_scope,
 )
 
@@ -89,7 +90,7 @@ class RngChecker(Checker):
         imports = imported_names(module.tree)
         yield from self._raw_api(module)
         yield from self._literal_seeds(module)
-        for fn in ast.walk(module.tree):
+        for fn in walk_all(module.tree):
             if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
                                ast.Lambda)):
                 yield from self._key_reuse(module, fn, imports)
@@ -99,7 +100,7 @@ class RngChecker(Checker):
         if _is_rng_file(module.relpath):
             return
         imports = imported_names(module.tree)
-        for node in ast.walk(module.tree):
+        for node in walk_all(module.tree):
             if not isinstance(node, ast.Call):
                 continue
             chain = call_chain(node)
@@ -121,7 +122,7 @@ class RngChecker(Checker):
         if _is_rng_file(module.relpath):
             return
         imports = imported_names(module.tree)
-        for node in ast.walk(module.tree):
+        for node in walk_all(module.tree):
             if not isinstance(node, ast.Call):
                 continue
             chain = call_chain(node)

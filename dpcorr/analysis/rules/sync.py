@@ -33,6 +33,7 @@ from dpcorr.analysis.core import (
     Violation,
     call_chain,
     imported_names,
+    walk_all,
 )
 
 #: call-chain tails that force a host sync regardless of origin
@@ -71,7 +72,7 @@ class SyncChecker(Checker):
     def check(self, module: Module) -> Iterator[Violation]:
         imports = imported_names(module.tree)
         seen: set[tuple[int, int]] = set()
-        for node in ast.walk(module.tree):
+        for node in walk_all(module.tree):
             if isinstance(node, _LOOPS):
                 roots = node.body
             elif isinstance(node, ast.DictComp):

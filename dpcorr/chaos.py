@@ -215,8 +215,8 @@ def plan_from_env(env: str = "DPCORR_CHAOS") -> ChaosPlan | None:
 
 
 _lock = threading.Lock()
-_plan: ChaosPlan | None = None
-_counts: dict[str, int] = {}
+_plan: ChaosPlan | None = None  # guarded by: _lock
+_counts: dict[str, int] = {}  # guarded by: _lock
 _crash_hooks: list = []  # guarded by: _lock
 
 
@@ -252,6 +252,7 @@ def clear() -> None:
 
 
 def active() -> ChaosPlan | None:
+    # dpcorr-lint: ignore[lock-unguarded-read] — benign stale read (racing disarm)
     return _plan
 
 
@@ -260,6 +261,7 @@ def point(name: str) -> None:
     point (and this thread, for thread-scoped plans); on the planned
     traversal the process dies (``exit``) or :class:`SimulatedCrash`
     propagates (``raise``)."""
+    # dpcorr-lint: ignore[lock-unguarded-read] — hot-path probe, re-checked under _lock
     plan = _plan
     if plan is None:
         return
@@ -436,6 +438,7 @@ def fault(name: str) -> None:
     """Declare one fault site. No-op unless an armed plan names this
     point and the traversal falls in its firing window; then sleep
     (``sleep``) or raise :class:`SimulatedFault` (``fail``)."""
+    # dpcorr-lint: ignore[lock-unguarded-read] — hot-path probe, re-read under _lock
     if not _fault_plans:
         return
     if name not in _KNOWN_FAULTS:

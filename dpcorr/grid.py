@@ -618,11 +618,12 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                 failures.extend((int(r.i), e) for r in rows
                                 if int(r.i) not in details)
                 dsp.set(error=type(e).__name__)
-                dsp.end()
                 continue
-            dsp.set(points_run=len(to_run), fused=bool(fused),
-                    precompiled=fut is not None)
-            dsp.end()
+            else:
+                dsp.set(points_run=len(to_run), fused=bool(fused),
+                        precompiled=fut is not None)
+            finally:
+                dsp.end()
             pending.append((rows, to_run, raw, stamps, paths, fused, cfg,
                             mk_stamps, scan_s + time.perf_counter() - t0,
                             fut is not None))
@@ -699,9 +700,9 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
             failures.extend((int(r.i), e) for r in rows
                             if int(r.i) not in details)
             fsp.set(error=type(e).__name__)
-            fsp.end()
             continue
-        fsp.end()
+        finally:
+            fsp.end()
         fetch_s = time.perf_counter() - t0
         ran = len(to_run)
         total_ran += ran
@@ -807,10 +808,11 @@ def run_grid(gcfg: GridConfig, mesh=None) -> GridResult:
                           i, row.n, row.rho, row.eps1, row.eps2, e)
                 failures.append((i, e))
                 psp.set(error=type(e).__name__)
-                psp.end()
                 continue
-            psp.set(cached=cached)
-            psp.end()
+            else:
+                psp.set(cached=cached)
+            finally:
+                psp.end()
             dt = time.perf_counter() - t0
             timings.append({"i": i, "n": row.n, "rho": row.rho,
                             "eps1": row.eps1, "eps2": row.eps2,

@@ -289,57 +289,67 @@ def eps_sweep(cfg: HrsConfig = HrsConfig(), cols=None,
     tr = obs_trace.tracer()
     root = tr.start_span("hrs.eps_sweep", n=n, n_eps=len(eps_grid),
                          reps=reps)
-    pending = []
-    for eps_idx, eps in enumerate(eps_grid):
-        eps = float(eps)
-        dsp = tr.start_span("hrs.dispatch", parent=root, eps=eps)
-        # per-(method, ε, rep) keys — the key-tree analogue of the
-        # reference's seed formulas 10+37·rep+1000·eps_idx / 20+41·rep+...
-        k_eps = rng.design_key(master, eps_idx)
-        keys_ni = rng.rep_keys(rng.stream(k_eps, "hrs/sweep/ni"), reps)
-        keys_int = rng.rep_keys(rng.stream(k_eps, "hrs/sweep/int"), reps)
-        if progress:
-            print(f"eps={eps:.2f}: dispatched "
-                  f"({eps_idx + 1}/{len(eps_grid)})", flush=True)
-        eps_t = jnp.float32(eps)
-        pending.append((eps, (
-            _sweep_ni_kernel(keys_ni, arrays, eps_t, std.lam_age,
-                             std.lam_bmi, cfg.alpha, k_pad),
-            _sweep_int_kernel(keys_int, arrays, eps_t, std.lam_age,
-                              std.lam_bmi, jnp.float32(lam_recvs[eps_idx]),
-                              jnp.float32(delta), cfg.mixquant_mode,
-                              cfg.alpha))))
-        dsp.end()
+    try:
+        pending = []
+        for eps_idx, eps in enumerate(eps_grid):
+            eps = float(eps)
+            dsp = tr.start_span("hrs.dispatch", parent=root, eps=eps)
+            try:
+                # per-(method, ε, rep) keys — the key-tree analogue of the
+                # reference's seed formulas 10+37·rep+1000·eps_idx /
+                # 20+41·rep+...
+                k_eps = rng.design_key(master, eps_idx)
+                keys_ni = rng.rep_keys(rng.stream(k_eps, "hrs/sweep/ni"),
+                                       reps)
+                keys_int = rng.rep_keys(rng.stream(k_eps, "hrs/sweep/int"),
+                                        reps)
+                if progress:
+                    print(f"eps={eps:.2f}: dispatched "
+                          f"({eps_idx + 1}/{len(eps_grid)})", flush=True)
+                eps_t = jnp.float32(eps)
+                pending.append((eps, (
+                    _sweep_ni_kernel(keys_ni, arrays, eps_t, std.lam_age,
+                                     std.lam_bmi, cfg.alpha, k_pad),
+                    _sweep_int_kernel(keys_int, arrays, eps_t, std.lam_age,
+                                      std.lam_bmi,
+                                      jnp.float32(lam_recvs[eps_idx]),
+                                      jnp.float32(delta), cfg.mixquant_mode,
+                                      cfg.alpha))))
+            finally:
+                dsp.end()
 
-    runs = []
-    for eps, out in pending:
-        fsp = tr.start_span("hrs.fetch", parent=root, eps=eps)
-        (ni_hat, ni_lo, ni_hi), (int_hat, int_lo, int_hi) = jax.tree.map(
-            np.asarray, out)
-        fsp.end()
-        for meth, hat, lo, hi in (("NI", ni_hat, ni_lo, ni_hi),
-                                  ("INT", int_hat, int_lo, int_hi)):
-            runs.append(pd.DataFrame({
-                "method": meth, "eps_corr": eps,
-                "rep": np.arange(1, reps + 1),
-                "rho_hat": hat, "ci_low": lo, "ci_high": hi,
-            }))
-        if progress:
-            print(f"eps={eps:.2f}: NI mean {ni_hat.mean():+.4f}, "
-                  f"INT mean {int_hat.mean():+.4f}")
+        runs = []
+        for eps, out in pending:
+            fsp = tr.start_span("hrs.fetch", parent=root, eps=eps)
+            try:
+                (ni_hat, ni_lo, ni_hi), (int_hat, int_lo, int_hi) = \
+                    jax.tree.map(np.asarray, out)
+            finally:
+                fsp.end()
+            for meth, hat, lo, hi in (("NI", ni_hat, ni_lo, ni_hi),
+                                      ("INT", int_hat, int_lo, int_hi)):
+                runs.append(pd.DataFrame({
+                    "method": meth, "eps_corr": eps,
+                    "rep": np.arange(1, reps + 1),
+                    "rho_hat": hat, "ci_low": lo, "ci_high": hi,
+                }))
+            if progress:
+                print(f"eps={eps:.2f}: NI mean {ni_hat.mean():+.4f}, "
+                      f"INT mean {int_hat.mean():+.4f}")
 
-    runs_df = pd.concat(runs, ignore_index=True)
-    g = runs_df.groupby(["method", "eps_corr"], sort=True)
-    summ = pd.DataFrame({
-        "rho_hat_mean": g["rho_hat"].mean(),
-        "ci_low_mean": g["ci_low"].mean(),
-        "ci_high_mean": g["ci_high"].mean(),
-        "ci_low_q10": g["ci_low"].quantile(0.10),
-        "ci_high_q90": g["ci_high"].quantile(0.90),
-    }).reset_index()
-    summ.attrs["runs"] = runs_df
-    summ.attrs["rho_np"] = std.rho_np
-    root.end()
+        runs_df = pd.concat(runs, ignore_index=True)
+        g = runs_df.groupby(["method", "eps_corr"], sort=True)
+        summ = pd.DataFrame({
+            "rho_hat_mean": g["rho_hat"].mean(),
+            "ci_low_mean": g["ci_low"].mean(),
+            "ci_high_mean": g["ci_high"].mean(),
+            "ci_low_q10": g["ci_low"].quantile(0.10),
+            "ci_high_q90": g["ci_high"].quantile(0.90),
+        }).reset_index()
+        summ.attrs["runs"] = runs_df
+        summ.attrs["rho_np"] = std.rho_np
+    finally:
+        root.end()
     return summ
 
 

@@ -44,15 +44,22 @@ def sweep_stale_tmp(path: str) -> None:
     """Remove ``{path}.tmp.*`` crash artifacts: a tmp file that was
     never renamed belongs to a write that never committed, and a dead
     writer will never finish it. Shared by the ledger snapshot
-    (serve.ledger) and the budget directory's shard files."""
+    (serve.ledger) and the budget directory's shard files.
+
+    Writers stamp their pid into the suffix (``{path}.tmp.{pid}``), so
+    a tmp bearing *our own* pid belongs to a writer in this very
+    process — alive by definition, possibly mid-persist on another
+    thread (in-proc crash-resume harnesses reopen a journal while the
+    pre-crash thread is still draining) — and is skipped."""
     d = os.path.dirname(path) or "."
     prefix = os.path.basename(path) + ".tmp."
+    own = str(os.getpid())
     try:
         names = os.listdir(d)
     except OSError:
         return
     for name in names:
-        if name.startswith(prefix):
+        if name.startswith(prefix) and name[len(prefix):] != own:
             try:
                 os.unlink(os.path.join(d, name))
             except OSError:

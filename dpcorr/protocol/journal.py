@@ -31,6 +31,8 @@ import json
 import os
 import secrets
 
+from dpcorr.obs.budget_replay import sweep_stale_tmp
+
 _VERSION = 1
 
 
@@ -65,6 +67,11 @@ class SessionJournal:
 
     def __init__(self, path: str):
         self.path = str(path)
+        # a crash between tmp-write and os.replace strands a
+        # ``{path}.tmp.{pid}`` orphan; the dead writer never finishes
+        # it, so clear them before loading (same discipline as the
+        # ledger snapshot and budget-directory shards)
+        sweep_stale_tmp(self.path)
         self._state = self._load()
 
     # -- persistence -------------------------------------------------

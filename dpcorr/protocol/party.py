@@ -303,6 +303,7 @@ class SessionEndpoint:
             except BudgetExceededError as e:
                 abort = self._msg("error", {
                     "kind": "budget", "reason": str(e), "party": e.party})
+                # dpcorr-lint: ignore[budget-deep-missing-refund] — abort frame is uncharged; send_release already refunded
                 self._send_best_effort(abort)
                 raise ProtocolRefused(str(e)) from e
             self._record("send", msg, receipt, eps=receipt["eps"])
@@ -341,6 +342,7 @@ class SessionEndpoint:
         except BudgetExceededError as e:
             abort = self._msg("error", {
                 "kind": "budget", "reason": str(e), "party": e.party})
+            # dpcorr-lint: ignore[budget-deep-missing-refund] — abort frame is uncharged; send_release already refunded
             self._send_best_effort(abort)
             raise ProtocolRefused(str(e)) from e
         self.journal.mark_acked(entry["slot"])
@@ -493,11 +495,13 @@ class Party(SessionEndpoint):
         headers, which a resume replays verbatim from the journal."""
         if self.role == "x":
             if self.journal is not None and self.journal.trace_id:
+                # dpcorr-lint: ignore[span-no-finally] — session root span; ends in close()
                 self._span = tracer().start_span(
                     "protocol.session", trace_id=self.journal.trace_id,
                     role=self.role, family=self.spec.family,
                     session=self.spec.session, resumed=True)
             else:
+                # dpcorr-lint: ignore[span-no-finally] — session root span; ends in close()
                 self._span = tracer().start_span(
                     "protocol.session", role=self.role,
                     family=self.spec.family, session=self.spec.session)
@@ -513,6 +517,7 @@ class Party(SessionEndpoint):
             self._recv("hello_ack")
         else:
             first = self._recv("hello")
+            # dpcorr-lint: ignore[span-no-finally] — session root span; ends in close()
             self._span = tracer().start_span(
                 "protocol.session", parent=from_wire_headers(first.headers),
                 role=self.role, family=self.spec.family,
@@ -643,6 +648,7 @@ class Party(SessionEndpoint):
             if self.journal.status == "finished" and self.journal.result:
                 return ProtocolResult(**self.journal.result)
             self._attach_journal()
+        # dpcorr-lint: ignore[budget-deep-uncharged-enqueue] — hello/ack frames carry no release, so nothing to charge
         self._handshake()
         chaos.point("party.post_handshake")
         releaser, _ = sr.split_roles(s.family, s.eps1, s.eps2)

@@ -223,7 +223,7 @@ class _Shard:
         self._dirty = rec["wal_entries"]
         if rec["wal_fresh_needed"]:
             self._write_fresh_wal_locked()
-        # the spill file is a within-process cache only — reset on open
+        # dpcorr-lint: ignore[durability-bare-write] — within-process spill cache, reset on open
         self._cold = open(self.cold_path, "w+", encoding="utf-8")  # guarded by: _lock
         self._evict_down_locked(fire_chaos=False)
 
@@ -411,6 +411,7 @@ class _Shard:
                           "w": renewed[0] if renewed is not None
                           else st["w"], "b": win_b})
             chaos.point("budget.pre_journal")
+            # dpcorr-lint: ignore[blocking-under-lock] — WAL-before-ack: fsync order IS the serialization order
             self._wal_append_locked(lines)
             chaos.point("budget.post_journal")
             if renewed is not None:
@@ -425,6 +426,7 @@ class _Shard:
             self.counters["charged_eps"] += eps
             self._dirty += len(lines)
             self._evict_down_locked()
+            # dpcorr-lint: ignore[blocking-under-lock] — compaction must see a quiesced shard
             self._maybe_compact_locked()
             return True
 
@@ -441,6 +443,7 @@ class _Shard:
             st = self._touch_locked(user)
             # w/b carried for the same WAL-only re-creation case as
             # charge entries
+            # dpcorr-lint: ignore[blocking-under-lock] — WAL-before-ack: fsync order IS the serialization order
             self._wal_append_locked(
                 [{"k": "r", "u": user, "e": eps, "id": charge_id,
                   "w": st["w"], "b": st["b"]}])
@@ -452,6 +455,7 @@ class _Shard:
             self.counters["refunded_eps"] += eps
             self._dirty += 1
             self._evict_down_locked()
+            # dpcorr-lint: ignore[blocking-under-lock] — compaction must see a quiesced shard
             self._maybe_compact_locked()
 
     # -- compaction --------------------------------------------------

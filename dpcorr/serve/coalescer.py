@@ -426,6 +426,7 @@ class Coalescer:
 
         launches = []
         for kkey, ps in by_kernel.items():
+            # dpcorr-lint: ignore[span-no-finally] — flush spans ride the launch list; each ends when its future resolves
             fspans = [self.tracer.start_span(
                 "serve.flush", parent=p.span.context,
                 family=kkey.family, n=kkey.n, batch_size=len(ps))
@@ -435,6 +436,7 @@ class Coalescer:
                 # small, predictable unbatched launches under pressure
                 launches.append((kkey, ps, None, fspans, None, None, 0.0))
                 continue
+            # dpcorr-lint: ignore[span-no-finally] — kernel span spans dispatch→fetch; ends at the fetch barrier below
             ksp = self.tracer.start_span(
                 "serve.kernel", parent=fspans[0],
                 family=kkey.family, n=kkey.n, batch_size=len(ps))

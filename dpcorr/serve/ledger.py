@@ -268,6 +268,7 @@ class PrivacyLedger:
                 while len(self._charge_ids) > _CHARGE_ID_CAP:
                     self._charge_ids.pop(next(iter(self._charge_ids)))
             chaos.point("ledger.pre_persist")
+            # dpcorr-lint: ignore[blocking-under-lock] — spend must be durable before the ack leaves the lock
             self._persist_locked()
             chaos.point("ledger.post_persist")
             # observers fire only after the spend is durably on disk —
@@ -316,6 +317,7 @@ class PrivacyLedger:
             # under it again — refund means "that charge never happened"
             if charge_id is not None:
                 self._charge_ids.pop(charge_id, None)
+            # dpcorr-lint: ignore[blocking-under-lock] — refund must be durable before the ack leaves the lock
             self._persist_locked()
             if self._events is not None:
                 self._events.inc(kind="refund")

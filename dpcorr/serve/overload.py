@@ -38,6 +38,7 @@ import threading
 import time
 
 from dpcorr.serve.request import BucketKey
+from dpcorr.serve.stats import ServeStats
 
 #: Gauge encoding for the per-bucket breaker state series.
 STATE_CODES = {"closed": 0, "open": 1, "half_open": 2}
@@ -90,7 +91,8 @@ class CircuitBreaker:
     """
 
     def __init__(self, fail_threshold: int = 5,
-                 reset_after_s: float = 30.0, stats=None,
+                 reset_after_s: float = 30.0,
+                 stats: ServeStats | None = None,
                  clock=time.monotonic, on_open=None):
         if fail_threshold < 1:
             raise ValueError(f"fail_threshold must be >= 1, "
@@ -220,7 +222,8 @@ class BrownoutController:
     def __init__(self, queue_frac: float = 0.75,
                  flush_slo_s: float | None = None,
                  enter_after_s: float = 0.5, exit_after_s: float = 2.0,
-                 stats=None, clock=time.monotonic, on_change=None):
+                 stats: ServeStats | None = None,
+                 clock=time.monotonic, on_change=None):
         if not 0.0 <= queue_frac <= 1.0:
             raise ValueError(f"queue_frac must be in [0, 1], "
                              f"got {queue_frac}")

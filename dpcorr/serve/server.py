@@ -382,6 +382,7 @@ class DpcorrServer:
         with self._idem_lock:
             placeholder = self._idem_inflight.pop(idem, None)
             if err is None:
+                # dpcorr-lint: ignore[blocking-under-lock] — done-callback: fut is already settled, result() cannot block
                 self._idem_done[idem] = fut.result()
                 self._idem_done.move_to_end(idem)
                 while len(self._idem_done) > self._idem_cap:
@@ -450,6 +451,7 @@ class DpcorrServer:
         the budget decision (docs/OBSERVABILITY.md)."""
         seed = req.seed if req.seed is not None else next(self._req_counter)
         key = self._request_key(req, seed)
+        # dpcorr-lint: ignore[span-no-finally] — request root span; closes on the flush thread when the response lands
         root = self.tracer.start_span("serve.request", family=req.family,
                                       n=req.n, seed=seed)
         # the cost record opens with the root span and shares its trace

@@ -168,7 +168,7 @@ class StreamService:
         self.audit = AuditTrail(os.path.join(self.workdir,
                                              "audit.jsonl"))
         self.costs = CostRegistry()
-        self._epoch_ts = 0.0  # event time of the window being released
+        self._epoch_ts = 0.0  # guarded by: _lock — release epoch
         base = PrivacyLedger(
             budget, path=os.path.join(self.workdir, "ledger.json"),
             audit=self.audit, registry=self.registry)
@@ -220,16 +220,19 @@ class StreamService:
                              fsync=fsync)
         self.journal = ReleaseJournal(
             os.path.join(self.workdir, "releases.jsonl"), fsync=fsync)
-        self._recover()
+        self._recover_locked()
 
     # ------------------------------------------------------ recovery ----
-    def _recover(self) -> None:
+    def _recover_locked(self) -> None:
         """Rebuild in-memory state from the durable stores: journaled
         windows are closed (never recomputed), the WAL re-admits every
         acked batch in append order (so watermark history — hence the
         admit/refuse sequence — replays exactly), then any window the
         watermark already passed is released. Idempotent charge ids
-        make the re-release spend nothing it already spent."""
+        make the re-release spend nothing it already spent.
+        Runs from the constructor, before any other thread can hold
+        the lock (the ``_locked`` suffix marks the same caller-owns-
+        the-lock contract the release helpers follow)."""
         for entry in self.journal.entries():
             self.manager.close(str(entry["window_id"]))
         for rec in self.wal.replay():
@@ -240,8 +243,8 @@ class StreamService:
                 # admissible when logged; only refusable now because
                 # every window it fed is already journaled
                 continue
-        self._close_ready()
-        self._publish_gauges()
+        self._close_ready_locked()
+        self._publish_gauges_locked()
 
     # -------------------------------------------------------- ingest ----
     def ingest(self, batch_id: str, ts: float, rows) -> dict:
@@ -269,31 +272,33 @@ class StreamService:
             except LateRecordError:
                 self._batches.inc(kind="late")
                 raise
+            # dpcorr-lint: ignore[blocking-under-lock] — WAL-before-ack: the batch is durable before the ack forms
             seq = self.wal.append(batch_id, float(ts), rows)
             chaos.point("stream.mid_window")
             self._seen.add(batch_id)
             self._batches.inc(kind="accepted")
             if rows:
                 self._rows.inc(len(rows))
-            released, refused = self._close_ready()
-            self._publish_gauges()
+            # dpcorr-lint: ignore[blocking-under-lock] — release charge+journal must serialize with admission
+            released, refused = self._close_ready_locked()
+            self._publish_gauges_locked()
             return {"ok": True, "deduped": False, "seq": seq,
                     "released": released, "refused": refused}
 
     # ------------------------------------------------------- release ----
-    def _close_ready(self):
+    def _close_ready_locked(self):
         """Release every window the watermark has passed, oldest
         first. Caller holds the lock (or is the constructor)."""
         released, refused = [], []
         for window in self.manager.closable():
-            entry = self._release_window(window)
+            entry = self._release_window_locked(window)
             if entry is None:
                 refused.append(window.id)
             else:
                 released.append(window.id)
         return released, refused
 
-    def _release_window(self, window: Window) -> dict | None:
+    def _release_window_locked(self, window: Window) -> dict | None:
         """Charge → release → journal for one closable window; the
         chaos points bracket the durability boundaries (module
         docstring). Returns the journal entry, or None on a budget
@@ -344,7 +349,7 @@ class StreamService:
         return entry
 
     # --------------------------------------------------------- views ----
-    def _publish_gauges(self) -> None:
+    def _publish_gauges_locked(self) -> None:
         self._open_g.set(float(len(self.manager.windows)))
         self._pending_g.set(float(
             sum(len(w) for w in self.manager.windows.values())))

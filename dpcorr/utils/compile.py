@@ -156,8 +156,8 @@ class CompileObserver:
             "Kernel compilations by cause",
             labelnames=("cause",))
         self._cause_lock = threading.Lock()
-        self._seen: set = set()     # signature keys ever compiled here
-        self._evicted: set = set()  # keys whose cache entry was dropped
+        self._seen: set = set()     # guarded by: _cause_lock
+        self._evicted: set = set()  # guarded by: _cause_lock
 
     def note_evicted(self, key) -> None:
         """A consumer cache dropped this signature's entry — the next

@@ -1,0 +1,243 @@
+"""Runtime lock-witness sanitizer (``DPCORR_SYNCWATCH=1``).
+
+The static lock model (``dpcorr lint --deep``, analysis/callgraph.py)
+predicts which lock-order edges the repo can traverse. This module is
+the empirical other half: an opt-in wrapper around ``threading.Lock``
+/ ``threading.RLock`` that records the acquisition-order graph a live
+process *actually* walks, detects order inversions and held-across-
+fsync windows as they happen, and dumps a witness artifact on exit —
+including chaos kills (``chaos.on_crash``; ``os._exit`` skips atexit).
+``dpcorr lint --witness DIR`` (analysis/witness.py) then diffs the
+observed graph against the static prediction: an observed edge the
+model did not predict fails CI, and an observed cycle aborts the
+smoke.
+
+Scope: only locks *created from dpcorr source files* are wrapped (the
+factory checks its caller's frame), so stdlib and third-party locks —
+``concurrent.futures`` internals, logging, the ``threading.Condition``
+a bare ``Condition()`` allocates for itself — pass through untouched.
+A lock's identity is its creation site ``relpath:lineno``: every
+instance born at one site shares an id, which is exactly the static
+model's granularity. jax-free by construction: stdlib only, safe to
+enable in the lint container.
+
+Cost when disabled: zero (nothing is patched). Cost when enabled: one
+dict lookup + list append per acquisition — fine for smokes, not meant
+for benchmark runs.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+
+#: where witness artifacts land unless DPCORR_SYNCWATCH_DIR says else.
+DEFAULT_DIR = ".dpcorr-syncwatch"
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+_real_fsync = os.fsync
+
+# package root ("<...>/dpcorr") — creator frames under it get wrapped
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_DIR = os.path.dirname(_PKG_DIR)
+
+_enabled = False
+_meta = _real_lock()          # guards everything below (a REAL lock:
+_edges: dict = {}             # the sanitizer must not watch itself)
+_locks: dict = {}             # site -> kind
+_inversions: list = []
+_fsync_under_lock: dict = {}
+_threads_seen: set = set()
+_tls = threading.local()
+
+
+def _held() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _creation_site() -> str | None:
+    """``relpath:lineno`` of the frame creating the lock, when that
+    frame lives in a dpcorr source file; None otherwise."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:
+        return None
+    # a relative sys.path entry (`sys.path.insert(0, '.')`) leaves
+    # co_filename relative or un-normalized ("<cwd>/./pkg/mod.py");
+    # anchor and normalize it the way import resolved it
+    fn = os.path.abspath(f.f_code.co_filename)
+    if not fn.startswith(_PKG_DIR + os.sep):
+        return None
+    rel = os.path.relpath(fn, _REPO_DIR).replace(os.sep, "/")
+    return f"{rel}:{f.f_lineno}"
+
+
+class _WatchedLock:
+    """Wraps one real lock; records order edges on acquisition. API
+    surface matches what ``with``, ``threading.Condition`` and direct
+    acquire/release callers use."""
+
+    __slots__ = ("_real", "site", "kind")
+
+    def __init__(self, real, site: str, kind: str):
+        self._real = real
+        self.site = site
+        self.kind = kind
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._record_acquire()
+        return got
+
+    def _record_acquire(self) -> None:
+        stack = _held()
+        reentrant = self.site in stack
+        if not reentrant and stack:
+            acquiring = self.site
+            with _meta:
+                _threads_seen.add(threading.current_thread().name)
+                for held_site in set(stack):
+                    if held_site == acquiring:
+                        continue
+                    edge = (held_site, acquiring)
+                    if edge not in _edges:
+                        _edges[edge] = threading.current_thread().name
+                        if (acquiring, held_site) in _edges:
+                            inv = {"held": held_site,
+                                   "acquiring": acquiring,
+                                   "thread":
+                                       threading.current_thread().name}
+                            _inversions.append(inv)
+                            print(f"dpcorr syncwatch: lock-order "
+                                  f"inversion: {held_site} -> "
+                                  f"{acquiring} (reverse edge already "
+                                  f"observed)", file=sys.stderr)
+        elif stack:
+            with _meta:
+                _threads_seen.add(threading.current_thread().name)
+        stack.append(self.site)
+
+    def release(self) -> None:
+        stack = _held()
+        # remove the most recent entry for this site (reentrant locks
+        # push once per level, so counts stay balanced)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == self.site:
+                del stack[i]
+                break
+        self._real.release()
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def _at_fork_reinit(self) -> None:  # pragma: no cover - fork only
+        self._real._at_fork_reinit()
+        _tls.stack = []
+
+
+def _make_factory(real_factory, kind: str):
+    def factory():
+        real = real_factory()
+        site = _creation_site()
+        if site is None:
+            return real
+        with _meta:
+            _locks.setdefault(site, kind)
+        return _WatchedLock(real, site, kind)
+    return factory
+
+
+def _watched_fsync(fd):
+    stack = _held()
+    if stack:
+        with _meta:
+            for site in set(stack):
+                _fsync_under_lock[site] = \
+                    _fsync_under_lock.get(site, 0) + 1
+    return _real_fsync(fd)
+
+
+def snapshot() -> dict:
+    """The witness artifact as a dict (also what gets dumped)."""
+    with _meta:
+        return {
+            "pid": os.getpid(),
+            "locks": {site: {"kind": kind}
+                      for site, kind in sorted(_locks.items())},
+            "edges": sorted([a, b] for (a, b) in _edges),
+            "edge_threads": {f"{a} -> {b}": t
+                             for (a, b), t in sorted(_edges.items())},
+            "inversions": list(_inversions),
+            "fsync_under_lock": dict(sorted(
+                _fsync_under_lock.items())),
+            "threads": sorted(_threads_seen),
+        }
+
+
+def dump(directory: str | None = None) -> str:
+    """Write the witness artifact for this process; returns the path.
+    Registered both with atexit and ``chaos.on_crash`` so a planned
+    kill (``os._exit``) still leaves its witness behind."""
+    directory = directory or os.environ.get("DPCORR_SYNCWATCH_DIR",
+                                            DEFAULT_DIR)
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"witness-{os.getpid()}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(snapshot(), fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def enable() -> None:
+    """Patch the lock factories and ``os.fsync``. Idempotent; called
+    from ``dpcorr/__init__`` when ``DPCORR_SYNCWATCH=1`` so the patch
+    lands before any dpcorr module creates a lock."""
+    global _enabled
+    if _enabled:
+        return
+    _enabled = True
+    threading.Lock = _make_factory(_real_lock, "lock")
+    threading.RLock = _make_factory(_real_rlock, "rlock")
+    os.fsync = _watched_fsync
+    atexit.register(dump)
+    from dpcorr import chaos
+    chaos.on_crash(lambda point: dump())
+
+
+def disable() -> None:
+    """Undo :func:`enable` (tests). Locks already created stay
+    wrapped; recording state is reset."""
+    global _enabled
+    if not _enabled:
+        return
+    _enabled = False
+    threading.Lock = _real_lock
+    threading.RLock = _real_rlock
+    os.fsync = _real_fsync
+    try:
+        atexit.unregister(dump)
+    except Exception:  # pragma: no cover
+        pass
+    with _meta:
+        _edges.clear()
+        _locks.clear()
+        _inversions.clear()
+        _fsync_under_lock.clear()
+        _threads_seen.clear()

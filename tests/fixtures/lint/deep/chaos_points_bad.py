@@ -1,0 +1,25 @@
+# Miniature chaos registry (located structurally, like the real one in
+# dpcorr/chaos.py): one dead point, one orphaned point only a private
+# helper instruments, one live-but-unswept point, one healthy point.
+
+KNOWN_POINTS = (
+    "fix.dead_point",
+    "fix.orphan_point",
+    "fix.unswept_point",
+    "fix.swept_point",
+)
+
+MATRIX_POINTS = ("fix.swept_point",)
+
+
+def point(name):
+    return name
+
+
+def run():
+    point("fix.unswept_point")
+    point("fix.swept_point")
+
+
+def _forgotten():
+    point("fix.orphan_point")

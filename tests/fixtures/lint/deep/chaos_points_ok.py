@@ -1,0 +1,22 @@
+# Healthy miniature registry: every point is instrumented on a path
+# reachable from a public entrypoint and swept by the kill matrix.
+
+KNOWN_POINTS = (
+    "fix.alpha_point",
+    "fix.beta_point",
+)
+
+MATRIX_POINTS = ("fix.alpha_point", "fix.beta_point")
+
+
+def point(name):
+    return name
+
+
+def run():
+    point("fix.alpha_point")
+    _inner()
+
+
+def _inner():
+    point("fix.beta_point")

@@ -1,0 +1,8 @@
+# A registry entry kept on purpose (the point is wired up in a repo
+# this fixture can't see), silenced at its registry line.
+
+KNOWN_POINTS = (
+    "fix.external_point",  # dpcorr-lint: ignore[chaos-unreachable-point]
+)
+
+MATRIX_POINTS = ("fix.external_point",)

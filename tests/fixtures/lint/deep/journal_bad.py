@@ -1,0 +1,8 @@
+# The seeded torn-file shape: a bare write to a durable journal with
+# no fsync anywhere on the path. Exactly ONE durability-bare-write.
+import json
+
+
+def save_snapshot(path, state):
+    with open(path, "w") as fh:
+        json.dump(state, fh)

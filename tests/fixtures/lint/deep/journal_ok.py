@@ -1,0 +1,37 @@
+# Full durable discipline: fsync-before-ack appends, tmp+fsync+replace
+# snapshots, a stale-tmp sweep before replacing, quarantine on load.
+import json
+import os
+
+
+def sweep_stale_tmp(dirpath):
+    for name in os.listdir(dirpath):
+        if name.endswith(".tmp"):
+            os.unlink(os.path.join(dirpath, name))
+
+
+def append(path, rec):
+    with open(path, "a") as fh:
+        fh.write(rec)
+        fh.flush()
+        os.fsync(fh.fileno())
+        return 1
+
+
+def persist(path, state):
+    sweep_stale_tmp(os.path.dirname(path))
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(state, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def load(path):
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except ValueError:
+        os.replace(path, path + ".corrupt")
+        return None

@@ -1,0 +1,17 @@
+# blocking-under-lock, interprocedurally: record() holds the lock and
+# calls _sync(), whose fsync it inherits through the call graph.
+import os
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def record(self, fh, rec):
+        with self._lock:
+            fh.write(rec)
+            self._sync(fh)
+
+    def _sync(self, fh):
+        os.fsync(fh.fileno())

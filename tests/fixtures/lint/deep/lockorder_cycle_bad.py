@@ -1,0 +1,20 @@
+# The seeded deadlock: forward() takes A then B, backward() takes B
+# then A. The deep pass must report exactly ONE lock-order-cycle whose
+# chain names both acquisition paths file:line.
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                return 2

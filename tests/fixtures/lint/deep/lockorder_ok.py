@@ -1,0 +1,22 @@
+# Clean: both paths take A before B, and the fsync happens after the
+# lock is released.
+import os
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def also_forward(self, fh):
+        with self._a:
+            with self._b:
+                staged = fh
+        os.fsync(staged.fileno())
+        return 3

@@ -1,0 +1,13 @@
+# tmp+fsync+replace is the right write path, but the module has no
+# stale-tmp sweep and no quarantine path for torn files on recovery.
+import json
+import os
+
+
+def persist(path, state):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(state, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)

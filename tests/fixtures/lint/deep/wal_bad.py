@@ -1,0 +1,13 @@
+# durability-unsynced-ack: the early return hands the caller a sequence
+# number before the fsync below it has made the record durable.
+import os
+
+
+def append(path, rec, fast):
+    with open(path, "a") as fh:
+        fh.write(rec)
+        seq = 1
+        if fast:
+            return seq
+        os.fsync(fh.fileno())
+        return seq

@@ -1,0 +1,24 @@
+# Cross-function budget discipline violations that the intra-function
+# rule (rules/budget.py) cannot see: the enqueue lives in a private
+# helper, so only the composed (inlined) view exposes the ordering.
+
+
+class Server:
+    def __init__(self, ledger, coalescer):
+        self.ledger = ledger
+        self.coalescer = coalescer
+
+    def estimate(self, req):
+        fut = self._enqueue(req)
+        self.ledger.charge(req.party, req.eps)
+        return fut
+
+    def _enqueue(self, req):
+        return self.coalescer.submit(req)
+
+    def admit(self, req):
+        self.ledger.charge(req.party, req.eps)
+        return self._launch(req)
+
+    def _launch(self, req):
+        return self.coalescer.submit(req)

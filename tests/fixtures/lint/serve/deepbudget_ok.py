@@ -1,0 +1,19 @@
+# The composed view done right: charge strictly before the inherited
+# enqueue, and the post-charge launch wrapped in a refund guard.
+
+
+class Server:
+    def __init__(self, ledger, coalescer):
+        self.ledger = ledger
+        self.coalescer = coalescer
+
+    def admit(self, req):
+        self.ledger.charge(req.party, req.eps)
+        try:
+            return self._launch(req)
+        except Exception:
+            self.ledger.refund(req.party, req.eps)
+            raise
+
+    def _launch(self, req):
+        return self.coalescer.submit(req)

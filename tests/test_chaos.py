@@ -514,3 +514,65 @@ def test_chaos_cli_full_matrix_tcp(tmp_path, family):
     report = json.loads(proc.stdout)
     assert report["ok"]
     assert len(report["cases"]) == 2 * len(chaos.MATRIX_POINTS)
+
+
+# -- serve coalescer crash windows ------------------------------------
+# These sweep coalescer.pre_flush / coalescer.post_flush (the two
+# KNOWN_POINTS the two-party matrix never traverses): raise-mode plans
+# scoped to the serve flush thread, asserting the window's ordering
+# contract on each side of the kernel launch.
+
+def _coalescer_req(seed=7, n=96):
+    rs = np.random.RandomState(seed)
+    from dpcorr.serve.request import EstimateRequest
+    return EstimateRequest("ni_sign", rs.randn(n).astype(np.float32),
+                           rs.randn(n).astype(np.float32),
+                           1.0, 0.5, seed=seed)
+
+
+def _run_with_flush_crash(point):
+    """Arm ``point`` on the serve flush thread, run one estimate, and
+    return (estimate outcome or exception, captured thread crash)."""
+    from dpcorr.serve.server import DpcorrServer
+
+    crashes = []
+    prev_hook = threading.excepthook
+    threading.excepthook = lambda args: crashes.append(args)
+    srv = DpcorrServer(budget=1e6, max_delay_s=0.001, shard="off")
+    try:
+        chaos.install(ChaosPlan(point=point, hit=1, mode="raise",
+                                thread_name="dpcorr-serve-flush"))
+        try:
+            outcome = srv.estimate(_coalescer_req(), timeout=5.0)
+        except Exception as e:
+            outcome = e
+        srv.coalescer._thread.join(timeout=5.0)
+        assert not srv.coalescer._thread.is_alive(), \
+            "flush thread survived a raise-mode chaos kill"
+        return outcome, crashes
+    finally:
+        threading.excepthook = prev_hook
+        chaos.clear()
+        srv.close()
+
+
+def test_chaos_coalescer_pre_flush_kills_before_launch():
+    """coalescer.pre_flush fires before the group is claimed: the
+    pending future is never resolved (the client times out and its
+    cancel wins), and the flush thread dies of SimulatedCrash."""
+    from concurrent.futures import TimeoutError as FuturesTimeout
+
+    outcome, crashes = _run_with_flush_crash("coalescer.pre_flush")
+    assert isinstance(outcome, FuturesTimeout), \
+        f"expected the estimate to time out, got {outcome!r}"
+    assert crashes and crashes[0].exc_type is SimulatedCrash
+
+
+def test_chaos_coalescer_post_flush_crashes_after_responses_land():
+    """coalescer.post_flush fires after futures resolve: the client
+    still gets its answer — the crash window sits strictly after
+    response delivery — and only then does the flush thread die."""
+    outcome, crashes = _run_with_flush_crash("coalescer.post_flush")
+    assert not isinstance(outcome, Exception), f"estimate failed: {outcome!r}"
+    assert outcome.rho_hat == outcome.rho_hat  # a real response (not NaN)
+    assert crashes and crashes[0].exc_type is SimulatedCrash

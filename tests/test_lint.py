@@ -133,7 +133,7 @@ def test_protocol_budget_ok_fixture_is_clean():
 def test_stream_budget_bad_fixture_fires_both_budget_rules():
     """The budget rules extend to stream/: handing a window to the
     releaser is an enqueue, so it needs a dominating per-window charge
-    and a refund guard (stream.service._release_window's shape)."""
+    and a refund guard (stream.service._release_window_locked's shape)."""
     vs = lint_fixture("stream/budget_bad.py")
     assert fired(vs) == [
         ("budget-missing-refund", 13),
@@ -372,3 +372,134 @@ def test_module_cli_entrypoint():
                        cwd=REPO)
     assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
     assert "0 new violations" in r.stdout
+
+
+# ---------------------------------------------------------- deep pass ----
+def deep_fixture(*names, rules=None):
+    return run_lint(list(names), str(FIXTURES), rule_filter=rules,
+                    deep=True)
+
+
+def test_lockorder_cycle_bad_fires_exactly_one_cycle():
+    """The seeded two-lock deadlock: exactly ONE lock-order-cycle
+    finding whose chain names both acquisition paths file:line."""
+    vs = deep_fixture("deep/lockorder_cycle_bad.py", rules=["lockorder"])
+    assert fired(vs) == [("lock-order-cycle", 14)]
+    (v,) = vs
+    assert "deep/lockorder_cycle_bad.py:14 (Pair.forward)" in v.chain
+    assert "deep/lockorder_cycle_bad.py:19 (Pair.backward)" in v.chain
+
+
+def test_lockorder_blocking_bad_fixture_interprocedural():
+    """record() never fsyncs itself — the effect is inherited from
+    _sync() through the call graph, and the chain says so."""
+    vs = deep_fixture("deep/lockorder_blocking_bad.py",
+                      rules=["lockorder"])
+    assert fired(vs) == [("blocking-under-lock", 14)]
+    (v,) = vs
+    assert v.chain == (
+        "deep/lockorder_blocking_bad.py:14 (Store.record)",
+        "deep/lockorder_blocking_bad.py:17 (Store._sync) os.fsync")
+
+
+def test_lockorder_ok_and_suppressed_fixtures_silent():
+    assert deep_fixture("deep/lockorder_ok.py",
+                        rules=["lockorder"]) == []
+    assert deep_fixture("deep/lockorder_suppressed_ok.py",
+                        rules=["lockorder"]) == []
+
+
+def test_durability_bare_write_to_journal_exactly_one():
+    """The seeded torn-file shape: exactly ONE durability-bare-write
+    naming the offending site."""
+    vs = deep_fixture("deep/journal_bad.py", rules=["durability"])
+    assert fired(vs) == [("durability-bare-write", 7)]
+    (v,) = vs
+    assert v.chain == ("deep/journal_bad.py:7 (save_snapshot)",)
+
+
+def test_durability_unsynced_ack_fixture():
+    vs = deep_fixture("deep/wal_bad.py", rules=["durability"])
+    assert fired(vs) == [("durability-unsynced-ack", 11)]
+
+
+def test_durability_module_level_sweep_and_quarantine():
+    vs = deep_fixture("deep/snapshot_bad.py", rules=["durability"])
+    assert fired(vs) == [("durability-missing-quarantine", 13),
+                        ("durability-missing-sweep", 13)]
+
+
+def test_durability_ok_and_suppressed_fixtures_silent():
+    assert deep_fixture("deep/journal_ok.py",
+                        rules=["durability"]) == []
+    assert deep_fixture("deep/journal_suppressed_ok.py",
+                        rules=["durability"]) == []
+
+
+def test_deepbudget_bad_fixture_cross_function():
+    vs = deep_fixture("serve/deepbudget_bad.py", rules=["deepbudget"])
+    assert fired(vs) == [("budget-deep-missing-refund", 21),
+                        ("budget-deep-uncharged-enqueue", 12)]
+    by_rule = {v.rule: v for v in vs}
+    # both findings anchor at the caller but name the callee's enqueue
+    assert "self.coalescer.submit" in \
+        by_rule["budget-deep-uncharged-enqueue"].message
+    assert by_rule["budget-deep-missing-refund"].chain == (
+        "serve/deepbudget_bad.py:21 (Server.admit)",)
+
+
+def test_deepbudget_ok_and_suppressed_fixtures_silent():
+    assert deep_fixture("serve/deepbudget_ok.py",
+                        rules=["deepbudget"]) == []
+    assert deep_fixture("serve/deepbudget_suppressed_ok.py",
+                        rules=["deepbudget"]) == []
+
+
+def test_coverage_bad_fixture_registry_audit():
+    vs = deep_fixture("deep/chaos_points_bad.py", rules=["coverage"])
+    assert fired(vs) == [("chaos-unreachable-point", 6),
+                        ("chaos-unreachable-point", 7),
+                        ("chaos-unswept-point", 8)]
+    orphan = [v for v in vs if v.line == 7]
+    assert orphan[0].chain == (
+        "deep/chaos_points_bad.py:25 (_forgotten)",)
+
+
+def test_coverage_ok_and_suppressed_fixtures_silent():
+    assert deep_fixture("deep/chaos_points_ok.py",
+                        rules=["coverage"]) == []
+    assert deep_fixture("deep/chaos_points_suppressed_ok.py",
+                        rules=["coverage"]) == []
+
+
+def test_repo_is_deep_lint_clean_modulo_baseline():
+    """The shipped tree passes its own interprocedural pass with an
+    EMPTY committed baseline — the same gate CI applies
+    (`python -m dpcorr lint --deep`)."""
+    vs = run_lint(["dpcorr"], str(REPO), deep=True)
+    baseline = REPO / ".dpcorr-lint-baseline.json"
+    entries = load_baseline(str(baseline)) if baseline.exists() else []
+    new, _, _ = apply_baseline(vs, entries)
+    assert new == [], "\n".join(v.render() for v in new)
+
+
+def test_deep_lint_is_jax_free():
+    """`dpcorr lint --deep` over the default paths on a jax-less
+    interpreter (-S skips the site hook): exits 0 and never imports
+    jax — the CI lint job has no jax wheel."""
+    r = subprocess.run(
+        [sys.executable, "-S", "-c",
+         "import sys; sys.path.insert(0, '.'); "
+         "from dpcorr.analysis import cli; "
+         "rc = cli.main(['--deep']); "
+         "assert 'jax' not in sys.modules, 'deep lint pulled jax'; "
+         "sys.exit(rc)"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
+
+
+def test_cli_deep_cyclic_fixture_exits_1():
+    """The CI canary: the deliberately cyclic fixture must fail the
+    deep gate with exit 1 (not 0, not a crash)."""
+    assert lint_main(["--root", str(FIXTURES), "--no-baseline",
+                      "--deep", "deep/lockorder_cycle_bad.py"]) == 1

@@ -1,0 +1,273 @@
+"""Runtime lock-witness sanitizer (dpcorr/utils/syncwatch.py) and the
+``dpcorr lint --witness`` diff gate (dpcorr/analysis/witness.py).
+
+The in-process tests drive _WatchedLock directly (the factory only
+wraps locks whose creation frame is a dpcorr source file, which test
+files are not) — the factory's frame filter itself is covered by
+constructing a real dpcorr object after enable(). jax is never needed:
+both modules are stdlib-only by design.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from dpcorr.analysis.cli import main as lint_main
+from dpcorr.analysis.witness import run_witness_check
+from dpcorr.utils import syncwatch
+
+REPO = Path(__file__).parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "lint"
+
+
+@pytest.fixture
+def watch():
+    syncwatch.enable()
+    try:
+        yield syncwatch
+    finally:
+        syncwatch.disable()
+        syncwatch._tls.stack = []
+
+
+def make_lock(site, kind="lock"):
+    real = syncwatch._real_rlock() if kind == "rlock" \
+        else syncwatch._real_lock()
+    with syncwatch._meta:
+        syncwatch._locks.setdefault(site, kind)
+    return syncwatch._WatchedLock(real, site, kind)
+
+
+# ------------------------------------------------------- recording ----
+def test_nested_acquisition_records_one_edge(watch):
+    a = make_lock("dpcorr/x.py:10")
+    b = make_lock("dpcorr/x.py:20")
+    with a:
+        with b:
+            pass
+    snap = watch.snapshot()
+    assert snap["edges"] == [["dpcorr/x.py:10", "dpcorr/x.py:20"]]
+    assert snap["inversions"] == []
+    assert snap["locks"]["dpcorr/x.py:10"] == {"kind": "lock"}
+    # repeating the same ordering adds nothing
+    with a:
+        with b:
+            pass
+    assert watch.snapshot()["edges"] == snap["edges"]
+
+
+def test_order_inversion_detected_live(watch, capsys):
+    a = make_lock("dpcorr/x.py:10")
+    b = make_lock("dpcorr/x.py:20")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    snap = watch.snapshot()
+    assert len(snap["edges"]) == 2
+    assert snap["inversions"] == [
+        {"held": "dpcorr/x.py:20", "acquiring": "dpcorr/x.py:10",
+         "thread": threading.current_thread().name}]
+    assert "lock-order inversion" in capsys.readouterr().err
+
+
+def test_reentrant_rlock_records_no_self_edge(watch):
+    r = make_lock("dpcorr/x.py:30", kind="rlock")
+    with r:
+        with r:
+            pass
+    snap = watch.snapshot()
+    assert snap["edges"] == []
+    assert snap["inversions"] == []
+    assert not syncwatch._held()  # push/pop stayed balanced
+
+
+def test_fsync_under_lock_counted(watch, tmp_path):
+    a = make_lock("dpcorr/x.py:40")
+    fd = os.open(str(tmp_path / "f"), os.O_CREAT | os.O_WRONLY)
+    try:
+        with a:
+            os.fsync(fd)  # patched while enabled
+        os.fsync(fd)      # not under any watched lock: not counted
+    finally:
+        os.close(fd)
+    assert watch.snapshot()["fsync_under_lock"] == {"dpcorr/x.py:40": 1}
+
+
+def test_factory_wraps_only_dpcorr_created_locks(watch):
+    # created from this (non-dpcorr) frame: passes through untouched
+    plain = threading.Lock()
+    assert not isinstance(plain, syncwatch._WatchedLock)
+    # created inside the dpcorr package: wrapped, site = creation line
+    from dpcorr.obs.metrics import Registry
+    reg = Registry()
+    assert isinstance(reg._lock, syncwatch._WatchedLock)
+    assert reg._lock.site.startswith("dpcorr/obs/metrics.py:")
+
+
+def test_enable_idempotent_and_disable_restores():
+    syncwatch.enable()
+    factory = threading.Lock
+    syncwatch.enable()
+    assert threading.Lock is factory  # second enable is a no-op
+    syncwatch.disable()
+    assert threading.Lock is syncwatch._real_lock
+    assert os.fsync is syncwatch._real_fsync
+    assert syncwatch.snapshot()["edges"] == []
+
+
+def test_dump_writes_witness_artifact(watch, tmp_path):
+    a = make_lock("dpcorr/x.py:10")
+    b = make_lock("dpcorr/x.py:20")
+    with a:
+        with b:
+            pass
+    path = watch.dump(str(tmp_path))
+    assert os.path.basename(path) == f"witness-{os.getpid()}.json"
+    art = json.loads(Path(path).read_text())
+    assert art["pid"] == os.getpid()
+    assert art["edges"] == [["dpcorr/x.py:10", "dpcorr/x.py:20"]]
+    assert art["edge_threads"] == {
+        "dpcorr/x.py:10 -> dpcorr/x.py:20":
+            threading.current_thread().name}
+    assert not list(tmp_path.glob("*.tmp.*"))  # dump is tmp+replace
+
+
+# ---------------------------------------------------- witness gate ----
+# static model for the gate tests: deep/lockorder_ok.py declares locks
+# at lines 9 (_a) and 10 (_b) and the one order _a -> _b.
+OK_FIX = "deep/lockorder_ok.py"
+SITE_A = f"{OK_FIX}:9"
+SITE_B = f"{OK_FIX}:10"
+
+
+def write_witness(d, edges=(), inversions=(), name="witness-1.json"):
+    d.mkdir(exist_ok=True)
+    (d / name).write_text(json.dumps({
+        "pid": 1, "locks": {}, "edges": [list(e) for e in edges],
+        "edge_threads": {}, "inversions": list(inversions),
+        "fsync_under_lock": {}, "threads": ["MainThread"]}))
+
+
+def test_witness_missing_dir_and_empty_dir_are_usage_errors(tmp_path):
+    assert run_witness_check([OK_FIX], str(FIXTURES),
+                             str(tmp_path / "nope")) == 2
+    (tmp_path / "empty").mkdir()
+    assert run_witness_check([OK_FIX], str(FIXTURES),
+                             str(tmp_path / "empty")) == 2
+
+
+def test_witness_predicted_edge_is_clean(tmp_path, capsys):
+    write_witness(tmp_path, edges=[(SITE_A, SITE_B)])
+    assert run_witness_check([OK_FIX], str(FIXTURES),
+                             str(tmp_path)) == 0
+    assert "witness: clean" in capsys.readouterr().out
+
+
+def test_witness_line_slack_matches_nearby_site(tmp_path):
+    # creation frame two lines below the static site: same lock
+    write_witness(tmp_path, edges=[(f"{OK_FIX}:11", SITE_B)])
+    assert run_witness_check([OK_FIX], str(FIXTURES),
+                             str(tmp_path)) == 0
+
+
+def test_witness_unpredicted_edge_fails(tmp_path, capsys):
+    write_witness(tmp_path, edges=[(SITE_B, SITE_A)])  # reverse order
+    assert run_witness_check([OK_FIX], str(FIXTURES),
+                             str(tmp_path)) == 1
+    assert "observed-but-unpredicted lock order" in \
+        capsys.readouterr().out
+
+
+def test_witness_unknown_site_counts_as_unpredicted(tmp_path, capsys):
+    write_witness(tmp_path, edges=[("dpcorr/nowhere.py:1", SITE_B)])
+    assert run_witness_check([OK_FIX], str(FIXTURES),
+                             str(tmp_path), as_json=True) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["unknown_sites"] == ["dpcorr/nowhere.py:1"]
+    assert not report["ok"]
+
+
+def test_witness_runtime_inversion_fails(tmp_path, capsys):
+    write_witness(tmp_path, edges=[(SITE_A, SITE_B)],
+                  inversions=[{"held": SITE_A, "acquiring": SITE_B,
+                               "thread": "T1"}])
+    assert run_witness_check([OK_FIX], str(FIXTURES),
+                             str(tmp_path)) == 1
+    assert "runtime lock-order inversion" in capsys.readouterr().out
+
+
+def test_witness_cross_process_cycle_fails(tmp_path, capsys):
+    """Two witnesses, each edge individually predicted by the cyclic
+    fixture's (deliberately cyclic) model — the union still cycles."""
+    cyc = "deep/lockorder_cycle_bad.py"
+    write_witness(tmp_path, edges=[(f"{cyc}:9", f"{cyc}:10")])
+    write_witness(tmp_path, edges=[(f"{cyc}:10", f"{cyc}:9")],
+                  name="witness-2.json")
+    assert run_witness_check([cyc], str(FIXTURES), str(tmp_path)) == 1
+    assert "observed lock-order cycle" in capsys.readouterr().out
+
+
+def test_cli_witness_wiring(tmp_path, capsys):
+    write_witness(tmp_path, edges=[(SITE_A, SITE_B)])
+    assert lint_main(["--root", str(FIXTURES),
+                      "--witness", str(tmp_path), OK_FIX]) == 0
+    capsys.readouterr()
+    assert lint_main(["--root", str(FIXTURES),
+                      "--witness", str(tmp_path / "nope"), OK_FIX]) == 2
+
+
+def test_witness_gate_is_jax_free(tmp_path):
+    """`dpcorr lint --witness` end-to-end on a jax-less interpreter
+    (-S): builds the full static lock model for dpcorr/ and diffs a
+    witness dir, without ever importing jax."""
+    write_witness(tmp_path)  # no observed edges: trivially clean
+    r = subprocess.run(
+        [sys.executable, "-S", "-c",
+         "import sys; sys.path.insert(0, '.'); "
+         "from dpcorr.analysis import cli; "
+         f"rc = cli.main(['--witness', {str(tmp_path)!r}, 'dpcorr']); "
+         "assert 'jax' not in sys.modules, 'witness gate pulled jax'; "
+         "sys.exit(rc)"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
+
+
+def test_syncwatch_dump_survives_chaos_kill(tmp_path):
+    """enable() registers the dump with chaos.on_crash, so a planned
+    os._exit(42) kill still leaves a witness artifact behind."""
+    code = (
+        "import os\n"
+        "os.environ['DPCORR_SYNCWATCH'] = '1'\n"
+        f"os.environ['DPCORR_SYNCWATCH_DIR'] = {str(tmp_path)!r}\n"
+        "import sys; sys.path.insert(0, '.')\n"
+        "import dpcorr\n"
+        "from dpcorr import chaos\n"
+        "from dpcorr.obs.metrics import Registry\n"
+        "c = Registry().counter('x', 'help')\n"
+        "c.inc()\n"
+        "plan = chaos.ChaosPlan('ledger.pre_persist', hit=1)\n"
+        "chaos.install(plan)\n"
+        "chaos.point('ledger.pre_persist')\n"
+        "raise SystemExit('chaos point did not fire')\n")
+    r = subprocess.run([sys.executable, "-S", "-c", code],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO)
+    assert r.returncode == chaos_exit_code(), (r.stdout, r.stderr)
+    arts = list(tmp_path.glob("witness-*.json"))
+    assert len(arts) == 1
+    art = json.loads(arts[0].read_text())
+    assert any(site.startswith("dpcorr/obs/metrics.py:")
+               for site in art["locks"])
+
+
+def chaos_exit_code():
+    from dpcorr import chaos
+    return chaos.EXIT_CODE

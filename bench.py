@@ -498,9 +498,17 @@ def worker_main(mode: str, budget_s: float) -> None:
         "path": best, "paths": paths,
         "device": str(jax.devices()[0]),
         "device_kind": "tpu" if platform in ("tpu", "axon") else platform,
+        # devices the measurement actually ran on (the winning
+        # pipeline's placement, not the host inventory) + mesh shape, so
+        # trajectory/gate attribution never folds a 1-device series with
+        # an N-device sharded one
+        "device_count": pipes[best].placement.device_count,
         "geometry": best_geo.as_detail(),
         "transfer": transfer_mod.diff(counters.snapshot(), before),
     }
+    mesh_shape = pipes[best].placement.mesh_shape()
+    if mesh_shape:
+        detail["mesh"] = mesh_shape
     # measured arithmetic intensity (ISSUE 15): the winning kernel's XLA
     # cost analysis, per-rep normalized — benchmarks/roofline.py consumes
     # this instead of hand-derived FLOP constants

@@ -8,6 +8,7 @@ derive from this list.
 """
 
 from dpcorr.analysis.rules.budget import BudgetChecker
+from dpcorr.analysis.rules.compilepath import CompilePathChecker
 from dpcorr.analysis.rules.coverage import ChaosCoverageChecker
 from dpcorr.analysis.rules.deepbudget import DeepBudgetChecker
 from dpcorr.analysis.rules.durability import DurabilityChecker
@@ -21,7 +22,8 @@ from dpcorr.analysis.rules.sync import SyncChecker
 
 #: registration order is report order for equal (path, line).
 ALL_CHECKERS = (RngChecker, BudgetChecker, LockChecker, PurityChecker,
-                RawDataChecker, SyncChecker, MetricsChecker)
+                RawDataChecker, SyncChecker, MetricsChecker,
+                CompilePathChecker)
 
 #: the interprocedural (``--deep``) families — ProjectChecker
 #: subclasses run over the callgraph model after the per-module pass.

@@ -1,0 +1,62 @@
+"""One compile path: every AOT build goes through ``utils.compile``.
+
+The repo's ahead-of-time story (export round-trips, compile-event
+observability, SingleFlight dedup, the AOT-vs-lazy-jit fallback
+contract) all hangs off one function —
+:func:`dpcorr.utils.compile.aot_compile` — and through it the plan
+layer (``dpcorr.plan.Executor.prepare``). A private
+``jit(...).lower(...).compile()`` anywhere else silently opts out of
+all of it: the compile is invisible to ``dpcorr_compile_*`` metrics,
+races other builders of the same signature, and never participates in
+the export cache. The grid, serve, federation and roofline dispatch
+sites were each exactly that bug before ISSUE 19 ported them. One rule:
+
+- ``aot-outside-compile-layer`` — a ``.lower(...).compile(...)`` call
+  chain in any scanned module other than ``utils/compile.py`` itself.
+
+The chain match requires the ``.compile()`` receiver to be a
+``.lower(...)`` *call*, so ``str.lower()`` and config objects with a
+``compile`` method never fire. The committed baseline carries zero
+entries for this rule: there is no legacy site to grandfather, and any
+new finding is a regression, not debt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from dpcorr.analysis.core import Checker, Module, Violation, walk_all
+
+
+class CompilePathChecker(Checker):
+    name = "compilepath"
+    rules = {
+        "aot-outside-compile-layer":
+            ".lower(...).compile() outside utils/compile.py — AOT "
+            "builds go through utils.compile.aot_compile (or "
+            "plan.Executor.prepare) so they are observed, deduplicated "
+            "and exportable",
+    }
+
+    def applies_to(self, relpath: str) -> bool:
+        # everything except the one sanctioned site
+        return not relpath.endswith("utils/compile.py")
+
+    def check(self, module: Module) -> Iterator[Violation]:
+        for node in walk_all(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr == "compile"):
+                continue
+            recv = fn.value
+            if not (isinstance(recv, ast.Call)
+                    and isinstance(recv.func, ast.Attribute)
+                    and recv.func.attr == "lower"):
+                continue
+            yield Violation(
+                "aot-outside-compile-layer", module.relpath, node.lineno,
+                ".lower(...).compile() builds an AOT executable outside "
+                "the compile layer — route it through "
+                "utils.compile.aot_compile / plan.Executor.prepare")

@@ -63,11 +63,14 @@ class SyncChecker(Checker):
         # the replication hot path only: these are the modules where a
         # per-iteration sync is a throughput bug rather than a style
         # choice (analysis code, tests and the serving layer fetch
-        # values because they *need* them on host)
+        # values because they *need* them on host). The plan layer is
+        # in scope since it became the shared dispatch/fetch boundary
+        # (Executor.fetch is the one sanctioned sync — and it is not in
+        # a loop).
         parts = relpath.split("/")
         return (relpath.endswith("sim.py") or relpath.endswith("grid.py")
-                or "parallel" in parts or "benchmarks" in parts
-                or parts[-1] == "bench.py")
+                or "parallel" in parts or "plan" in parts
+                or "benchmarks" in parts or parts[-1] == "bench.py")
 
     def check(self, module: Module) -> Iterator[Violation]:
         imports = imported_names(module.tree)

@@ -326,14 +326,14 @@ def validate_geometry(geometry: str) -> None:
             f"geometry must be 'pinned' or 'auto', got {geometry!r}")
 
 
-def _precompile_bucket(cfg: SimConfig, m: int, merged: bool, k_pad,
-                       observer, parent):
-    """Phase-0 pool worker: AOT-compile one bucket's flat kernel at its
-    exact dispatch shapes (utils.compile — XLA releases the GIL, so
-    workers compile concurrently with each other and with the main
-    thread's dispatch loop). Returns the compiled executable, called
-    with the dynamic args only, or None when AOT fell back — the
-    dispatch then takes the ordinary lazily-jitted path.
+def _precompile_bucket(executor, cfg: SimConfig, m: int, merged: bool,
+                       k_pad, parent):
+    """Phase-0 pool worker: build one bucket's flat kernel as a plan
+    unit at its exact dispatch shapes (``executor.prepare`` →
+    utils.compile — XLA releases the GIL, so workers compile
+    concurrently with each other and with the main thread's dispatch
+    loop). Returns the :class:`~dpcorr.plan.Prepared`; when AOT fell
+    back, dispatching it takes the ordinary lazily-jitted path.
 
     ``parent`` pins the ``kernel.compile`` span under the caller's
     ``grid.run`` span: the pool thread's implicit span stack is empty.
@@ -346,20 +346,24 @@ def _precompile_bucket(cfg: SimConfig, m: int, merged: bool, k_pad,
     if merged:
         cfg_noeps = dataclasses.replace(cfg, rho=0.0, seed=0,
                                         eps1=1.0, eps2=1.0)
-        fn, ok = compile_mod.aot_compile(
+        return executor.prepare(
+            ("grid.flat_eps", cfg_noeps, m, k_pad),
             sim_mod._run_detail_flat_eps,
             (cfg_noeps, keys_aval, f32, f32, f32, k_pad),
+            fallback=lambda keys, rhos, e1, e2: sim_mod._run_detail_flat_eps(
+                cfg_noeps, keys, rhos, e1, e2, k_pad),
             signature={"kernel": "_run_detail_flat_eps", "n": cfg.n,
                        "m": m, "k_pad": k_pad},
-            observer=observer, parent=parent)
-    else:
-        cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
-        fn, ok = compile_mod.aot_compile(
-            sim_mod._run_detail_flat, (cfg_norho, keys_aval, f32),
-            signature={"kernel": "_run_detail_flat", "n": cfg.n,
-                       "eps1": cfg.eps1, "eps2": cfg.eps2, "m": m},
-            observer=observer, parent=parent)
-    return fn if ok else None
+            parent=parent)
+    cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
+    return executor.prepare(
+        ("grid.flat", cfg_norho, m),
+        sim_mod._run_detail_flat, (cfg_norho, keys_aval, f32),
+        fallback=lambda keys, rhos: sim_mod._run_detail_flat(
+            cfg_norho, keys, rhos),
+        signature={"kernel": "_run_detail_flat", "n": cfg.n,
+                   "eps1": cfg.eps1, "eps2": cfg.eps2, "m": m},
+        parent=parent)
 
 
 def _raise_if_failed(failures, n_points: int):
@@ -385,8 +389,17 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
 
     import jax.numpy as jnp
 
+    from dpcorr import plan as plan_mod
+
     details, timings, failures = {}, [], []
     tr = obs_trace.tracer()
+
+    # one plan executor for the whole grid: the sharded backend runs on
+    # a mesh placement (parallel.mesh), everything else on the local
+    # single-device placement — bit-identical to the pre-plan dispatch
+    ex = plan_mod.Executor(
+        placement="mesh" if gcfg.backend == "bucketed-sharded" else "local",
+        mesh=mesh)
 
     merged = gcfg.bucket_merge == "eps"
 
@@ -404,57 +417,43 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
         return k_pad_for(n, [float(r.eps1) * float(r.eps2)
                              for r in bucket_rows])
 
-    def xla_dispatch(cfg, to_run, k_pad=None, compiled=None):
+    def xla_dispatch(cfg, to_run, k_pad=None, prepared=None):
         """The XLA bucket dispatch — single source for phase 1 and the
         fetch-time fused fallback, so both stay bit-identical to
         fused="off" by construction. In ε-merged mode ε rides as a
         per-element traced operand next to ρ (one compiled kernel per
-        n; GridConfig.bucket_merge). ``compiled`` is the phase-0 AOT
-        executable for this bucket, if any — same HLO as the jit path,
-        dynamic args only; a shape drift (TypeError) degrades to the
-        lazy jit call it would have made anyway."""
+        n; GridConfig.bucket_merge). ``prepared`` is the phase-0 plan
+        unit for this bucket, if any — same HLO as the jit path; a
+        shape drift degrades inside the unit to the lazy jit call it
+        would have made anyway. Without one, a lazy unit wraps the jit
+        call so every dispatch flows through the executor."""
         keys = jnp.concatenate([
             rng.rep_keys(rng.design_key(master, int(r.i)), gcfg.b)
             for r in to_run])
         rhos = jnp.repeat(jnp.asarray([r.rho for r in to_run], jnp.float32),
                           gcfg.b)
-        if gcfg.backend != "bucketed-sharded":
-            # pre-shard the flat operands onto the kernel's (single)
-            # device before dispatch, counting placements into the
-            # transfer registry — the sharded backend does its own
-            # mesh-aware preshard inside run_detail_flat_sharded
-            from dpcorr.parallel.backend import _preshard
-
-            keys, rhos = _preshard((keys, rhos),
-                                   compile_mod.host_sharding())
         if merged:
             eps1s = jnp.repeat(jnp.asarray([r.eps1 for r in to_run],
                                            jnp.float32), gcfg.b)
             eps2s = jnp.repeat(jnp.asarray([r.eps2 for r in to_run],
                                            jnp.float32), gcfg.b)
-            if compiled is not None:
-                try:
-                    return compiled(keys, rhos, eps1s, eps2s)
-                except Exception as e:
-                    log.warning("precompiled merged kernel (n=%d) rejected"
-                                " args: %s -- jit path", cfg.n, e)
             cfg_noeps = dataclasses.replace(cfg, rho=0.0, seed=0,
                                             eps1=1.0, eps2=1.0)
-            return sim_mod._run_detail_flat_eps(cfg_noeps, keys, rhos,
-                                                eps1s, eps2s, k_pad)
+            unit = prepared if prepared is not None else ex.lazy_unit(
+                lambda k, r, e1, e2: sim_mod._run_detail_flat_eps(
+                    cfg_noeps, k, r, e1, e2, k_pad))
+            return ex.dispatch(unit, (keys, rhos, eps1s, eps2s))
         cfg_norho = dataclasses.replace(cfg, rho=0.0, seed=0)
         if gcfg.backend == "bucketed-sharded":
+            # the sharded twin pads to a mesh multiple before its own
+            # mesh-aware preshard, so it keeps owning both steps
             from dpcorr.parallel import run_detail_flat_sharded
 
-            return run_detail_flat_sharded(cfg_norho, keys, rhos, mesh=mesh)
-        if compiled is not None:
-            try:
-                return compiled(keys, rhos)
-            except Exception as e:
-                log.warning("precompiled kernel (n=%d eps=(%.2f,%.2f)) "
-                            "rejected args: %s -- jit path",
-                            cfg.n, cfg.eps1, cfg.eps2, e)
-        return sim_mod._run_detail_flat(cfg_norho, keys, rhos)
+            return run_detail_flat_sharded(cfg_norho, keys, rhos,
+                                           mesh=ex.placement.mesh)
+        unit = prepared if prepared is not None else ex.lazy_unit(
+            lambda k, r: sim_mod._run_detail_flat(cfg_norho, k, r))
+        return ex.dispatch(unit, (keys, rhos))
 
     # Phase 0 — scan every bucket's resume cache up front and, when
     # precompiling (GridConfig.precompile), submit each to-run bucket's
@@ -470,7 +469,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                     and (gcfg.precompile == "on"
                          or (gcfg.precompile == "auto"
                              and (os.cpu_count() or 1) >= 2)))
-    pool, pre_obs = None, None
+    pool = None
     parent_sp = obs_trace.current_span()
     t_scan0 = time.perf_counter()
     buckets = []
@@ -538,10 +537,11 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                 pool = ThreadPoolExecutor(
                     max_workers=min(8, max(2, os.cpu_count() or 1)),
                     thread_name_prefix="dpcorr-grid-compile")
-                pre_obs = compile_mod.CompileObserver(tracer=tr)
-            fut = pool.submit(_precompile_bucket, cfg,
+                if ex.observer is None:
+                    ex.observer = compile_mod.CompileObserver(tracer=tr)
+            fut = pool.submit(_precompile_bucket, ex, cfg,
                               len(to_run) * gcfg.b, merged,
-                              bucket_k_pad, pre_obs, parent_sp)
+                              bucket_k_pad, parent_sp)
         buckets.append((rows, to_run, stamps, paths, fused, cfg,
                         mk_stamps, scan_cache, bucket_k_pad, fut,
                         time.perf_counter() - t0))
@@ -599,17 +599,17 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                         stamps = mk_stamps("")
                         to_run = scan_cache(to_run, stamps)
                 if to_run and raw is None:
-                    compiled = None
+                    prepared = None
                     if fut is not None:
                         try:
-                            compiled = fut.result()
+                            prepared = fut.result()
                         except Exception as e:
                             # precompile is an optimization, never a gate:
                             # a worker crash degrades to the inline jit
                             log.warning("bucket precompile (n=%d) failed:"
                                         " %s -- inline jit", cfg.n, e)
                     raw = xla_dispatch(cfg, to_run, k_pad=bucket_k_pad,
-                                       compiled=compiled)
+                                       prepared=prepared)
             except Exception as e:
                 log.error("bucket (n=%d eps=(%.2f,%.2f), %d points) "
                           "failed at dispatch: %s",
@@ -650,8 +650,11 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
         try:
             if to_run:
                 try:
+                    # the plan's one sanctioned host sync (counted into
+                    # obs.transfer fetches), then the numpy views
+                    raw = ex.fetch(raw)
                     raw = [np.asarray(a)  # dpcorr-lint: ignore[sync-in-loop]
-                           for a in raw]  # completion barrier
+                           for a in raw]
                 except Exception as e:
                     if not fused:
                         raise
@@ -682,7 +685,7 @@ def _run_grid_bucketed(gcfg: GridConfig, design: pd.DataFrame, master,
                     # the degraded bucket's own fetch boundary
                     # dpcorr-lint: ignore[sync-in-loop]
                     raw = ([np.asarray(a)
-                            for a in xla_dispatch(cfg, to_run)]
+                            for a in ex.fetch(xla_dispatch(cfg, to_run))]
                            if to_run else None)
                 for j, r in enumerate(to_run):
                     i = int(r.i)

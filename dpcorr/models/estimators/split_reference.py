@@ -355,6 +355,25 @@ def _finish_batch_jit(family: str, eps1: float, eps2: float, alpha: float,
         lambda args: single(*args), (keys, rels, cols)))
 
 
+_PLAN: "object | None" = None
+
+
+def _plan_executor():
+    """Module-level plan executor for federation finishes — the third
+    dispatch site ported onto the shared plan layer (dpcorr.plan).
+    Local placement: a federation round is host-side RPC aggregation
+    dispatching one batched kernel. Units are AOT-compiled at the exact
+    stacked round shapes and cached per signature, which closes the old
+    lazy-jit hole where every first round of a new (B, n) shape paid
+    its compile on the session's critical path."""
+    global _PLAN
+    if _PLAN is None:
+        from dpcorr import plan as plan_mod
+
+        _PLAN = plan_mod.Executor("local")
+    return _PLAN
+
+
 def finish_batch(family: str, keys, peer_releases, cols,
                  eps1: float, eps2: float, alpha: float = 0.05,
                  normalise: bool = True, engine: str = "exact",
@@ -384,8 +403,24 @@ def finish_batch(family: str, keys, peer_releases, cols,
             f"releases, {len(cols)} columns")
     fn = _finish_batch_jit(family, float(eps1), float(eps2), float(alpha),
                            bool(normalise), engine)
-    return fn(jnp.stack(list(keys)), jnp.stack(rels),
-              jnp.stack([jnp.asarray(c, jnp.float32) for c in cols]))
+    keys_arr = jnp.stack(list(keys))
+    rels_arr = jnp.stack(rels)
+    cols_arr = jnp.stack([jnp.asarray(c, jnp.float32) for c in cols])
+    ex = _plan_executor()
+    unit = ex.prepare(
+        ("finish_batch", family, float(eps1), float(eps2), float(alpha),
+         bool(normalise), engine,
+         tuple((a.shape, str(a.dtype))
+               for a in (keys_arr, rels_arr, cols_arr))),
+        fn,
+        tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+              for a in (keys_arr, rels_arr, cols_arr)),
+        signature={"kernel": "finish_batch", "family": family,
+                   "engine": engine, "b": int(keys_arr.shape[0]),
+                   "n": int(cols_arr.shape[-1])})
+    # dispatch stays asynchronous — the protocol runtime fetches when
+    # it serializes the round's results
+    return ex.dispatch(unit, (keys_arr, rels_arr, cols_arr))
 
 
 def split_estimate(family: str, key_x: jax.Array, key_y: jax.Array,

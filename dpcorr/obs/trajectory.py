@@ -115,21 +115,36 @@ def derive_device_kind(detail: Dict[str, Any], top: Dict[str, Any]) -> str:
     Old artifacts only carry a device *string* like ``"TFRT_CPU_0"`` or
     ``"TPU v5 lite0"`` — derive the kind from it so old and new rounds
     land in the same series.
+
+    A multi-device measurement (``detail.device_count`` > 1, stamped by
+    bench/mesh-scaling since ISSUE 19) gets a ``x<count>`` suffix —
+    ``cpux4`` — so an N-way sharded series is never folded into (or
+    regression-walked against) the 1-device series of the same chip.
+    Absent or 1 keeps the bare kind: every historical series label is
+    unchanged.
     """
+    kind = ""
     for src in (detail, top):
         dk = src.get("device_kind")
         if isinstance(dk, str) and dk:
-            return dk
-    dev = detail.get("device") or top.get("device") or ""
-    if isinstance(dev, str) and dev:
-        low = dev.lower()
-        if "tpu" in low:
-            return "tpu"
-        if "gpu" in low or "cuda" in low or "rocm" in low:
-            return "gpu"
-        if "cpu" in low:
-            return "cpu"
-    return "unknown"
+            kind = dk
+            break
+    if not kind:
+        dev = detail.get("device") or top.get("device") or ""
+        if isinstance(dev, str) and dev:
+            low = dev.lower()
+            if "tpu" in low:
+                kind = "tpu"
+            elif "gpu" in low or "cuda" in low or "rocm" in low:
+                kind = "gpu"
+            elif "cpu" in low:
+                kind = "cpu"
+    if not kind:
+        return "unknown"
+    nd = detail.get("device_count")
+    if isinstance(nd, int) and not isinstance(nd, bool) and nd > 1:
+        return f"{kind}x{nd}"
+    return kind
 
 
 def _point_from_payload(

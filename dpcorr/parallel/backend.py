@@ -34,32 +34,13 @@ def _padded_b(b: int, n_shards: int) -> int:
 
 
 def _preshard(arrays, sharding, counters=None):
-    """Place inputs on their kernel's declared sharding *before* dispatch,
-    so jit never inserts an implicit resharding copy (free on one CPU
-    device; through a TPU tunnel it is the silent per-dispatch tax the
-    explicit shardings exist to remove). Placements and any
-    committed-but-mismatched inputs are counted into the transfer
-    registry (``obs.transfer``) so the bench/roofline artifacts can
-    attribute them."""
-    from dpcorr.obs import transfer as transfer_mod
+    """Pre-dispatch placement onto the kernel's declared sharding.
+    Canonical implementation moved to the plan layer
+    (``dpcorr.plan.placement.preshard``); this alias keeps the
+    historical call sites and import path working."""
+    from dpcorr.plan.placement import preshard
 
-    tc = counters if counters is not None else transfer_mod.default_counters()
-    out = []
-    for a in arrays:
-        sh = getattr(a, "sharding", None)
-        if sh is not None and sh.is_equivalent_to(sharding, a.ndim):
-            out.append(a)
-            continue
-        if sh is not None and getattr(a, "_committed", False):
-            tc.reshard_mismatch.inc()
-        a = jax.device_put(a, sharding)
-        tc.device_puts.inc()
-        try:
-            tc.device_put_bytes.inc(float(a.nbytes))
-        except Exception:  # typed-key avals may not report nbytes
-            pass
-        out.append(a)
-    return tuple(out)
+    return preshard(arrays, sharding, counters)
 
 
 @lru_cache(maxsize=128)

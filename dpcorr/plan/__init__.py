@@ -1,0 +1,40 @@
+"""Plan/executor layer: bucket → compile → dispatch → fetch, once.
+
+Three subsystems used to reimplement the same four-phase dispatch shape
+privately — the grid's bucketed phases (``dpcorr.grid``), the serving
+kernel cache + coalescer (``dpcorr.serve.kernels``), and federation's
+``finish_batch`` (``dpcorr.models.estimators.split_reference``). This
+package owns that shape in one place, with *placement* pluggable:
+
+- ``local``   — today's single-device behavior, bit-identical;
+- ``mesh``    — shard_map/NamedSharding over ``parallel.mesh`` with
+  matching in/out shardings so no stage reshards;
+- ``multihost`` — a named seam (clear NotImplementedError pointing at
+  ``parallel.multihost.init_distributed``), not an implementation.
+
+``utils.compile`` stays the only legal ``jit(...).lower(...).compile()``
+site (lint rule ``aot-outside-compile-layer``); the executor routes all
+AOT builds through it and counts the single sanctioned host fetch per
+plan into ``obs.transfer``.
+"""
+
+from dpcorr.plan.executor import Executor, Prepared
+from dpcorr.plan.placement import (
+    LocalPlacement,
+    MeshPlacement,
+    MultihostPlacement,
+    Placement,
+    preshard,
+    resolve_placement,
+)
+
+__all__ = [
+    "Executor",
+    "LocalPlacement",
+    "MeshPlacement",
+    "MultihostPlacement",
+    "Placement",
+    "Prepared",
+    "preshard",
+    "resolve_placement",
+]

@@ -1,0 +1,163 @@
+"""The executor: compile, dispatch and fetch for one placement.
+
+One :class:`Executor` owns the mechanics every dispatch site used to
+hand-roll:
+
+- **compile** — :meth:`Executor.prepare` builds a :class:`Prepared`
+  unit through ``utils.compile.aot_compile`` (the only legal
+  ``jit(...).lower(...).compile()`` site — lint rule
+  ``aot-outside-compile-layer``), de-duplicated per key by a
+  :class:`~dpcorr.utils.compile.SingleFlight` so concurrent callers of
+  the same signature share one build.
+- **dispatch** — operands are placed on the placement's declared
+  sharding *before* the call (:meth:`Executor.preshard`), so jit never
+  inserts an implicit resharding copy; the call itself stays
+  asynchronous.
+- **fetch** — :meth:`Executor.fetch` is the single sanctioned host
+  sync per plan, counted into ``obs.transfer`` fetches so a rising
+  fetches:dispatches ratio is visible in every artifact.
+
+A :class:`Prepared` keeps the lazily-jitted program as its fallback:
+the AOT executable is strict about shapes, and an off-signature
+dispatch (e.g. a partial-resume bucket with fewer points) degrades to
+the jit call it would have made anyway — never an error.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from dpcorr.plan.placement import Placement, resolve_placement
+from dpcorr.utils import compile as compile_mod
+
+log = logging.getLogger("dpcorr.plan")
+
+
+class Prepared:
+    """One compiled plan unit. Call it with the *dynamic* arguments
+    only: the AOT executable is tried first (when lowering succeeded),
+    and any rejection falls back to ``fallback`` — the consumer's
+    lazily-jitted call with its static arguments re-bound."""
+
+    __slots__ = ("key", "fn", "fallback", "aot_ok", "signature")
+
+    def __init__(self, key, fn, fallback, aot_ok, signature=None):
+        self.key = key
+        self.fn = fn
+        self.fallback = fallback
+        self.aot_ok = aot_ok
+        self.signature = dict(signature or {})
+
+    def __call__(self, *dyn):
+        if self.aot_ok:
+            try:
+                return self.fn(*dyn)
+            except Exception as e:  # off-signature shapes, mostly
+                log.warning("prepared unit %s rejected dispatch args: "
+                            "%s -- lazy jit path",
+                            self.signature or self.key, e)
+        return self.fallback(*dyn)
+
+
+class Executor:
+    """Compile/dispatch/fetch for one placement.
+
+    ``placement`` is a name (``"local"``/``"mesh"``/``"multihost"``) or
+    a :class:`~dpcorr.plan.placement.Placement`; ``mesh``/``device``
+    feed its resolution. ``observer`` is the
+    :class:`~dpcorr.utils.compile.CompileObserver` all of this
+    executor's compiles report through (serve passes its per-server
+    registry); ``counters`` the ``obs.transfer`` bundle fetches and
+    preshards are counted into (tests pass their own so concurrent
+    executors never cross-contaminate)."""
+
+    def __init__(self, placement="local", *, mesh=None, device=None,
+                 observer=None, counters=None, flight=None):
+        self.placement: Placement = resolve_placement(
+            placement, mesh=mesh, device=device)
+        self.observer = observer
+        self.flight = flight if flight is not None \
+            else compile_mod.SingleFlight()
+        self._counters = counters
+        self._units: dict = {}  # written only by flight leaders
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------- compile ----
+    def counters(self):
+        if self._counters is None:
+            from dpcorr.obs import transfer as transfer_mod
+
+            self._counters = transfer_mod.default_counters()
+        return self._counters
+
+    def _observer(self):
+        if self.observer is None:
+            self.observer = compile_mod.CompileObserver()
+        return self.observer
+
+    def prepare(self, key, jitted, lower_args, *, fallback=None,
+                signature=None, parent=None, cache=True):
+        """Build (or fetch from this executor's unit cache) the
+        :class:`Prepared` for ``jitted`` lowered at ``lower_args`` (full
+        argument list, statics concrete, dynamics as avals —
+        ``aot_compile``'s contract). ``fallback`` is the dynamic-args
+        call used when AOT fails or rejects a shape; it defaults to
+        ``jitted`` itself, which is only correct when the program takes
+        no static arguments."""
+        if cache:
+            with self._lock:
+                unit = self._units.get(key)
+            if unit is not None:
+                return unit
+
+        def _build():
+            fn, ok = compile_mod.aot_compile(
+                jitted, lower_args, signature=signature,
+                observer=self._observer(), parent=parent)
+            fb = fallback if fallback is not None else jitted
+            unit = Prepared(key, fn, fb, ok, signature=signature)
+            if cache:
+                with self._lock:
+                    self._units[key] = unit
+            return unit
+
+        unit, _leader = self.flight.do(("plan.prepare", key), _build)
+        return unit
+
+    def lazy_unit(self, fallback, *, key=None, signature=None) -> Prepared:
+        """A :class:`Prepared` that never AOT-compiled: dispatching it
+        is the plain lazy-jit call. Used by consumers whose precompile
+        knob is off (or whose fused path just degraded) so every
+        dispatch still flows through one unit type."""
+        return Prepared(key, None, fallback, False, signature=signature)
+
+    def evict(self, key) -> None:
+        """Drop a cached unit and tell the observer, so the next compile
+        for the signature is attributed to eviction, not novelty."""
+        with self._lock:
+            unit = self._units.pop(key, None)
+        if unit is not None:
+            self._observer().note_evicted(
+                compile_mod.signature_key(unit.signature))
+
+    # ------------------------------------------------------ dispatch ----
+    def preshard(self, arrays):
+        """Batch-axis operands onto the placement's data sharding."""
+        return self.placement.preshard(arrays, self.counters())
+
+    def dispatch(self, prepared, args):
+        """Preshard ``args`` and launch; returns device futures (the
+        call stays asynchronous — pair with one :meth:`fetch`)."""
+        return prepared(*self.preshard(tuple(args)))
+
+    # --------------------------------------------------------- fetch ----
+    def fetch(self, out):
+        """The single sanctioned host sync of a plan: block until the
+        dispatched values are resolved and count one fetch into the
+        transfer registry. Returns ``out`` (device arrays, now ready)."""
+        import jax
+
+        out = jax.block_until_ready(out)
+        self.counters().fetches.inc()
+        return out

@@ -1,0 +1,196 @@
+"""Pluggable placement: where a plan's units run and how operands land.
+
+A :class:`Placement` answers the three questions every dispatch site
+used to answer privately: which sharding operands and results are
+pinned to (so jit never inserts an implicit resharding copy), how the
+batch axis pads (mesh placements need a devices-multiple), and which
+mesh (if any) kernels are built over. The executor composes these; the
+consumers (grid, serve, federation, ``sim.RepBlockPipeline``) just name
+one.
+"""
+
+from __future__ import annotations
+
+
+def preshard(arrays, sharding, counters=None):
+    """Place inputs on their kernel's declared sharding *before*
+    dispatch, so jit never inserts an implicit resharding copy (free on
+    one CPU device; through a TPU tunnel it is the silent per-dispatch
+    tax the explicit shardings exist to remove). Placements and any
+    committed-but-mismatched inputs are counted into the transfer
+    registry (``obs.transfer``) so the bench/roofline artifacts can
+    attribute them.
+
+    Canonical home of the helper formerly known as
+    ``parallel.backend._preshard`` (which now delegates here)."""
+    import jax
+
+    from dpcorr.obs import transfer as transfer_mod
+
+    tc = counters if counters is not None else transfer_mod.default_counters()
+    out = []
+    for a in arrays:
+        sh = getattr(a, "sharding", None)
+        if sh is not None and sh.is_equivalent_to(sharding, a.ndim):
+            out.append(a)
+            continue
+        if sh is not None and getattr(a, "_committed", False):
+            tc.reshard_mismatch.inc()
+        a = jax.device_put(a, sharding)
+        tc.device_puts.inc()
+        try:
+            tc.device_put_bytes.inc(float(a.nbytes))
+        except Exception:  # typed-key avals may not report nbytes
+            pass
+        out.append(a)
+    return tuple(out)
+
+
+class Placement:
+    """Interface: one answer to "where does this plan run"."""
+
+    name = "?"
+
+    def data_sharding(self):
+        """Sharding for batch-axis operands and per-element results."""
+        raise NotImplementedError
+
+    def replicated_sharding(self):
+        """Sharding for scalars / whole-array operands."""
+        raise NotImplementedError
+
+    @property
+    def mesh(self):
+        return None
+
+    @property
+    def device_count(self) -> int:
+        return 1
+
+    def mesh_shape(self):
+        """``{axis: size}`` for mesh placements, None otherwise — the
+        shape bench stamps into artifact detail and the geometry
+        autotuner folds into its cache key."""
+        return None
+
+    def pad(self, n: int) -> int:
+        """Smallest dispatchable batch size >= n for this placement."""
+        return int(n)
+
+    def preshard(self, arrays, counters=None):
+        return preshard(arrays, self.data_sharding(), counters)
+
+    def describe(self) -> dict:
+        return {
+            "placement": self.name,
+            "device_count": self.device_count,
+            "mesh_shape": self.mesh_shape(),
+        }
+
+
+class LocalPlacement(Placement):
+    """Today's single-device behavior, bit-identical: everything pinned
+    to one explicit device sharding (``utils.compile.host_sharding``),
+    no padding, no mesh."""
+
+    name = "local"
+
+    def __init__(self, device=None):
+        self._device = device
+
+    def data_sharding(self):
+        from dpcorr.utils.compile import host_sharding
+
+        return host_sharding(self._device)
+
+    def replicated_sharding(self):
+        return self.data_sharding()
+
+
+class MeshPlacement(Placement):
+    """shard_map/NamedSharding placement over the 1-axis ``rep`` mesh
+    (``parallel.mesh.rep_mesh``). Batch axes arrive pre-sharded
+    ``P("rep")`` and results leave sharded the same way, so chained
+    stages never reshard (SNIPPETS pjit/pre-sharded-input shape)."""
+
+    name = "mesh"
+
+    def __init__(self, mesh=None, n_devices=None):
+        if mesh is None:
+            from dpcorr.parallel.mesh import rep_mesh
+
+            mesh = rep_mesh(n_devices)
+        self._mesh = mesh
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def device_count(self) -> int:
+        return int(self._mesh.devices.size)
+
+    def mesh_shape(self):
+        return {str(name): int(size) for name, size
+                in zip(self._mesh.axis_names, self._mesh.devices.shape)}
+
+    def data_sharding(self):
+        from dpcorr.utils.compile import mesh_shardings
+
+        return mesh_shardings(self._mesh)[0]
+
+    def replicated_sharding(self):
+        from dpcorr.utils.compile import mesh_shardings
+
+        return mesh_shardings(self._mesh)[1]
+
+    def pad(self, n: int) -> int:
+        d = self.device_count
+        return -(-int(n) // d) * d
+
+
+class MultihostPlacement(Placement):
+    """The multihost/remote seam. Resolvable by name so plans can state
+    the intent, but every execution surface raises with the recipe:
+    initialize the distributed runtime, then extend
+    :class:`MeshPlacement` over the global mesh."""
+
+    name = "multihost"
+
+    @property
+    def device_count(self) -> int:
+        return 0  # unknown until the distributed runtime is up
+
+    def _unavailable(self):
+        raise NotImplementedError(
+            "multihost placement is a seam, not an implementation yet: "
+            "initialize the distributed runtime first "
+            "(dpcorr.parallel.multihost.init_distributed), then build a "
+            "MeshPlacement over the global device mesh — see "
+            "docs/PERFORMANCE.md §multi-device.")
+
+    def data_sharding(self):
+        self._unavailable()
+
+    def replicated_sharding(self):
+        self._unavailable()
+
+    def pad(self, n: int) -> int:
+        self._unavailable()
+
+
+def resolve_placement(spec, *, mesh=None, device=None) -> Placement:
+    """``spec`` is a Placement (returned as-is) or one of the names
+    ``"local"`` / ``"mesh"`` / ``"multihost"`` (None means local).
+    ``mesh`` feeds a mesh placement; ``device`` pins a local one."""
+    if isinstance(spec, Placement):
+        return spec
+    if spec is None or spec == "local":
+        return LocalPlacement(device)
+    if spec == "mesh":
+        return MeshPlacement(mesh)
+    if spec == "multihost":
+        return MultihostPlacement()
+    raise ValueError(
+        f"unknown placement {spec!r}: expected 'local', 'mesh', or "
+        "'multihost' (or a Placement instance)")

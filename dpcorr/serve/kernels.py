@@ -10,18 +10,21 @@ replicate lane 0 (cheapest valid input) and are truncated before
 results leave this module, so they cost device FLOPs but never appear
 in responses.
 
-Compilation itself goes through the compile-ahead layer
-(:mod:`dpcorr.utils.compile`):
+Compilation and dispatch go through the plan/executor layer
+(:mod:`dpcorr.plan`, which owns the only ``lower().compile()`` site in
+:mod:`dpcorr.utils.compile`):
 
 - misses are **single-flight** — concurrent misses for one signature
   wait on a single inflight compile (the pre-ISSUE-4 race had both
   threads compiling and the second overwriting the first); the dedup
   is observable as ``kernel_compile_dedup`` in stats. Distinct
   signatures still compile concurrently (XLA releases the GIL).
-- kernels are **AOT-compiled** (``lower(avals).compile()``) at the
+- kernels are **AOT-compiled** plan units (``Executor.prepare``) at the
   exact signature shapes, so the cost is paid at ``get`` time — which
   warmup moves off the request path entirely (serve.server) — and
   measured into ``dpcorr_compile_seconds`` / ``kernel.compile`` spans.
+- each flush is one plan: operands pre-placed on the launch's declared
+  sharding, one dispatch, one counted host fetch (``obs.transfer``).
 - with ``export_dir`` set, unsharded compiled programs are serialized
   via ``jax.export`` (version-gated, raw-key-data boundary — see
   utils.compile) and replayed on the next boot, skipping even the
@@ -61,6 +64,7 @@ from typing import Callable
 import numpy as np
 
 from dpcorr import chaos
+from dpcorr import plan as plan_mod
 from dpcorr.models.estimators.registry import serving_entry
 from dpcorr.serve.request import KernelKey
 from dpcorr.serve.stats import ServeStats
@@ -124,9 +128,14 @@ class KernelCache:
         self.max_kernels = max_kernels
         self.aot = aot
         self.export_dir = export_dir
-        self._cobs = compile_mod.CompileObserver(
-            registry=self.stats.registry, tracer=tracer)
-        self._flight = compile_mod.SingleFlight()
+        # the cache's compile/dispatch/fetch engine: one local-placement
+        # plan executor whose observer reports into the server's registry
+        self._plan = plan_mod.Executor(
+            "local", observer=compile_mod.CompileObserver(
+                registry=self.stats.registry, tracer=tracer))
+        self._cobs = self._plan.observer
+        self._flight = self._plan.flight
+        self._mesh_placement: plan_mod.MeshPlacement | None = None
         self._compile_hook: Callable | None = None  # test seam
         self._lock = threading.Lock()
         self._fns: OrderedDict[tuple, Callable] = OrderedDict()  # guarded by: _lock
@@ -207,7 +216,8 @@ class KernelCache:
             kkey.alpha, kkey.normalise, b_pad, self.mode, rng.impl_tag())
         return compile_mod.export_path(self.export_dir, digest)
 
-    def _build(self, kkey: KernelKey, b_pad: int, shards: int) -> Callable:
+    def _build(self, kkey: KernelKey, b_pad: int,
+               shards: int) -> plan_mod.Prepared:
         import jax
 
         if self._compile_hook is not None:
@@ -225,7 +235,8 @@ class KernelCache:
                 lambda keys, xs, ys: jax.lax.map(
                     lambda t: single(*t), (keys, xs, ys)))
         if not self.aot:
-            return jfn
+            # lazy plan unit: the pre-ISSUE-4 behavior for A/B runs
+            return self._plan.lazy_unit(jfn)
         avals = (rng.key_aval(b_pad),
                  jax.ShapeDtypeStruct((b_pad, kkey.n), np.float32),
                  jax.ShapeDtypeStruct((b_pad, kkey.n), np.float32))
@@ -233,6 +244,9 @@ class KernelCache:
         # export replay first: a prior boot's serialized program skips
         # tracing AND the XLA retrace of the persistent compile cache.
         # Unsharded only — exported programs pin device assignments.
+        # (The cache's LRU owns unit lifetime, so the executor's own
+        # unit cache is off; the outer single-flight in `get` already
+        # dedups concurrent builds per signature.)
         path = None
         if self.export_dir and shards == 1:
             path = self._export_file(kkey, b_pad)
@@ -240,14 +254,14 @@ class KernelCache:
             if call is not None:
                 wrapped = jax.jit(
                     lambda keys, xs, ys: call(rng.key_data(keys), xs, ys))
-                fn, ok = compile_mod.aot_compile(
-                    wrapped, avals, signature={**sig, "source": "export"},
-                    observer=self._cobs)
-                if ok:
-                    return fn
-        fn, ok = compile_mod.aot_compile(jfn, avals, signature=sig,
-                                         observer=self._cobs)
-        if ok and path is not None:
+                unit = self._plan.prepare(
+                    (kkey, b_pad, shards, "export"), wrapped, avals,
+                    signature={**sig, "source": "export"}, cache=False)
+                if unit.aot_ok:
+                    return unit
+        unit = self._plan.prepare((kkey, b_pad, shards), jfn, avals,
+                                  signature=sig, fallback=jfn, cache=False)
+        if unit.aot_ok and path is not None:
             # serialize for the NEXT boot, through the raw-key-data
             # boundary (typed key avals can't cross jax.export); best
             # effort — failure just means a cold next boot
@@ -255,7 +269,7 @@ class KernelCache:
                 lambda kd, xs, ys: jfn(rng.keys_from_data(kd), xs, ys))
             compile_mod.save_exported(
                 path, ejit, (rng.key_data_aval(b_pad), avals[1], avals[2]))
-        return fn
+        return unit
 
     # ------------------------------------------------------- warm set ----
     def manifest(self) -> list[dict]:
@@ -286,11 +300,27 @@ class KernelCache:
         chaos.fault("serve.kernel")
         b = xs.shape[0]
         b_pad = pad_batch(b)
-        fn, _ = self.get(kkey, b_pad)
+        fn, shards = self.get(kkey, b_pad)
         if b_pad != b:
             keys = jnp.concatenate([keys, jnp.repeat(keys[:1], b_pad - b,
                                                      axis=0)])
             xs = _pad_rows(xs, b_pad)
             ys = _pad_rows(ys, b_pad)
-        out = fn(keys, xs, ys)
+        # one plan per flush: pre-place operands on the launch's
+        # declared sharding, dispatch, and pay exactly one counted
+        # host sync at the truncation boundary
+        pl = self._placement_for(shards)
+        keys, xs, ys = pl.preshard((keys, xs, ys), self._plan.counters())
+        out = self._plan.fetch(fn(keys, xs, ys))
         return tuple(np.asarray(a)[:b] for a in out)
+
+    def _placement_for(self, shards: int) -> plan_mod.Placement:
+        """The sharding a launch's operands must land on: the local
+        single-device placement, or the ``rep`` mesh the sharded batch
+        kernel was built over (``parallel.make_serve_batch_sharded``
+        defaults to the full ``rep_mesh()`` — same devices)."""
+        if shards == 1:
+            return self._plan.placement
+        if self._mesh_placement is None:
+            self._mesh_placement = plan_mod.MeshPlacement()
+        return self._mesh_placement

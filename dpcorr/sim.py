@@ -377,15 +377,31 @@ class RepBlockPipeline:
     addresses and the same chunked math as the un-donated path, pinned
     by :meth:`block_detail` and tests/test_pipeline.py for all four
     estimator families.
+
+    **Mesh placement** (``placement="mesh"``, the plan layer's first
+    mesh consumer — ``dpcorr.plan``): the rep axis is sharded ``P("rep")``
+    over ``parallel.mesh.rep_mesh`` and the donated key/acc carry stays
+    *per-shard* — each device folds its next-block keys at the global
+    replication addresses (``rng.rep_keys_slice``) and keeps one
+    accumulator lane, so no cross-device communication happens until the
+    single host fetch at the reduction boundary. Per-rep outputs
+    (:meth:`block_detail`, which runs the genuinely sharded program) are
+    **bitwise identical** to the local placement for every chunk width —
+    the geometry invariance measured in r08. The reduced sums fold the
+    per-shard lanes on the host in fixed ascending shard order
+    (float64): deterministic for a given mesh size, tolerance-equal (not
+    bitwise) to the single-device sequential sum because a different
+    reduction tree rounds differently.
     """
 
     def __init__(self, rep_fn: Callable, out_len: int, *, key: jax.Array,
                  block_reps: int, chunk_size: int, family: str = "custom",
                  device=None, counters=None, aot: bool = True,
                  observer=None, impl: str | None = None,
-                 acc_dtype=jnp.float32, profiler=None):
+                 acc_dtype=jnp.float32, profiler=None,
+                 placement: str = "local", mesh=None):
+        from dpcorr import plan as plan_mod
         from dpcorr.obs import transfer as transfer_mod
-        from dpcorr.utils import compile as compile_mod
 
         #: optional obs.prof.BlockProfiler — strictly opt-in: every use
         #: sits behind ``is not None`` so the unprofiled path costs
@@ -404,8 +420,83 @@ class RepBlockPipeline:
         self._key = key
         self._counters = counters if counters is not None \
             else transfer_mod.default_counters()
-        self.sharding = compile_mod.host_sharding(device)
+        self._observer = observer
+        self.placement = plan_mod.resolve_placement(placement, mesh=mesh,
+                                                    device=device)
+        if self.placement.name not in ("local", "mesh"):
+            raise ValueError(
+                f"RepBlockPipeline supports 'local' and 'mesh' "
+                f"placements, got {self.placement.name!r}")
+        if self.placement.name == "mesh":
+            n_dev = self.placement.device_count
+            if self.block_reps % n_dev != 0:
+                raise ValueError(
+                    f"block_reps={self.block_reps} must split evenly "
+                    f"over the {n_dev}-device mesh: the donated carry "
+                    "is per-shard (equal key-buffer and accumulator "
+                    "lanes on every device)")
+            self._build_mesh_kernels()
+        else:
+            self._build_local_kernels()
+        self._blk = self._blk_jit
+        #: None until the runtime has shown its hand; then True iff no
+        #: donation-decline warning was observed
+        self.donation_engaged: bool | None = None
+        self.aot_ok: bool | None = None
+        if aot:
+            acc_avals = tuple(
+                jax.ShapeDtypeStruct(self._acc_shape, self.acc_dtype)
+                for _ in range(self.out_len))
+            # the key-data aval is derived from THIS pipeline's keygen
+            # (not the process-default impl): an "rbg" root carries 4
+            # uint32 words where threefry carries 2
+            kd_aval = jax.eval_shape(
+                lambda i: rng.key_data(rng.rep_keys(
+                    rng.design_key(self._key, i), self.block_reps)),
+                jax.ShapeDtypeStruct((), jnp.uint32))
+            with transfer_mod.donation_watch(self._counters) as w:
+                unit = self._executor().prepare(
+                    ("rep_block", self.family, self.placement.name,
+                     self.block_reps, self.chunk_size, self.out_len,
+                     id(self.rep_fn)),
+                    self._blk_jit,
+                    (kd_aval, acc_avals,
+                     jax.ShapeDtypeStruct((), jnp.uint32)),
+                    signature={"kernel": "rep_block",
+                               "family": self.family,
+                               "placement": self.placement.name,
+                               "devices": self.placement.device_count,
+                               "block_reps": self.block_reps,
+                               "chunk_size": self.chunk_size},
+                    cache=False)
+                self.aot_ok = unit.aot_ok
+                if unit.aot_ok:
+                    self._blk = unit.fn
+            if w.declined:
+                # decline warnings fire at lowering — the first-dispatch
+                # watch would never see this one
+                self.donation_engaged = False
+            elif self.aot_ok:
+                self.donation_engaged = True
+
+    def _executor(self):
+        """The plan executor this pipeline compiles and fetches
+        through (lazy: observer wiring stays per-pipeline)."""
+        from dpcorr import plan as plan_mod
+
+        if getattr(self, "_plan_ex", None) is None:
+            self._plan_ex = plan_mod.Executor(
+                self.placement, counters=self._counters,
+                observer=self._observer)
+        return self._plan_ex
+
+    def _build_local_kernels(self):
+        """Today's single-device kernels, bit-identical: one explicit
+        device sharding for every operand, scalar accumulators."""
+        self.sharding = self.placement.data_sharding()
         sh = self.sharding
+        self._acc_shape = ()
+        self._acc_sharding = sh
 
         def _body(key_data, acc, i):
             keys = rng.keys_from_data(key_data, self.impl)
@@ -422,41 +513,59 @@ class RepBlockPipeline:
 
         self._blk_jit = jax.jit(_body, donate_argnums=(0, 1),
                                 in_shardings=sh, out_shardings=sh)
-        self._blk = self._blk_jit
         self._keygen = jax.jit(
             lambda i: rng.key_data(rng.rep_keys(
                 rng.design_key(self._key, i), self.block_reps)),
             out_shardings=sh)
-        #: None until the runtime has shown its hand; then True iff no
-        #: donation-decline warning was observed
-        self.donation_engaged: bool | None = None
-        self.aot_ok: bool | None = None
-        if aot:
-            acc_avals = tuple(jax.ShapeDtypeStruct((), self.acc_dtype)
-                              for _ in range(self.out_len))
-            # the key-data aval is derived from THIS pipeline's keygen
-            # (not the process-default impl): an "rbg" root carries 4
-            # uint32 words where threefry carries 2
-            kd_aval = jax.eval_shape(
-                lambda i: rng.key_data(rng.rep_keys(
-                    rng.design_key(self._key, i), self.block_reps)),
-                jax.ShapeDtypeStruct((), jnp.uint32))
-            with transfer_mod.donation_watch(self._counters) as w:
-                self._blk, self.aot_ok = compile_mod.aot_compile(
-                    self._blk_jit,
-                    (kd_aval, acc_avals,
-                     jax.ShapeDtypeStruct((), jnp.uint32)),
-                    signature={"kernel": "rep_block",
-                               "family": self.family,
-                               "block_reps": self.block_reps,
-                               "chunk_size": self.chunk_size},
-                    observer=observer)
-            if w.declined:
-                # decline warnings fire at lowering — the first-dispatch
-                # watch would never see this one
-                self.donation_engaged = False
-            elif self.aot_ok:
-                self.donation_engaged = True
+
+    def _build_mesh_kernels(self):
+        """Mesh kernels: the same body per shard under ``shard_map``,
+        with per-shard keygen at global replication addresses and one
+        accumulator lane per device. Matching in/out shardings on every
+        carry leaf keep donation valid and stop jit from inserting a
+        resharding copy between chained blocks."""
+        from jax.sharding import PartitionSpec as P
+
+        try:  # jax >= 0.5 re-exports shard_map at top level
+            from jax import shard_map
+        except ImportError:  # jax 0.4.x: experimental, same semantics
+            from jax.experimental.shard_map import shard_map
+
+        mesh = self.placement.mesh
+        rep_sh = self.placement.data_sharding()
+        repl_sh = self.placement.replicated_sharding()
+        self.sharding = rep_sh
+        n_dev = self.placement.device_count
+        per = self.block_reps // n_dev
+        self._acc_shape = (n_dev,)
+        self._acc_sharding = rep_sh
+
+        def _shard_body(key_data, acc, i):
+            # local view: key_data (per, words), acc leaves (1,) lanes
+            keys = rng.keys_from_data(key_data, self.impl)
+            outs = chunked_vmap(self.rep_fn, keys, self.chunk_size)
+            # per-shard keygen at GLOBAL replication addresses: shard s
+            # folds exactly the (key, index) pairs rows [s·per, (s+1)·per)
+            # of the local placement's rep_keys would — per-rep
+            # bit-identity by construction, no communication
+            s = jax.lax.axis_index("rep")
+            nxt = rng.key_data(rng.rep_keys_slice(
+                rng.design_key(self._key, i + jnp.uint32(1)),
+                s * per, per))
+            return nxt, tuple(a + o.sum()
+                              for a, o in zip(acc, outs, strict=True))
+
+        body = shard_map(_shard_body, mesh=mesh,
+                         in_specs=(P("rep"), P("rep"), P()),
+                         out_specs=(P("rep"), P("rep")))
+        self._blk_jit = jax.jit(body, donate_argnums=(0, 1),
+                                in_shardings=(rep_sh, rep_sh, repl_sh),
+                                out_shardings=(rep_sh, rep_sh))
+        # initial keygen: the full key vector, landed pre-sharded
+        self._keygen = jax.jit(
+            lambda i: rng.key_data(rng.rep_keys(
+                rng.design_key(self._key, i), self.block_reps)),
+            out_shardings=rep_sh)
 
     def _call(self, key_data, acc, i):
         try:
@@ -487,7 +596,8 @@ class RepBlockPipeline:
         """Run ``n_blocks`` chained blocks; returns ``(sums, n_reps)``
         with ``sums`` the tuple of float accumulator totals. Exactly one
         host sync, at the reduction boundary."""
-        acc = tuple(jnp.zeros((), self.acc_dtype, device=self.sharding)
+        acc = tuple(jnp.zeros(self._acc_shape, self.acc_dtype,
+                              device=self._acc_sharding)
                     for _ in range(self.out_len))
         cur = self._keygen(jnp.uint32(start_block))
         prof = self.profiler
@@ -506,8 +616,21 @@ class RepBlockPipeline:
         self._counters.fetches.inc()
         if pstate is not None:
             prof.run_end(pstate)
-        return (tuple(float(a) for a in acc),
+        return (tuple(self._reduce_host(a) for a in acc),
                 int(n_blocks) * self.block_reps)
+
+    def _reduce_host(self, a) -> float:
+        """Collapse one fetched accumulator leaf to a float. Local: the
+        scalar itself. Mesh: fold the per-shard lanes in fixed ascending
+        shard order (float64 on the host) — deterministic for a given
+        mesh size; tolerance-equal, not bitwise, to the single-device
+        sequential sum (different reduction tree, different rounding)."""
+        if self._acc_shape == ():
+            return float(a)
+        total = 0.0
+        for v in a:  # ascending shard index — never a set/dict order
+            total += float(v)
+        return total
 
     def cost_summary(self) -> dict:
         """XLA cost analysis of the compiled block kernel, normalized
@@ -534,11 +657,38 @@ class RepBlockPipeline:
         """Un-reduced per-rep outputs of block ``i`` — the verification
         hook the bit-identity A/B tests compare against the plain
         (un-donated, un-presharded) path: same key addresses, same
-        chunked math, so equality is exact, not approximate."""
+        chunked math, so equality is exact, not approximate. Under mesh
+        placement this runs the *genuinely sharded* program (the same
+        ``shard_map`` body the hot loop executes), so the comparison
+        certifies the sharded math, not a single-device re-derivation."""
         keys = rng.rep_keys(rng.design_key(self._key, i), self.block_reps)
+        if self._acc_shape != ():
+            return self._sharded_detail_fn()(
+                jax.device_put(keys, self.sharding))
         fn = jax.jit(
             lambda k: chunked_vmap(self.rep_fn, k, self.chunk_size))
         return fn(keys)
+
+    def _sharded_detail_fn(self):
+        """Cached jit of the per-shard chunked map under ``shard_map`` —
+        the mesh analogue of block_detail's plain jit (typed PRNG keys
+        pass through ``P("rep")`` specs; proven in parallel.backend)."""
+        if getattr(self, "_detail_sharded", None) is None:
+            from jax.sharding import PartitionSpec as P
+
+            try:
+                from jax import shard_map
+            except ImportError:
+                from jax.experimental.shard_map import shard_map
+
+            body = shard_map(
+                lambda k: chunked_vmap(self.rep_fn, k, self.chunk_size),
+                mesh=self.placement.mesh,
+                in_specs=P("rep"), out_specs=P("rep"))
+            self._detail_sharded = jax.jit(
+                body, in_shardings=self.sharding,
+                out_shardings=self.sharding)
+        return self._detail_sharded
 
 
 def summarize(detail: Mapping[str, jax.Array], rho: float):

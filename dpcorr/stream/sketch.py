@@ -65,7 +65,7 @@ from dpcorr.utils.rng import chunk_key, stream
 __all__ = [
     "ChunkGrid", "ReleaseParams", "SketchState", "grid_for",
     "moments_for_window", "release_from_sketch", "release_window",
-    "set_compile_observer", "sketch_window", "window_key",
+    "set_compile_observer", "sketch_window", "tree_merge", "window_key",
 ]
 
 
@@ -191,6 +191,26 @@ class SketchState:
 
 def _freeze_stats(st) -> tuple:
     return tuple(tuple(float(v) for v in s) for s in st)
+
+
+def tree_merge(sketches: Sequence[SketchState]) -> SketchState:
+    """Pairwise binary tree reduction of shard sketches — the merge
+    shape a mesh of N workers produces (log₂N rounds of neighbor
+    merges) rather than the sequential left fold of
+    :func:`release_window`. Because :meth:`SketchState.merge` is a
+    disjoint dict union with no arithmetic, the result is **bitwise
+    identical** to any other merge order — this function exists so the
+    tree shape is exercised and pinned by tests, not assumed."""
+    level = list(sketches)
+    if not level:
+        raise ValueError("tree_merge needs at least one sketch")
+    while len(level) > 1:
+        nxt = [level[i].merge(level[i + 1])
+               for i in range(0, len(level) - 1, 2)]
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+    return level[0]
 
 
 def _fold(sketch: SketchState, grid: ChunkGrid) -> list[list[float]]:

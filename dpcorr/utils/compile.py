@@ -187,11 +187,13 @@ class CompileObserver:
             else obs_trace.tracer()
 
 
-def aot_compile(jitted, lower_args, *, signature=None,
+def aot_compile(jitted, lower_args, *, lower_kwargs=None, signature=None,
                 observer: CompileObserver | None = None, parent=None):
     """AOT-compile ``jitted`` at ``lower_args`` (the full argument list
     as the jitted callable takes it — static args included, as concrete
     values; dynamic args may be ``jax.ShapeDtypeStruct`` avals).
+    ``lower_kwargs`` are keyword arguments forwarded to ``lower`` for
+    programs with keyword statics (the roofline cost probe).
 
     Returns ``(fn, aot_ok)``. On success ``fn`` is the compiled
     executable, called with the *dynamic* args only and strict about
@@ -212,7 +214,8 @@ def aot_compile(jitted, lower_args, *, signature=None,
         with obs.tracer().span("kernel.compile", parent=parent,
                                **attrs) as sp:
             try:
-                fn = jitted.lower(*lower_args).compile()
+                fn = jitted.lower(*lower_args,
+                                  **(lower_kwargs or {})).compile()
                 ok = True
             except Exception as e:
                 log.warning("AOT compile failed for %s: %s -- falling "

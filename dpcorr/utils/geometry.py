@@ -109,8 +109,19 @@ def cache_path() -> str | None:
                         "geometry.json")
 
 
-def _cache_key(device_kind: str, family: str, n: int, dtype: str) -> str:
-    return f"{device_kind}|{family}|n={int(n)}|{dtype}"
+def _cache_key(device_kind: str, family: str, n: int, dtype: str,
+               device_count: int = 1, mesh_shape=None) -> str:
+    """Cache key; multi-device runs get a fifth ``dev=`` axis (count
+    plus mesh shape) so a shape tuned for a 4-way sharded pipeline is
+    never served to the 1-device path or vice versa. Single-device keys
+    keep the historical 4-part form — old caches stay valid."""
+    key = f"{device_kind}|{family}|n={int(n)}|{dtype}"
+    if int(device_count or 1) > 1:
+        key += f"|dev={int(device_count)}"
+        if mesh_shape:
+            key += "".join(f":{a}={int(s)}"
+                           for a, s in sorted(dict(mesh_shape).items()))
+    return key
 
 
 def _load(path: str) -> dict:
@@ -145,9 +156,14 @@ def entries(state: dict, *, now: float | None = None) -> list[dict]:
         val = state[key]
         row: dict = {"key": key}
         parts = key.split("|")
-        if len(parts) == 4 and parts[2].startswith("n="):
+        if len(parts) in (4, 5) and parts[2].startswith("n="):
             row.update(device_kind=parts[0], family=parts[1],
                        n=parts[2][2:], dtype=parts[3])
+            if len(parts) == 5:
+                if parts[4].startswith("dev="):
+                    row["devices"] = parts[4][4:]
+                else:
+                    row["note"] = "unrecognized key shape"
         else:
             row["note"] = "unrecognized key shape"
         if isinstance(val, dict):
@@ -215,8 +231,8 @@ def resolve_pinned(geo: Geometry, device_kind: str) -> Geometry:
 
 
 def lookup(family: str, n: int, *, device_kind: str = "cpu",
-           dtype: str = "f32", eps_pairs=None,
-           env_pin: bool = True) -> Geometry | None:
+           dtype: str = "f32", eps_pairs=None, env_pin: bool = True,
+           device_count: int = 1, mesh_shape=None) -> Geometry | None:
     """Read-only geometry resolution (no probing): env pin → in-process
     memo → persistent cache. The grid's ``geometry="auto"`` path —
     probing inside a resumable grid would burn replications and jitter
@@ -230,7 +246,9 @@ def lookup(family: str, n: int, *, device_kind: str = "cpu",
     pinned = _pinned() if env_pin else None
     if pinned is not None:
         return resolve_pinned(pinned, device_kind)
-    key = _cache_key(device_kind, family, n, dtype_tag(dtype, eps_pairs, n))
+    key = _cache_key(device_kind, family, n,
+                     dtype_tag(dtype, eps_pairs, n),
+                     device_count, mesh_shape)
     geo = _MEMO.get(key)
     if geo is not None:
         return geo
@@ -251,7 +269,8 @@ def autotune(family: str, n: int, make_runner, *,
              device_kind: str = "cpu", dtype: str = "f32",
              eps_pairs=None, ladder=None, probe_reps: int | None = None,
              clock=time.perf_counter, use_cache: bool = True,
-             force: bool = False, env_pin: bool = True) -> Geometry:
+             force: bool = False, env_pin: bool = True,
+             device_count: int = 1, mesh_shape=None) -> Geometry:
     """Choose (chunk_size, block_reps) for one replication workload.
 
     ``make_runner(chunk, block)`` must return a zero-arg callable that
@@ -272,7 +291,8 @@ def autotune(family: str, n: int, make_runner, *,
         return resolve_pinned(pinned, device_kind)
 
     tag = dtype_tag(dtype, eps_pairs, n)
-    key = _cache_key(device_kind, family, n, tag)
+    key = _cache_key(device_kind, family, n, tag, device_count,
+                     mesh_shape)
     if not force:
         geo = _MEMO.get(key)
         if geo is not None:

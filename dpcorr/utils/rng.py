@@ -78,6 +78,21 @@ def rep_keys(key: jax.Array, n_reps: int) -> jax.Array:
     return jax.vmap(lambda b: jax.random.fold_in(key, b))(jnp.arange(n_reps))
 
 
+def rep_keys_slice(key: jax.Array, start, n_reps: int) -> jax.Array:
+    """Contiguous slice ``[start, start + n_reps)`` of the
+    :func:`rep_keys` stream, without materializing the full vector.
+
+    This is the per-shard keygen of the mesh rep pipeline
+    (``dpcorr.plan`` / ``sim.RepBlockPipeline``): shard *s* derives its
+    block-local keys at the **global** replication addresses
+    ``start = s * reps_per_shard``, so sharded and single-device runs
+    fold identical ``(key, index)`` pairs and every per-rep output stays
+    bit-identical. ``start`` may be traced (e.g. built from
+    ``lax.axis_index`` inside ``shard_map``)."""
+    return jax.vmap(lambda b: jax.random.fold_in(key, b))(
+        start + jnp.arange(n_reps))
+
+
 def pallas_seeds(key: jax.Array, n_reps: int) -> jax.Array:
     """Per-replication (n_reps, 2) int32 seed words for the on-chip
     (Pallas) hardware PRNG, derived from the key-tree so fused-kernel runs

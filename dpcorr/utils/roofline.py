@@ -76,9 +76,19 @@ def xla_cost(jitted_fn, *args, **static) -> dict:
     (key spellings vary across versions: ``flops``, ``bytes accessed``).
     Returns ``{"flops": float, "bytes": float}``; zero values mean the
     entry is absent on this backend (e.g. an opaque custom call — Pallas
-    kernels are invisible to this analysis; use the analytic model there).
+    kernels are invisible to this analysis; use the analytic model there)
+    or that AOT lowering failed. Compilation goes through the one
+    compile path (``utils.compile.aot_compile`` — on success the
+    returned executable *is* the ``Compiled``), so even the cost probe
+    never grows a private ``.lower().compile()`` site.
     """
-    compiled = jitted_fn.lower(*args, **static).compile()
+    from dpcorr.utils import compile as compile_mod
+
+    compiled, ok = compile_mod.aot_compile(
+        jitted_fn, args, lower_kwargs=static,
+        signature={"kernel": "roofline.xla_cost"})
+    if not ok:
+        return {"flops": 0.0, "bytes": 0.0}
     ca = compiled.cost_analysis()
     if isinstance(ca, (list, tuple)):  # older jax: one dict per program
         ca = ca[0] if ca else {}

@@ -1,0 +1,15 @@
+"""compilepath bad fixture: private AOT builds outside utils/compile."""
+import jax
+
+
+def private_aot(fn, avals):
+    jitted = jax.jit(fn)
+    return jitted.lower(*avals).compile()  # aot-outside-compile-layer
+
+
+def chained_inline(fn, x):
+    return jax.jit(fn).lower(x).compile()  # aot-outside-compile-layer
+
+
+def with_options(fn, x, opts):
+    return fn.lower(x).compile(compiler_options=opts)  # aot-outside-compile-layer

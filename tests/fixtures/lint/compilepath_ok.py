@@ -1,0 +1,21 @@
+"""compilepath ok fixture: the legal ways to get an executable, plus
+the look-alikes that must never fire."""
+
+
+def through_the_layer(jitted, avals):
+    from dpcorr.utils import compile as compile_mod
+
+    fn, ok = compile_mod.aot_compile(jitted, avals)
+    return fn if ok else jitted
+
+
+def str_lower_is_not_aot(name: str):
+    # str.lower() with no .compile() on the result's *call* — clean
+    return name.lower()
+
+
+def regex_compile_is_not_aot(pattern: str):
+    import re
+
+    # a bare .compile(...) whose receiver is not a .lower(...) call
+    return re.compile(pattern.lower())

@@ -1,0 +1,7 @@
+"""compilepath suppression fixture: a deliberate out-of-layer build
+(e.g. a one-off debugging probe) carries the ignore tag."""
+import jax
+
+
+def debug_probe(fn, x):
+    return jax.jit(fn).lower(x).compile()  # dpcorr-lint: ignore[aot-outside-compile-layer]

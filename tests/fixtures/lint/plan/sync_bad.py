@@ -1,0 +1,16 @@
+"""sync-rule bad fixture under the plan layer: an executor helper that
+syncs per dispatched unit instead of once at the fetch boundary."""
+import jax
+import numpy as np
+
+
+def fetch_each(units, args):
+    out = []
+    for u in units:
+        r = u(*args)
+        out.append(jax.block_until_ready(r))  # sync-in-loop
+    return out
+
+
+def gather_host(outs):
+    return [np.asarray(o) for o in outs]  # sync-in-loop

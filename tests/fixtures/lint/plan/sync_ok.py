@@ -1,0 +1,9 @@
+"""sync-rule ok fixture under the plan layer: dispatch loops stay
+asynchronous; the single fetch sits outside any loop (the
+Executor.fetch shape)."""
+import jax
+
+
+def dispatch_all(units, args):
+    outs = [u(*args) for u in units]     # async — no sync call
+    return jax.block_until_ready(outs)   # ONE fetch, not in a loop

@@ -1,0 +1,12 @@
+"""sync-rule suppression fixture under the plan layer: a deliberate
+per-unit barrier (e.g. a latency probe) carries the ignore tag."""
+import jax
+
+
+def probe_latency(units, args, clock):
+    out = []
+    for u in units:
+        r = u(*args)
+        jax.block_until_ready(r)  # dpcorr-lint: ignore[sync-in-loop]
+        out.append(clock())
+    return out

@@ -208,9 +208,57 @@ def test_sync_scope_is_path_based():
     assert not checker.applies_to("dpcorr/serve/kernels.py")
     assert not checker.applies_to("dpcorr/analysis/core.py")
     for hot in ("dpcorr/sim.py", "dpcorr/grid.py",
-                "dpcorr/parallel/backend.py", "bench.py",
+                "dpcorr/parallel/backend.py", "dpcorr/plan/executor.py",
+                "dpcorr/plan/placement.py", "bench.py",
                 "benchmarks/roofline.py"):
         assert checker.applies_to(hot), hot
+
+
+def test_sync_plan_bad_fixture_fires():
+    vs = lint_fixture("plan/sync_bad.py")
+    assert fired(vs) == [
+        ("sync-in-loop", 11),  # block_until_ready per dispatched unit
+        ("sync-in-loop", 16),  # np.asarray in a comprehension
+    ]
+
+
+def test_sync_plan_ok_fixture_is_clean():
+    assert lint_fixture("plan/sync_ok.py") == []
+
+
+def test_sync_plan_suppressed_fixture_is_clean():
+    assert lint_fixture("plan/sync_suppressed_ok.py") == []
+
+
+def test_compilepath_bad_fixture_fires_every_site():
+    vs = lint_fixture("compilepath_bad.py")
+    assert fired(vs) == [
+        ("aot-outside-compile-layer", 7),   # jitted.lower().compile()
+        ("aot-outside-compile-layer", 11),  # jit(f).lower(x).compile()
+        ("aot-outside-compile-layer", 15),  # with compiler_options
+    ]
+
+
+def test_compilepath_ok_fixture_is_clean():
+    """str.lower(), re.compile() and the sanctioned aot_compile call
+    are all look-alikes the chain match must not fire on."""
+    assert lint_fixture("compilepath_ok.py") == []
+
+
+def test_compilepath_suppressed_fixture_is_clean():
+    assert lint_fixture("compilepath_suppressed_ok.py") == []
+
+
+def test_compilepath_scope_excludes_only_the_compile_layer():
+    from dpcorr.analysis.rules.compilepath import CompilePathChecker
+
+    checker = CompilePathChecker()
+    assert not checker.applies_to("dpcorr/utils/compile.py")
+    for covered in ("dpcorr/grid.py", "dpcorr/serve/kernels.py",
+                    "dpcorr/plan/executor.py", "bench.py",
+                    "benchmarks/roofline.py",
+                    "dpcorr/utils/roofline.py"):
+        assert checker.applies_to(covered), covered
 
 
 def test_metrics_bad_fixture_fires_both_telemetry_rules():

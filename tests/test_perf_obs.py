@@ -91,6 +91,33 @@ class TestTrajectory:
             == "cpu"
         assert traj_mod.derive_device_kind({}, {}) == "unknown"
 
+    def test_multi_device_count_suffixes_the_series_label(self):
+        """device_count > 1 gets its own series label; absent/1 keeps
+        the historical bare kind (no series migration)."""
+        assert traj_mod.derive_device_kind(
+            {"device_kind": "cpu", "device_count": 4}, {}) == "cpux4"
+        assert traj_mod.derive_device_kind(
+            {"device_kind": "cpu", "device_count": 1}, {}) == "cpu"
+        assert traj_mod.derive_device_kind(
+            {"device_kind": "tpu"}, {}) == "tpu"
+
+    def test_mesh_series_never_folds_into_single_device(self, tmp_path):
+        """A 4-device sharded round must neither regress nor be walked
+        against the 1-device series of the same metric."""
+        _artifact(tmp_path / "BENCH_r01.json", 5_000.0)
+        doc = {"metric": METRIC, "value": 900.0, "unit": "reps/sec/chip",
+               "detail": {"device_kind": "cpu", "device_count": 4,
+                          "mesh": {"rep": 4}}}
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(doc))
+        rep = traj_mod.build_report([str(tmp_path)])
+        assert set(rep.series) == {("cpu", METRIC), ("cpux4", METRIC)}
+        assert rep.regressions == []
+        # gate attribution against the 1-device series ignores the
+        # mesh point entirely
+        assert traj_mod.gate_attribution(
+            [str(tmp_path)], metric=METRIC, device_kind="cpu",
+            measured_value=4_900.0) is None
+
     def test_malformed_zero_and_null_tolerance(self, tmp_path):
         (tmp_path / "BENCH_r01.json").write_text("{not json")
         _artifact(tmp_path / "BENCH_r02.json", 0.0)           # zero value
@@ -388,6 +415,22 @@ class TestGeometryCli:
                 good["dtype"]) == ("cpu", "bench-icdf", "10000", "f32")
         assert good["age_s"] > 0
         assert by_key["weird-key"]["note"] == "unrecognized key shape"
+
+    def test_cache_key_multi_device_axis(self):
+        """1-device keys keep the historical 4-part shape (old caches
+        stay valid); multi-device keys grow a dev= axis and entries()
+        parses both."""
+        assert geometry._cache_key("cpu", "f", 100, "f32") == \
+            "cpu|f|n=100|f32"
+        assert geometry._cache_key("cpu", "f", 100, "f32",
+                                   device_count=1) == "cpu|f|n=100|f32"
+        k4 = geometry._cache_key("cpu", "f", 100, "f32",
+                                 device_count=4, mesh_shape={"rep": 4})
+        assert k4 == "cpu|f|n=100|f32|dev=4:rep=4"
+        rows = geometry.entries({k4: {"chunk_size": 4, "block_reps": 64,
+                                      "reps_per_sec": 1.0}})
+        assert rows[0]["devices"] == "4:rep=4"
+        assert rows[0]["family"] == "f" and "note" not in rows[0]
 
     def test_load_strict_raises_where_load_shrugs(self, tmp_path):
         p = tmp_path / "geometry.json"

@@ -1,0 +1,246 @@
+"""The plan/executor layer (dpcorr.plan) and its first mesh consumer.
+
+Three contracts:
+
+1. **Placement/executor mechanics** — resolution, preshard counting,
+   Prepared fallback on off-signature dispatch, the multihost seam.
+2. **Mesh bit-identity** — ``sim.RepBlockPipeline`` under
+   ``placement="mesh"`` produces per-rep outputs **bitwise identical**
+   to the local placement for all four estimator families at mesh
+   sizes 2 and 4 (8 virtual devices via conftest), and its reduced
+   sums are tolerance-equal (a different reduction tree rounds
+   differently — documented, not hidden).
+3. **Single fetch** — one mesh ``run()`` increments the transfer
+   fetch counter exactly once, proven against a private counter
+   bundle; plus the sketch tree-reduce merge is bitwise equal to the
+   monolithic release.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpcorr import plan as plan_mod
+from dpcorr import sim
+from dpcorr.obs import transfer as transfer_mod
+from dpcorr.obs.metrics import Registry
+from dpcorr.parallel.mesh import rep_mesh
+from dpcorr.utils import rng
+
+BLOCK_REPS = 16
+CHUNK = 4
+
+#: the four estimator families, as (name, SimConfig) — two configs
+#: cover all four since each runs an NI and an INT estimator
+FAMILY_CFGS = {
+    "sign": sim.SimConfig(n=192, rho=0.35, eps1=1.0, eps2=1.0,
+                          use_subg=False),
+    "subg": sim.SimConfig(n=192, rho=0.35, eps1=2.0, eps2=1.5,
+                          use_subg=True),
+}
+
+
+def _rep_fn(cfg):
+    rho = jnp.float32(cfg.rho)
+
+    def rep(k):
+        row = sim._one_rep(k, rho, cfg)
+        return (row[0], row[1], row[8], row[9])  # ni_hat, int_hat, covers
+
+    return rep
+
+
+def _pipe(cfg, placement="local", mesh=None, counters=None, aot=True):
+    return sim.RepBlockPipeline(
+        _rep_fn(cfg), 4, key=rng.master_key(7), block_reps=BLOCK_REPS,
+        chunk_size=CHUNK, family="plan-test", placement=placement,
+        mesh=mesh, counters=counters, aot=aot)
+
+
+def _own_counters():
+    return transfer_mod.TransferCounters(Registry())
+
+
+# ------------------------------------------------------- placements ----
+def test_resolve_placement_names_and_passthrough():
+    lp = plan_mod.resolve_placement("local")
+    assert lp.name == "local" and lp.device_count == 1
+    assert lp.mesh_shape() is None
+    mp = plan_mod.resolve_placement("mesh", mesh=rep_mesh(2))
+    assert mp.name == "mesh" and mp.device_count == 2
+    assert mp.mesh_shape() == {"rep": 2}
+    assert plan_mod.resolve_placement(mp) is mp
+    assert plan_mod.resolve_placement(None).name == "local"
+    with pytest.raises(ValueError):
+        plan_mod.resolve_placement("quantum")
+
+
+def test_mesh_placement_pads_to_device_multiple():
+    mp = plan_mod.MeshPlacement(rep_mesh(4))
+    assert mp.pad(1) == 4 and mp.pad(4) == 4 and mp.pad(5) == 8
+    assert plan_mod.LocalPlacement().pad(5) == 5
+
+
+def test_multihost_is_a_seam_not_an_implementation():
+    mh = plan_mod.resolve_placement("multihost")
+    assert mh.device_count == 0
+    with pytest.raises(NotImplementedError, match="init_distributed"):
+        mh.data_sharding()
+    with pytest.raises(NotImplementedError):
+        mh.pad(8)
+
+
+def test_preshard_counts_placements():
+    ctr = _own_counters()
+    ex = plan_mod.Executor("mesh", mesh=rep_mesh(2), counters=ctr)
+    x = np.arange(8, dtype=np.float32)
+    (placed,) = ex.preshard((x,))
+    assert placed.sharding.is_equivalent_to(
+        ex.placement.data_sharding(), placed.ndim)
+    assert ctr.snapshot()["device_put"] >= 1
+    # already-placed arrays pass through without a second put
+    before = ctr.snapshot()["device_put"]
+    ex.preshard((placed,))
+    assert ctr.snapshot()["device_put"] == before
+
+
+# --------------------------------------------------------- executor ----
+def test_prepared_falls_back_on_off_signature_dispatch():
+    ex = plan_mod.Executor("local", counters=_own_counters())
+    jf = jax.jit(lambda x: x * 2.0)
+    unit = ex.prepare(("t", "double"), jf,
+                      (jax.ShapeDtypeStruct((4,), jnp.float32),))
+    assert unit.aot_ok
+    ok = ex.dispatch(unit, (jnp.ones((4,), jnp.float32),))
+    off = ex.dispatch(unit, (jnp.ones((6,), jnp.float32),))  # wrong shape
+    np.testing.assert_array_equal(np.asarray(ok), 2.0 * np.ones(4))
+    np.testing.assert_array_equal(np.asarray(off), 2.0 * np.ones(6))
+
+
+def test_executor_unit_cache_and_evict():
+    ex = plan_mod.Executor("local", counters=_own_counters())
+    jf = jax.jit(lambda x: x + 1.0)
+    args = (jax.ShapeDtypeStruct((2,), jnp.float32),)
+    u1 = ex.prepare(("t", "inc"), jf, args)
+    u2 = ex.prepare(("t", "inc"), jf, args)
+    assert u1 is u2
+    ex.evict(("t", "inc"))
+    u3 = ex.prepare(("t", "inc"), jf, args)
+    assert u3 is not u1
+
+
+def test_fetch_counts_exactly_one():
+    ctr = _own_counters()
+    ex = plan_mod.Executor("local", counters=ctr)
+    out = ex.fetch(jnp.arange(3))
+    assert ctr.snapshot()["fetches"] == 1
+    np.testing.assert_array_equal(np.asarray(out), [0, 1, 2])
+
+
+# ---------------------------------------------- mesh rep pipeline ------
+def test_mesh_rejects_indivisible_block_reps():
+    with pytest.raises(ValueError, match="split evenly"):
+        sim.RepBlockPipeline(
+            _rep_fn(FAMILY_CFGS["sign"]), 4, key=rng.master_key(7),
+            block_reps=10, chunk_size=CHUNK, placement="mesh",
+            mesh=rep_mesh(4), aot=False)
+
+
+@pytest.mark.parametrize("fam", sorted(FAMILY_CFGS))
+@pytest.mark.parametrize("n_dev", [2, 4])
+def test_mesh_block_detail_bitwise_equals_local(fam, n_dev):
+    """4 estimator families x {mesh(2), mesh(4)}: the sharded program's
+    per-rep outputs are byte-for-byte the local placement's."""
+    cfg = FAMILY_CFGS[fam]
+    local = _pipe(cfg, aot=False)
+    mesh = _pipe(cfg, placement="mesh", mesh=rep_mesh(n_dev),
+                 counters=_own_counters(), aot=False)
+    for a, b in zip(local.block_detail(0), mesh.block_detail(0)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+@pytest.mark.parametrize("fam", sorted(FAMILY_CFGS))
+def test_mesh_run_sums_match_local_to_tolerance(fam):
+    cfg = FAMILY_CFGS[fam]
+    s_local, n_local = _pipe(cfg, counters=_own_counters()).run(2)
+    s_mesh, n_mesh = _pipe(cfg, placement="mesh", mesh=rep_mesh(4),
+                           counters=_own_counters()).run(2)
+    assert n_local == n_mesh == 2 * BLOCK_REPS
+    for a, b in zip(s_local, s_mesh):
+        assert a == pytest.approx(b, rel=1e-5, abs=1e-5)
+
+
+def test_mesh_run_is_single_fetch_and_donating():
+    """The transfer proof: one run = one fetch, n_blocks donated
+    dispatches, no reshard mismatches — on a counter bundle owned by
+    this test alone."""
+    ctr = _own_counters()
+    pipe = _pipe(FAMILY_CFGS["sign"], placement="mesh", mesh=rep_mesh(4),
+                 counters=ctr)
+    before = ctr.snapshot()
+    pipe.run(3)
+    delta = transfer_mod.diff(ctr.snapshot(), before)
+    assert delta.get("fetches") == 1, delta
+    assert delta.get("donated_blocks") == 3, delta
+    assert not delta.get("reshard_mismatch"), delta
+    assert pipe.donation_engaged is True
+
+
+def test_mesh_reduced_sums_deterministic_across_runs():
+    cfg = FAMILY_CFGS["sign"]
+    a, _ = _pipe(cfg, placement="mesh", mesh=rep_mesh(4),
+                 counters=_own_counters()).run(2)
+    b, _ = _pipe(cfg, placement="mesh", mesh=rep_mesh(4),
+                 counters=_own_counters()).run(2)
+    assert a == b  # exact: same shards, same ascending host fold
+
+
+def test_mesh_resume_addresses_match_local():
+    """start_block > 0 keygen lands at the same global key addresses
+    sharded as unsharded (rep_keys_slice contract)."""
+    cfg = FAMILY_CFGS["sign"]
+    local = _pipe(cfg, counters=_own_counters())
+    mesh = _pipe(cfg, placement="mesh", mesh=rep_mesh(2),
+                 counters=_own_counters())
+    for a, b in zip(local.block_detail(3), mesh.block_detail(3)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_rep_keys_slice_bitwise_matches_full_stream():
+    key = rng.design_key(rng.master_key(3), jnp.uint32(5))
+    full = rng.key_data(rng.rep_keys(key, 12))
+    for start, n in ((0, 12), (4, 4), (8, 4)):
+        part = rng.key_data(rng.rep_keys_slice(key, start, n))
+        assert np.asarray(part).tobytes() == \
+            np.asarray(full[start:start + n]).tobytes()
+
+
+# -------------------------------------------------- sketch tree merge --
+def test_sketch_tree_merge_bitwise_equals_monolithic():
+    from dpcorr.stream import sketch as sk
+
+    params = sk.ReleaseParams(family="ni_sign", eps1=1.0, eps2=1.0,
+                              target_chunk=64)
+    xy = np.random.default_rng(0).normal(size=(300, 2)).astype(np.float32)
+    wkey = sk.window_key(rng.master_key(11), "w-tree")
+    grid = sk.grid_for(params, xy.shape[0])
+    assert grid.n_chunks >= 3  # the tree has real shape
+
+    pass_a = sk.tree_merge([
+        sk.sketch_window(xy, params, wkey, "pass_a", chunk_ids=[c])
+        for c in range(grid.n_chunks)])
+    moments = sk.moments_for_window(pass_a, params, grid, wkey)
+    shards = [sk.sketch_window(xy, params, wkey, "estimate",
+                               chunk_ids=[c], moments=moments)
+              for c in range(grid.n_chunks)]
+    tree = sk.release_from_sketch(sk.tree_merge(shards), params, wkey)
+    mono = sk.release_window(xy, params, wkey)
+    assert tree == mono  # dict equality over floats == bitwise
+
+
+def test_sketch_tree_merge_rejects_empty():
+    from dpcorr.stream import sketch as sk
+
+    with pytest.raises(ValueError):
+        sk.tree_merge([])

@@ -139,6 +139,19 @@ def main() -> int:
                     help="--users mode: LRU cap per shard (small "
                          "enough that evictions are guaranteed)")
     ap.add_argument("--fleet", action="store_true",
+                    help="run the ISSUE 20 horizontally-scaled fleet "
+                         "scenario: a jax-free front end routing over "
+                         "--replicas real `dpcorr serve` replicas "
+                         "sharing one leased budget directory; gates "
+                         "on exact aggregate==Σ per-replica admission "
+                         "counts (pre-kill), qps(N)/qps(1) reported, "
+                         "and a SIGKILL of one replica mid-traffic "
+                         "losing zero ε: fleet-wide conservation "
+                         "binary-exact (no double-spend on re-leased "
+                         "shards, no lost charges) with 100% eventual "
+                         "client success through the front end")
+    ap.add_argument("--fleet-page", dest="fleet_page",
+                    action="store_true",
                     help="run the ISSUE 11 fleet-telemetry scenario: "
                          "N real `dpcorr serve` subprocesses (one with "
                          "a slow-kernel chaos fault), driven over HTTP "
@@ -149,17 +162,32 @@ def main() -> int:
                          "page firing for exactly the faulted instance "
                          "and dumping its flight recorder (reason "
                          "slo_page, reconstructed jax-free)")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="--fleet mode: serve replicas behind the "
+                         "front end (stamped into the artifact)")
+    ap.add_argument("--fleet-users", dest="fleet_users", type=int,
+                    default=64,
+                    help="--fleet mode: distinct principals in the "
+                         "shared leased budget directory")
+    ap.add_argument("--fleet-shards", dest="fleet_shards", type=int,
+                    default=8,
+                    help="--fleet mode: budget directory shard count "
+                         "(= lease granularity)")
+    ap.add_argument("--lease-ttl-s", dest="lease_ttl_s", type=float,
+                    default=1.5,
+                    help="--fleet mode: lease TTL — bounds failover "
+                         "convergence after the SIGKILL")
     ap.add_argument("--fleet-instances", dest="fleet_instances",
                     type=int, default=3,
-                    help="--fleet mode: serve subprocesses to launch")
+                    help="--fleet-page mode: serve subprocesses to launch")
     ap.add_argument("--fleet-requests", dest="fleet_requests",
                     type=int, default=24,
-                    help="--fleet mode: requests per healthy instance "
+                    help="--fleet/--fleet-page: requests per replica per phase (healthy instance for --fleet-page) "
                          "(the faulted one gets fewer — its point is "
                          "latency, not volume)")
     ap.add_argument("--fleet-dir", dest="fleet_dir",
                     default="fleet_artifacts",
-                    help="--fleet mode: artifact directory (span "
+                    help="--fleet/--fleet-page: artifact directory (span "
                          "spools, audit spools, recorder dumps, the "
                          "merged trace + fleet snapshot)")
     args = ap.parse_args()
@@ -168,10 +196,13 @@ def main() -> int:
         # no kernels, no traffic — pure admission arithmetic; runs
         # before any jax configuration on purpose
         return run_users(args)
-    if args.fleet:
+    if args.fleet_page:
         # the driver itself never needs jax: the kernels run inside
         # the serve subprocesses, the collector speaks HTTP + stdlib
         return run_fleet(args)
+    if args.fleet:
+        # jax-free too: supervisor + front end + retrying HTTP client
+        return run_fleet_scale(args)
 
     import jax
 
@@ -732,8 +763,8 @@ def run_fleet(args) -> int:
 
     n_inst = args.fleet_instances
     if n_inst < 2:
-        print("--fleet needs at least 2 instances (one healthy, one "
-              "faulted)", file=sys.stderr)
+        print("--fleet-page needs at least 2 instances (one healthy, "
+              "one faulted)", file=sys.stderr)
         return 2
     fdir = os.path.abspath(args.fleet_dir)
     os.makedirs(fdir, exist_ok=True)
@@ -994,6 +1025,375 @@ def run_fleet(args) -> int:
         with open(args.out_json, "w") as f:
             f.write(blob)
     return 0 if all(ok.values()) else 1
+
+
+def run_fleet_scale(args) -> int:
+    """ISSUE 20 acceptance: the horizontally scaled serve fleet.
+
+    Boots two cells of REAL ``dpcorr serve`` replicas under the
+    :mod:`dpcorr.serve.fleet` supervisor — one replica (the qps
+    baseline), then ``--replicas`` of them sharing ONE leased budget
+    directory behind the jax-free :class:`FleetFrontend` — and drives
+    every request through the front end with the stock
+    :class:`RetryingClient`. Three claim groups:
+
+    - **scale** — aggregate qps at N replicas vs 1, same offered
+      concurrency; the ~linear gate is asserted only when the box has
+      the cores to make it meaningful (≥ 4 per replica), else reported
+      as ``null`` (measured, not asserted).
+    - **exact counting (pre-kill)** — with the fleet healthy, client
+      successes == Σ per-replica ``requests_total`` deltas, integer-
+      exact: the front end admits each logical request exactly once.
+    - **zero-ε failover** — SIGKILL one replica mid-traffic; the
+      supervisor relaunches it with identical argv; its shards are
+      re-leased on demand; every client request still succeeds. Then,
+      binary-exact: the fleet-wide merged audit replay of the shared
+      budget directory equals the on-disk per-user balances equals the
+      incremental expectation (charge-id dedup over the shared shard
+      WALs makes this kill-point-independent — no double spend on a
+      re-leased shard, no lost charge). Per-party ledgers are
+      instance-local: survivors must be replay==ledger exact; the
+      victim's trail may trail its ledger by AT MOST the one charge
+      in flight at the kill (the ledger's documented spend-then-audit
+      durability order — the audit under-reports, never the budget).
+    """
+    import shutil
+    import urllib.request
+
+    from dpcorr.obs import fleet as obs_fleet
+    from dpcorr.obs.audit import read_events
+    from dpcorr.obs.audit import replay as audit_replay
+    from dpcorr.obs.budget_replay import fold_levels, read_user_balances
+    from dpcorr.serve.client import (
+        HttpEstimateClient,
+        RetryingClient,
+        RetryPolicy,
+    )
+    from dpcorr.serve.fleet import ReplicaSpec, Supervisor, lease_table
+    from dpcorr.serve.fleet.frontend import (
+        FleetFrontend,
+        make_frontend_http_server,
+    )
+    from dpcorr.serve.ledger import request_charges
+    from dpcorr.serve.request import EstimateRequest
+
+    n_rep = args.replicas
+    if n_rep < 2:
+        print("--fleet needs --replicas >= 2 (a kill victim and at "
+              "least one survivor)", file=sys.stderr)
+        return 2
+    fdir = os.path.abspath(args.fleet_dir)
+    os.makedirs(fdir, exist_ok=True)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    shards = args.fleet_shards
+    users = [f"user-{u}" for u in range(args.fleet_users)]
+    errors: list[str] = []
+
+    def spec_for(name: str, subdir: str, target: int) -> ReplicaSpec:
+        argv = [sys.executable, "-m", "dpcorr", "serve",
+                "--port", "0", "--instance", name,
+                "--platform", "cpu", "--budget", "1e9",
+                "--ledger", os.path.join(subdir, f"{name}_ledger.json"),
+                "--audit", os.path.join(subdir, f"{name}_audit.jsonl"),
+                "--user-dir", os.path.join(subdir, "budget"),
+                "--user-shards", str(shards),
+                "--user-budget", "1e9",
+                "--lease-dir", os.path.join(subdir, "leases"),
+                "--lease-ttl-s", str(args.lease_ttl_s),
+                "--lease-target", str(target),
+                "--aot", "off", "--max-batch", "8",
+                "--max-delay-ms", "5"]
+        return ReplicaSpec(name=name, argv=argv, env=env, cwd=repo_root,
+                           stderr_path=os.path.join(subdir,
+                                                    f"{name}.log"))
+
+    class Cell:
+        """One booted fleet: supervisor + front end + HTTP server +
+        background health poller."""
+
+        def __init__(self, tag: str, n: int):
+            self.subdir = os.path.join(fdir, tag)
+            shutil.rmtree(self.subdir, ignore_errors=True)
+            os.makedirs(self.subdir)
+            self.names = [f"rep-{i}" for i in range(n)]
+            target = -(-shards // n)
+            self.fe = FleetFrontend(
+                {}, lease_dir=os.path.join(self.subdir, "leases"),
+                cooldown_s=0.5, table_ttl_s=0.25)
+            self.sup = Supervisor(
+                [spec_for(nm, self.subdir, target) for nm in self.names],
+                on_up=lambda name, url, banner:
+                    self.fe.set_replica(name, url))
+            self.sup.start()
+            self.httpd = make_frontend_http_server(self.fe,
+                                                   "127.0.0.1", 0)
+            threading.Thread(target=self.httpd.serve_forever,
+                             daemon=True).start()
+            self.front_url = (f"http://127.0.0.1:"
+                              f"{self.httpd.server_address[1]}")
+            deadline = time.monotonic() + 600
+            ready: dict = {}
+            while time.monotonic() < deadline:
+                ready = self.fe.poll_ready()
+                if len(ready) == n and all(ready.values()):
+                    break
+                time.sleep(0.25)
+            else:
+                raise RuntimeError(f"{tag}: replicas never ready: "
+                                   f"{ready}")
+            self._stop = threading.Event()
+
+            def health():
+                while not self._stop.is_set():
+                    try:
+                        self.fe.poll_ready()
+                    except Exception:
+                        pass
+                    self._stop.wait(0.25)
+
+            threading.Thread(target=health, daemon=True).start()
+
+        def replica_stats(self) -> dict[str, dict]:
+            out = {}
+            for name in self.names:
+                with urllib.request.urlopen(
+                        f"{self.sup.url(name)}/stats", timeout=30) as r:
+                    out[name] = json.load(r)
+            return out
+
+        def audits(self) -> dict[str, str]:
+            return {n: os.path.join(self.subdir, f"{n}_audit.jsonl")
+                    for n in self.names}
+
+        def stop(self) -> None:
+            self._stop.set()
+            self.httpd.shutdown()
+            self.sup.stop()
+
+    sent: dict[str, int] = {}  # fleet cell only: user -> logical reqs
+
+    def drive(cell: Cell, n_requests: int, n_threads: int, base: int,
+              *, count: bool, policy: RetryPolicy,
+              kill_after: int | None = None,
+              victim: str | None = None) -> dict:
+        """Drive ``n_requests`` logical requests through the front
+        end; each eventually succeeds or lands in ``errs``. With
+        ``kill_after``, SIGKILL ``victim`` once that many completed."""
+        cli = RetryingClient(HttpEstimateClient(cell.front_url,
+                                                timeout_s=120.0),
+                             policy)
+        done = [0]
+        errs: list[str] = []
+        lock = threading.Lock()
+        killed = threading.Event()
+
+        def one(i: int) -> None:
+            import random as _random
+
+            rs = _random.Random(base + i)
+            x = [rs.gauss(0.0, 1.0) for _ in range(32)]
+            y = [xi * 0.5 + rs.gauss(0.0, 1.0) for xi in x]
+            user = users[i % len(users)]
+            req = EstimateRequest(
+                args.family, x, y, args.eps1, args.eps2,
+                party_x="fleet-x", party_y="fleet-y", user=user)
+            try:
+                cli.estimate(req, timeout=120.0)
+                with lock:
+                    done[0] += 1
+                    if count:
+                        sent[user] = sent.get(user, 0) + 1
+            except Exception as e:
+                with lock:
+                    errs.append(f"#{i}: {type(e).__name__}: {e}")
+
+        def worker(ids: list[int]) -> None:
+            for i in ids:
+                one(i)
+                if kill_after is not None and not killed.is_set():
+                    with lock:
+                        due = done[0] >= kill_after
+                    if due and not killed.is_set():
+                        killed.set()
+                        cell.sup.kill(victim)
+
+        lanes: list[list[int]] = [[] for _ in range(n_threads)]
+        for i in range(n_requests):
+            lanes[i % n_threads].append(i)
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(lane,))
+                   for lane in lanes if lane]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return {"done": done[0], "wall_s": wall, "errors": errs,
+                "client": cli.stats()}
+
+    threads_n = min(16, 4 * n_rep)
+    per_phase = args.fleet_requests * n_rep
+    steady = RetryPolicy(max_attempts=6, base_delay_s=0.05,
+                         max_delay_s=1.0, deadline_s=120.0)
+    failover = RetryPolicy(max_attempts=20, base_delay_s=0.1,
+                           max_delay_s=1.0, deadline_s=240.0)
+
+    # ---- phase A: single-replica qps baseline ---------------------
+    solo = Cell("solo", 1)
+    try:
+        drive(solo, 2 * len(users), threads_n, 500_000,
+              count=False, policy=steady)  # warm: compiles + leases
+        a = drive(solo, per_phase, threads_n, 600_000,
+                  count=False, policy=steady)
+    finally:
+        solo.stop()
+    if a["errors"]:
+        errors.extend(f"solo {e}" for e in a["errors"][:3])
+    qps1 = a["done"] / a["wall_s"] if a["wall_s"] else None
+
+    # ---- phase B: N replicas, exact counting + qps ----------------
+    fleet = Cell("fleet", n_rep)
+    victim = fleet.names[-1]
+    try:
+        warm = drive(fleet, 2 * len(users), threads_n, 700_000,
+                     count=True, policy=steady)
+        stats0 = fleet.replica_stats()
+        b = drive(fleet, per_phase, threads_n, 800_000,
+                  count=True, policy=steady)
+        stats1 = fleet.replica_stats()
+        qps_n = b["done"] / b["wall_s"] if b["wall_s"] else None
+        admitted_delta = {
+            n: (stats1[n]["requests_total"]
+                - stats0[n]["requests_total"])
+            for n in fleet.names}
+        counts_exact = (not warm["errors"] and not b["errors"]
+                        and b["done"] == per_phase
+                        and sum(admitted_delta.values()) == per_phase)
+
+        # ---- phase C: SIGKILL mid-traffic -------------------------
+        owners_before = {s: rec.get("owner") for s, rec in
+                         lease_table(os.path.join(fleet.subdir,
+                                                  "leases")).items()}
+        c = drive(fleet, per_phase, threads_n, 900_000,
+                  count=True, policy=failover,
+                  kill_after=per_phase // 3, victim=victim)
+        restarted = fleet.sup.wait_restarted(victim, 1, timeout_s=300)
+        # let the restarted replica finish boot + re-lease its share
+        time.sleep(2.0 * args.lease_ttl_s)
+        owners_after = {s: rec.get("owner") for s, rec in
+                        lease_table(os.path.join(fleet.subdir,
+                                                 "leases")).items()}
+        stats2 = fleet.replica_stats()
+        lease_snaps = {n: stats2[n].get("leases") for n in fleet.names}
+    finally:
+        fleet.stop()
+
+    kill_ok = (not c["errors"] and c["done"] == per_phase and restarted
+               and fleet.sup.restarts.get(victim, 0) == 1)
+    victim_shards = sorted(s for s, o in owners_before.items()
+                           if o == victim)
+    released_ok = all(owners_after.get(s) is not None
+                      for s in victim_shards)
+
+    # ---- gate: per-party conservation (instance-local ledgers) ----
+    def party_only(events: list[dict]) -> list[dict]:
+        out = []
+        for ev in events:
+            ch = {p: e for p, e in ev["charges"].items()
+                  if not p.startswith("user/")
+                  and not p.startswith("global/")}
+            if ch:
+                out.append({**ev, "charges": ch})
+        return out
+
+    trails = {n: read_events(path)
+              for n, path in fleet.audits().items()}
+    survivors = [n for n in fleet.names if n != victim]
+    cons = obs_fleet.conservation(
+        {n: party_only(trails[n]) for n in survivors},
+        {n: obs_fleet.ledger_parties(stats2[n]) for n in survivors})
+    # victim: spend persists before the audit line, so the kill can
+    # orphan AT MOST the one in-flight charge out of its trail (and a
+    # later same-id retry on the restarted victim repairs even that)
+    v_replay = audit_replay(party_only(trails[victim]))
+    v_ledger = obs_fleet.ledger_parties(stats2[victim])
+    per_req = request_charges(EstimateRequest(
+        args.family, [0.0, 1.0], [0.0, 1.0], args.eps1, args.eps2,
+        party_x="fleet-x", party_y="fleet-y"))
+    v_gap = {p: v_ledger.get(p, 0.0) - v_replay.get(p, 0.0)
+             for p in set(v_ledger) | set(v_replay)}
+    victim_ok = all(g == 0.0 or g == per_req.get(p)
+                    for p, g in v_gap.items())
+
+    # ---- gate: fleet-wide user-level zero-ε (the leased shards) ---
+    merged = sorted((ev for evs in trails.values() for ev in evs),
+                    key=lambda ev: ev["ts"])
+    user_replay = fold_levels(audit_replay(merged))["user"]
+    balances = read_user_balances(os.path.join(fleet.subdir, "budget"))
+    disk = {u: rec["l"] for u, rec in balances.items()}
+    user_eps = sum(per_req.values())
+    expected = {u: k * user_eps for u, k in sent.items()}
+    user_exact = user_replay == disk == expected
+
+    cpu = os.cpu_count() or 1
+    ratio = (qps_n / qps1) if qps1 and qps_n else None
+    # assert ~linear scaling only where the cores exist to deliver it
+    linear_ok = (ratio is not None and ratio >= 0.5 * n_rep) \
+        if cpu >= 4 * n_rep else None
+
+    ok = {
+        "fleet_up": not errors,
+        "prekill_counts_exact": counts_exact,
+        "kill_all_succeeded": kill_ok,
+        "victim_shards_releases": released_ok,
+        "party_conservation_survivors": cons["ok"],
+        "victim_audit_within_one_charge": victim_ok,
+        "user_conservation_exact": user_exact,
+    }
+    if linear_ok is not None:
+        ok["qps_linearish"] = linear_ok
+    out = {
+        "metric": "serve_fleet_scale",
+        "replicas": n_rep,
+        "shards": shards,
+        "users": len(users),
+        "lease_ttl_s": args.lease_ttl_s,
+        "requests_per_phase": per_phase,
+        "client_threads": threads_n,
+        "qps": {"one": round(qps1, 2) if qps1 else None,
+                "n": round(qps_n, 2) if qps_n else None,
+                "ratio": round(ratio, 3) if ratio else None,
+                "linear_ok": linear_ok, "cpu_count": cpu},
+        "prekill": {"done": b["done"],
+                    "admitted_delta": admitted_delta,
+                    "client": b["client"]},
+        "kill": {"victim": victim,
+                 "restarts": dict(fleet.sup.restarts),
+                 "done": c["done"], "wall_s": round(c["wall_s"], 3),
+                 "client": c["client"],
+                 "victim_shards_before": victim_shards,
+                 "owners_after": owners_after},
+        "party_conservation": cons,
+        "victim_audit_gap": v_gap,
+        "user_conservation": {
+            "exact": user_exact,
+            "per_request_user_eps": user_eps,
+            "replay_total": sum(user_replay.values()),
+            "disk_total": sum(disk.values()),
+            "expected_total": sum(expected.values()),
+        },
+        "lease_snapshots": lease_snaps,
+        "ok": ok,
+        "errors": (errors + warm["errors"] + b["errors"]
+                   + c["errors"])[:8],
+    }
+    blob = json.dumps(out, indent=2)
+    print(blob)
+    if args.out_json:
+        with open(args.out_json, "w") as f:
+            f.write(blob)
+    return 0 if all(v for v in ok.values() if v is not None) else 1
 
 
 def run_overload(args) -> int:

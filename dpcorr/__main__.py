@@ -233,9 +233,31 @@ def cmd_hrs_sweep(args):
 def cmd_serve(args):
     """Online serving: micro-batched DP-correlation queries behind a
     per-party ε-budget ledger (dpcorr.serve; docs/SERVING.md)."""
+    import socket
+
     from dpcorr.obs import trace as obs_trace
     from dpcorr.serve.server import make_http_server
 
+    # bind FIRST (cheap, before the jax-heavy build) so the port is
+    # known up front: --instance defaults from it, so two replicas on
+    # one box without explicit names can't collide on span-spool /
+    # recorder / ledger filenames (ISSUE 20), and {instance}/{port}
+    # placeholders in those paths resolve before anything opens them
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind((args.host, args.port))
+    sock.listen(128)
+    bound_port = sock.getsockname()[1]
+    if args.instance is None:
+        args.instance = f"serve-{bound_port}"
+    subst = {"instance": args.instance, "port": str(bound_port)}
+    for attr in ("trace", "audit", "flight_recorder", "ledger",
+                 "warmup_manifest"):
+        val = getattr(args, attr)
+        if val:
+            for k, v in subst.items():
+                val = val.replace("{%s}" % k, v)
+            setattr(args, attr, val)
     if args.trace:
         # the process tracer, so grid/profiling spans from in-server
         # kernels land in the same log as the serve lifecycle spans
@@ -261,15 +283,20 @@ def cmd_serve(args):
         rec = FlightRecorder(args.flight_recorder)
         signal.signal(signal.SIGUSR2,
                       lambda signum, frame: rec.dump("sigusr2"))
-    server = _build_server(args)
+    advertise_url = f"http://{args.host}:{bound_port}" \
+        if args.host not in ("0.0.0.0", "::") \
+        else f"http://127.0.0.1:{bound_port}"
+    server = _build_server(args, advertise_url=advertise_url)
     if rec is not None:
         server.attach_recorder(rec)
-    # bind BEFORE the banner so --port 0 (ephemeral) is discoverable:
-    # the fleet harness reads the bound port out of the banner line
-    httpd = make_http_server(server, host=args.host, port=args.port)
-    bound_port = httpd.server_address[1]
+    # the socket was bound before the build; the HTTP server adopts it
+    # (the banner below is how the fleet harness discovers --port 0)
+    httpd = make_http_server(server, host=args.host, port=args.port,
+                             sock=sock)
     print(json.dumps({"serving": {"host": args.host, "port": bound_port,
                                   "instance": args.instance,
+                                  "lease_dir": args.lease_dir,
+                                  "advertise_url": advertise_url,
                                   "budget": args.budget,
                                   "ledger": args.ledger,
                                   "max_batch": args.max_batch,
@@ -304,7 +331,7 @@ def cmd_serve(args):
         httpd.shutdown()
 
 
-def _build_server(args):
+def _build_server(args, advertise_url=None):
     from dpcorr.serve import DpcorrServer
 
     # exported-executable persistence rides the same opt-in cache dir as
@@ -341,7 +368,106 @@ def _build_server(args):
         user_renew_period_s=args.user_renew_period_s,
         user_burst_cap=args.user_burst_cap,
         global_budget=args.global_budget,
-        instance=args.instance)
+        instance=args.instance,
+        lease_dir=args.lease_dir,
+        lease_ttl_s=args.lease_ttl_s,
+        lease_target=args.lease_target,
+        advertise_url=advertise_url)
+
+
+def cmd_fleet(args):
+    """Fleet deployment plane (jax-free; docs/SERVING.md 'Running a
+    fleet'): `front` routes over already-running replicas, `up` boots
+    and supervises N replicas plus a front end in one command."""
+    import math
+    import sys
+    import threading
+    import time as time_mod
+
+    from dpcorr.serve.fleet.frontend import (FleetFrontend,
+                                             make_frontend_http_server)
+
+    def _serve_front(fe, host, port, banner_extra):
+        httpd = make_frontend_http_server(fe, host, port)
+        bound = httpd.server_address[1]
+        banner = {"host": host, "port": bound,
+                  "lease_dir": args.lease_dir}
+        banner.update(banner_extra)
+        print(json.dumps({"fleet_front": banner}), flush=True)
+
+        def _poll():
+            while True:
+                try:
+                    fe.poll_ready()
+                except Exception:
+                    pass
+                time_mod.sleep(args.health_interval_s)
+
+        threading.Thread(target=_poll, name="fleet-health",
+                         daemon=True).start()
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.shutdown()
+
+    if args.fleet_cmd == "front":
+        replicas = {}
+        for spec in args.replica:
+            name, sep, url = spec.partition("=")
+            if not sep or not url:
+                raise SystemExit(f"--replica wants name=url, got {spec!r}")
+            replicas[name] = url
+        fe = FleetFrontend(replicas, lease_dir=args.lease_dir)
+        _serve_front(fe, args.host, args.port,
+                     {"replicas": dict(sorted(replicas.items()))})
+        return
+
+    # fleet up: boot N real `dpcorr serve` replicas over one shared
+    # budget directory + lease dir, supervise them, front them
+    from dpcorr.serve.fleet.supervisor import ReplicaSpec, Supervisor
+
+    os.makedirs(args.workdir, exist_ok=True)
+    budget_root = os.path.join(args.workdir, "budget")
+    lease_dir = os.path.join(args.workdir, "leases")
+    args.lease_dir = lease_dir
+    target = math.ceil(args.user_shards / args.replicas)
+    specs = []
+    for i in range(args.replicas):
+        name = f"r{i}"
+        argv = [sys.executable, "-m", "dpcorr", "serve",
+                "--port", "0", "--instance", name,
+                "--budget", str(args.budget),
+                "--ledger", os.path.join(args.workdir,
+                                         f"{name}_ledger.json"),
+                "--audit", os.path.join(args.workdir,
+                                        f"{name}_audit.jsonl"),
+                "--user-dir", budget_root,
+                "--user-shards", str(args.user_shards),
+                "--user-budget", str(args.user_budget),
+                "--lease-dir", lease_dir,
+                "--lease-ttl-s", str(args.lease_ttl_s),
+                "--lease-target", str(target),
+                "--max-delay-ms", str(args.max_delay_ms)]
+        if args.platform:
+            argv += ["--platform", args.platform]
+        specs.append(ReplicaSpec(
+            name=name, argv=argv,
+            stderr_path=os.path.join(args.workdir, f"{name}.log")))
+    fe = FleetFrontend({}, lease_dir=lease_dir)
+    sup = Supervisor(specs,
+                     on_up=lambda name, url, banner:
+                     fe.set_replica(name, url))
+    print(json.dumps({"fleet_up": {"replicas": args.replicas,
+                                   "workdir": args.workdir,
+                                   "booting": True}}), flush=True)
+    sup.start()
+    try:
+        _serve_front(fe, args.host, args.port,
+                     {"replicas": sup.urls()})
+    finally:
+        sup.stop()
 
 
 def cmd_stream(args):
@@ -369,6 +495,14 @@ def cmd_stream(args):
                       lambda signum, frame: rec.dump("sigusr2"))
     spec = WindowSpec(size_s=args.window_s, slide_s=args.slide_s,
                       late_s=args.late_s)
+    placement = None
+    if args.placement is not None:
+        from dpcorr.plan.placement import MeshPlacement, resolve_placement
+
+        if args.placement == "mesh" and args.mesh_devices:
+            placement = MeshPlacement(n_devices=args.mesh_devices)
+        else:
+            placement = resolve_placement(args.placement)
     service = StreamService(
         args.workdir, spec, args.families.split(","),
         args.eps1, args.eps2, normalise=args.normalise == "on",
@@ -376,7 +510,7 @@ def cmd_stream(args):
         party_x=args.party_x, party_y=args.party_y,
         stream_id=args.stream_id, user=args.user,
         user_budget=args.user_budget, global_budget=args.global_budget,
-        max_pending_rows=args.max_pending_rows)
+        max_pending_rows=args.max_pending_rows, placement=placement)
     if rec is not None:
         rec.watch_registry(service.registry)
         rec.watch_costs(service.costs)
@@ -1878,6 +2012,24 @@ def main(argv=None):
                      help="whole-replica ε ceiling, charged atomically "
                           "with the per-party legs (reserved principal "
                           "global/total)")
+    ps_.add_argument("--lease-dir", dest="lease_dir", default=None,
+                     help="fleet mode (requires --user-dir): shard-lease "
+                          "directory SHARED by all replicas of one "
+                          "budget directory; each shard's journal is "
+                          "only ever written by the replica holding its "
+                          "lease (docs/SERVING.md 'Running a fleet')")
+    ps_.add_argument("--lease-ttl-s", dest="lease_ttl_s", type=float,
+                     default=3.0,
+                     help="lease validity window; a silent replica "
+                          "loses its shards this long after its last "
+                          "heartbeat renewal")
+    ps_.add_argument("--lease-target", dest="lease_target", type=int,
+                     default=None,
+                     help="cap on proactively acquired shards (the "
+                          "fleet harness passes ceil(shards/replicas) "
+                          "so the first replica up doesn't hoard the "
+                          "ring); on-demand takeover of free shards "
+                          "is not capped")
     ps_.add_argument("--max-batch", dest="max_batch", type=int, default=64,
                      help="flush a bucket at this many live requests")
     ps_.add_argument("--max-delay-ms", dest="max_delay_ms", type=float,
@@ -1968,6 +2120,50 @@ def main(argv=None):
                           "SIGUSR2; replay with `dpcorr obs dump PATH`")
     ps_.set_defaults(fn=cmd_serve)
 
+    pfl = sub.add_parser("fleet", help="horizontally scaled serve: "
+                         "front-end router over N replicas with leased "
+                         "budget shards (docs/SERVING.md)")
+    pfls = pfl.add_subparsers(dest="fleet_cmd", required=True)
+    pff = pfls.add_parser("front", help="jax-free HTTP front end over "
+                          "already-running serve replicas")
+    pff.add_argument("--replica", action="append", required=True,
+                     metavar="NAME=URL",
+                     help="one serve replica (repeatable), e.g. "
+                          "r0=http://127.0.0.1:8321")
+    pff.add_argument("--lease-dir", dest="lease_dir", default=None,
+                     help="the fleet's shared lease directory: routes "
+                          "each user to the replica owning their "
+                          "budget shard")
+    pff.add_argument("--host", default="127.0.0.1")
+    pff.add_argument("--port", type=int, default=8330)
+    pff.add_argument("--health-interval-s", dest="health_interval_s",
+                     type=float, default=0.5,
+                     help="readyz poll cadence per replica")
+    pff.set_defaults(fn=cmd_fleet)
+    pfu = pfls.add_parser("up", help="boot + supervise N serve replicas "
+                          "over one shared budget directory, plus a "
+                          "front end; a dead replica is restarted with "
+                          "identical argv and its shards re-leased")
+    pfu.add_argument("--workdir", required=True,
+                     help="fleet state root: budget/ (shared directory), "
+                          "leases/, per-replica ledger/audit/logs")
+    pfu.add_argument("--replicas", type=int, default=3)
+    pfu.add_argument("--budget", type=float, default=100.0)
+    pfu.add_argument("--user-budget", dest="user_budget", type=float,
+                     default=1.0)
+    pfu.add_argument("--user-shards", dest="user_shards", type=int,
+                     default=16)
+    pfu.add_argument("--lease-ttl-s", dest="lease_ttl_s", type=float,
+                     default=3.0)
+    pfu.add_argument("--max-delay-ms", dest="max_delay_ms", type=float,
+                     default=5.0)
+    pfu.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+    pfu.add_argument("--host", default="127.0.0.1")
+    pfu.add_argument("--port", type=int, default=8330)
+    pfu.add_argument("--health-interval-s", dest="health_interval_s",
+                     type=float, default=0.5)
+    pfu.set_defaults(fn=cmd_fleet)
+
     pst = sub.add_parser("stream", help="always-on windowed DP "
                          "correlation over an ingest stream "
                          "(docs/STREAMING.md)")
@@ -2038,6 +2234,17 @@ def main(argv=None):
                           "/obs/trigger) for FleetCollector and "
                           "obs top --fleet")
     pst.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+    pst.add_argument("--placement", default=None,
+                     choices=["local", "mesh"],
+                     help="execution placement for window finalize "
+                          "(dpcorr.plan): 'mesh' splits each pass's "
+                          "chunk set across devices and tree-merges "
+                          "the shard sketches — bitwise-equal to the "
+                          "default monolithic release")
+    pst.add_argument("--mesh-devices", dest="mesh_devices", type=int,
+                     default=None,
+                     help="device count for --placement mesh "
+                          "(default: all visible devices)")
     pst.set_defaults(fn=cmd_stream)
 
     po_ = sub.add_parser("obs", help="telemetry tooling: audit-trail "

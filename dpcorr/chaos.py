@@ -76,6 +76,10 @@ KNOWN_POINTS = (
     "stream.pre_release",      # window closable, nothing charged yet
     "stream.mid_window",       # ingest batch in the WAL, not acked
     "stream.post_journal",     # release journaled, window not closed
+    # fleet lease takeover (serve/fleet/lease.py) — NOT in
+    # MATRIX_POINTS: the two-party chaos matrix never traverses it;
+    # tests/test_fleet_serve.py and the fleet-scale CI job do
+    "fleet.pre_lease_commit",  # claim file won, lease not committed
 )
 
 #: The step-kill matrix `dpcorr chaos` sweeps: the points every protocol

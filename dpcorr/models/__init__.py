@@ -1,4 +1,25 @@
 """Models: data-generating processes and DP correlation estimators (layers
-L0 and L2 of the reference — SURVEY.md §1)."""
+L0 and L2 of the reference — SURVEY.md §1).
 
-from dpcorr.models import dgp  # noqa: F401
+Submodules resolve lazily (PEP 562): :mod:`dpcorr.models.estimators.families`
+is jax-free and is imported by the serve request validator and the fleet
+front end, so this package init must not eagerly pull :mod:`dgp` (jax).
+``dpcorr.models.dgp`` and ``from dpcorr.models import dgp`` still work —
+the submodule loads on first attribute access.
+"""
+
+import importlib
+
+_SUBMODULES = ("dgp", "estimators")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        mod = importlib.import_module(f"{__name__}.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_SUBMODULES))

@@ -17,31 +17,46 @@ result`` with static batch geometry, so ``jax.vmap`` over keys evaluates a
 full Monte-Carlo replication batch as one fused kernel.
 """
 
-from dpcorr.models.estimators.common import (  # noqa: F401
-    CorrResult,
-    batch_geometry,
-)
-from dpcorr.models.estimators.int_sign import (  # noqa: F401
-    ci_int_signflip,
-    correlation_int_signflip,
-)
-from dpcorr.models.estimators.int_subg import ci_int_subg  # noqa: F401
-from dpcorr.models.estimators.ni_sign import (  # noqa: F401
-    ci_ni_signbatch,
-    correlation_ni_signbatch,
-)
-from dpcorr.models.estimators.ni_subg import correlation_ni_subg  # noqa: F401
-from dpcorr.models.estimators.registry import (  # noqa: F401
-    FAMILIES,
-    serving_entry,
-)
-from dpcorr.models.estimators.streaming import (  # noqa: F401
-    array_chunk_fn,
-    choose_n_chunk,
-    ci_int_signflip_stream,
-    ci_int_subg_stream,
-    ci_ni_signbatch_stream,
-    correlation_ni_subg_stream,
-    dgp_chunk_fn,
-    subg_pair_stream,
-)
+import importlib
+
+# Lazy re-exports (PEP 562): :mod:`families` in this package is jax-free
+# and feeds serve-side request validation; an eager estimator import here
+# would load jax into every process that only wants the family *names*
+# (the fleet front end, lease keeper, jax-free benchmark drivers).
+_EXPORTS = {
+    "CorrResult": "common",
+    "batch_geometry": "common",
+    "ci_int_signflip": "int_sign",
+    "correlation_int_signflip": "int_sign",
+    "ci_int_subg": "int_subg",
+    "ci_ni_signbatch": "ni_sign",
+    "correlation_ni_signbatch": "ni_sign",
+    "correlation_ni_subg": "ni_subg",
+    "FAMILIES": "families",
+    "serving_entry": "registry",
+    "array_chunk_fn": "streaming",
+    "choose_n_chunk": "streaming",
+    "ci_int_signflip_stream": "streaming",
+    "ci_int_subg_stream": "streaming",
+    "ci_ni_signbatch_stream": "streaming",
+    "correlation_ni_subg_stream": "streaming",
+    "dgp_chunk_fn": "streaming",
+    "subg_pair_stream": "streaming",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(
+        importlib.import_module(f"{__name__}.{mod}"), name)
+    globals()[name] = value  # cache: resolve each name once
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

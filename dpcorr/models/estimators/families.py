@@ -1,0 +1,16 @@
+"""The estimator-family name universe, jax-free.
+
+:data:`FAMILIES` is the single source of truth for which estimator
+families the serving layer accepts. It lives here — not in
+:mod:`dpcorr.models.estimators.registry` — because the registry
+imports the estimator implementations (and therefore jax), while
+request validation, the fleet front end, and the jax-free benchmark
+drivers only need the *names*. The registry re-exports it, so
+``from dpcorr.models.estimators.registry import FAMILIES`` keeps
+working for jax-loaded callers.
+"""
+
+from __future__ import annotations
+
+#: Families the serving layer accepts, in SURVEY.md §2.2 order.
+FAMILIES: tuple[str, ...] = ("ni_sign", "int_sign", "ni_subg", "int_subg")

@@ -46,8 +46,9 @@ from dpcorr.models.estimators.int_subg import ci_int_subg
 from dpcorr.models.estimators.ni_sign import ci_ni_signbatch
 from dpcorr.models.estimators.ni_subg import correlation_ni_subg
 
-#: Families the serving layer accepts, in SURVEY.md §2.2 order.
-FAMILIES: tuple[str, ...] = ("ni_sign", "int_sign", "ni_subg", "int_subg")
+# Re-exported from the jax-free families module (serve.request and
+# the fleet front end import the names without loading estimators).
+from dpcorr.models.estimators.families import FAMILIES  # noqa: F401,E402
 
 
 def serving_entry(family: str, eps1: float, eps2: float,

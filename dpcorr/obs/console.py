@@ -180,7 +180,10 @@ def render_fleet_frame(snapshot, now: float | None = None) -> str:
                  f"{n_live}/{n_all} instances up")
     lines.append("-" * 72)
     lines.append(f"{'instance':<14} {'done':>7} {'refused':>7} "
-                 f"{'queue':>5} {'p50 ms':>8} {'p99 ms':>8}  top ε")
+                 f"{'queue':>5} {'shards':>7} {'p50 ms':>8} "
+                 f"{'p99 ms':>8}  top ε")
+    lease_owned: dict[str, int] = {}  # instance -> shards held
+    lease_total = 0  # n_shards of the shared directory (0 = no fleet)
     for name in sorted(snapshot.instances):
         rec = snapshot.instances[name]
         if rec.get("error") is not None:
@@ -192,13 +195,29 @@ def render_fleet_frame(snapshot, now: float | None = None) -> str:
         top = (f"{rows[0][0]}={_fmt_eps(rows[0][1])}" if rows else "-")
         done = (stats.get("batched_requests", 0)
                 + stats.get("unbatched_requests", 0))
+        leases = stats.get("leases")
+        if leases:
+            held = len(leases.get("owned", ()))
+            lease_owned[name] = held
+            lease_total = max(lease_total,
+                              int(leases.get("n_shards") or 0))
+            shards = f"{held}/{leases.get('n_shards', '?')}"
+        else:
+            shards = "-"
         lines.append(
             f"{name:<14} {done:>7} "
             f"{sum(stats.get('refused', {}).values()):>7} "
             f"{stats.get('queue_depth', 0):>5} "
+            f"{shards:>7} "
             f"{lat.get('p50', 0.0) * 1e3:>8.2f} "
             f"{lat.get('p99', 0.0) * 1e3:>8.2f}  {top}")
     lines.append("-" * 72)
+    if lease_owned:
+        held = sum(lease_owned.values())
+        own = "  ".join(f"{n}={k}" for n, k in sorted(lease_owned.items()))
+        orphans = max(0, lease_total - held)
+        lines.append(f"leases      : {held}/{lease_total} shards held "
+                     f"({orphans} orphaned)   {own}")
     if n_live:
         agg = snapshot.aggregate()
 

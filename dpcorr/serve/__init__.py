@@ -38,58 +38,85 @@ See docs/SERVING.md for the end-to-end story and the bit-identity
 contract (estimators.registry).
 """
 
-from dpcorr.serve.client import (  # noqa: F401
-    HttpEstimateClient,
-    RetriableTransportError,
-    RetryingClient,
-    RetryPolicy,
-    request_to_json,
-)
-from dpcorr.serve.coalescer import (  # noqa: F401
-    Coalescer,
-    ServerClosedError,
-    ServerOverloadedError,
-)
-from dpcorr.serve.kernels import KernelCache, pad_batch  # noqa: F401
-from dpcorr.serve.overload import (  # noqa: F401
-    BrownoutController,
-    CircuitBreaker,
-    CircuitOpenError,
-    DeadlineExpiredError,
-)
-from dpcorr.serve.budget_dir import (  # noqa: F401
-    BudgetDirectory,
-    CompositeLedger,
-    DirectoryCorruptError,
-    RenewalPolicy,
-    party_view,
-    user_view,
-)
-from dpcorr.serve.ledger import (  # noqa: F401
-    BudgetExceededError,
-    PrivacyLedger,
-    request_charges,
-)
-from dpcorr.serve.request import (  # noqa: F401
-    BucketKey,
-    EstimateRequest,
-    EstimateResponse,
-    KernelKey,
-    bucket_key,
-    kernel_key,
-    pad_n,
-)
-from dpcorr.serve.server import (  # noqa: F401
-    DpcorrServer,
-    InProcessClient,
-    make_http_server,
-    pinned_request_key,
-    serve_http,
-)
-from dpcorr.serve.stats import ServeStats, percentiles  # noqa: F401
-from dpcorr.serve.warmup import (  # noqa: F401
-    load_manifest,
-    parse_warmup_spec,
-    save_manifest,
-    signatures_to_keys,
-)
+import importlib
+
+# Lazy re-exports (PEP 562): importing :mod:`dpcorr.serve` — or any of
+# its submodules — must NOT load jax. The serve tree splits into
+# jax-free leaves (request, ledger, budget_dir, stats, overload,
+# coalescer, client, fleet/*) and jax-heavy roots (kernels, server,
+# warmup); an eager ``from .server import DpcorrServer`` here would
+# weld them back together and drag jax into the fleet front end, the
+# lease keeper, and the jax-free benchmark drivers. Attribute access
+# (``dpcorr.serve.DpcorrServer`` or ``from dpcorr.serve import ...``)
+# resolves through ``__getattr__`` below, importing the owning module
+# on first touch only.
+_EXPORTS = {
+    # client
+    "HttpEstimateClient": "client",
+    "RetriableTransportError": "client",
+    "RetryingClient": "client",
+    "RetryPolicy": "client",
+    "request_to_json": "client",
+    # coalescer
+    "Coalescer": "coalescer",
+    "ServerClosedError": "coalescer",
+    "ServerOverloadedError": "coalescer",
+    # kernels (jax)
+    "KernelCache": "kernels",
+    "pad_batch": "kernels",
+    # overload
+    "BrownoutController": "overload",
+    "CircuitBreaker": "overload",
+    "CircuitOpenError": "overload",
+    "DeadlineExpiredError": "overload",
+    # budget_dir
+    "BudgetDirectory": "budget_dir",
+    "CompositeLedger": "budget_dir",
+    "DirectoryCorruptError": "budget_dir",
+    "RenewalPolicy": "budget_dir",
+    "party_view": "budget_dir",
+    "user_view": "budget_dir",
+    # ledger
+    "BudgetExceededError": "ledger",
+    "PrivacyLedger": "ledger",
+    "request_charges": "ledger",
+    # request
+    "BucketKey": "request",
+    "EstimateRequest": "request",
+    "EstimateResponse": "request",
+    "KernelKey": "request",
+    "bucket_key": "request",
+    "kernel_key": "request",
+    "pad_n": "request",
+    # server (jax)
+    "DpcorrServer": "server",
+    "InProcessClient": "server",
+    "make_http_server": "server",
+    "pinned_request_key": "server",
+    "serve_http": "server",
+    # stats
+    "ServeStats": "stats",
+    "percentiles": "stats",
+    # warmup (jax)
+    "load_manifest": "warmup",
+    "parse_warmup_spec": "warmup",
+    "save_manifest": "warmup",
+    "signatures_to_keys": "warmup",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(
+        importlib.import_module(f"{__name__}.{mod}"), name)
+    globals()[name] = value  # cache: resolve each name once
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -166,6 +166,25 @@ def _hash64(s: str) -> int:
     return int.from_bytes(hashlib.sha256(s.encode()).digest()[:8], "big")
 
 
+def build_ring(n_shards: int,
+               replicas: int = 16) -> tuple[list[int], list[int]]:
+    """The directory's consistent-hash ring as ``(keys, shard_ids)``.
+
+    Module-level (and jax-free) so the fleet front end can compute
+    ``user -> shard`` with the exact arithmetic the directory routes
+    by, without opening any journal."""
+    points = sorted((_hash64(f"shard-{i}:{r}"), i)
+                    for i in range(n_shards) for r in range(replicas))
+    return [h for h, _ in points], [i for _, i in points]
+
+
+def ring_shard_index(user: str, ring_keys: list[int],
+                     ring_shards: list[int]) -> int:
+    """Route ``user`` on a ring built by :func:`build_ring`."""
+    j = bisect.bisect_right(ring_keys, _hash64(user)) % len(ring_keys)
+    return ring_shards[j]
+
+
 def _atomic_write(path: str, text: str, fsync: bool = True) -> None:
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as fh:
@@ -539,7 +558,8 @@ class BudgetDirectory:
                  compact_every: int | None = 256,
                  replicas: int = 16, clock=time.time,
                  fsync: bool = True,
-                 audit: AuditTrail | None = None):
+                 audit: AuditTrail | None = None,
+                 lease=None):
         if shards < 1:
             raise ValueError(f"shards must be >= 1, got {shards}")
         self.root = str(root)
@@ -561,32 +581,66 @@ class BudgetDirectory:
         self.n_shards = shards
         self.renewal = renewal if renewal is not None else RenewalPolicy()
         self.user_budget = float(user_budget)
-        self._shards = [
-            _Shard(os.path.join(self.root, f"shard-{i:04d}"),
-                   self.user_budget, self.renewal, clock, fsync,
-                   max_resident, compact_every)
-            for i in range(shards)]
-        points = sorted((_hash64(f"shard-{i}:{r}"), i)
-                        for i in range(shards) for r in range(replicas))
-        self._ring_keys = [h for h, _ in points]
-        self._ring_shards = [i for _, i in points]
+        self._mk = lambda i: _Shard(
+            os.path.join(self.root, f"shard-{i:04d}"),
+            self.user_budget, self.renewal, clock, fsync,
+            max_resident, compact_every)
+        self._lease = lease
+        self._open_lock = threading.Lock()
+        if lease is None:
+            # single-owner mode: eager, exactly the pre-fleet behavior
+            self._shards: list[_Shard | None] = \
+                [self._mk(i) for i in range(shards)]
+        else:
+            # fleet mode: the directory is SHARED on disk; a shard's
+            # journal opens lazily and only while this process holds
+            # its lease, so two replicas never have the same WAL open
+            self._shards = [None] * shards
+            lease.bind(shards, on_lost=self.drop_shard)
+        self._ring_keys, self._ring_shards = build_ring(shards, replicas)
 
     def shard_index(self, user: str) -> int:
-        j = bisect.bisect_right(self._ring_keys, _hash64(user)) \
-            % len(self._ring_keys)
-        return self._ring_shards[j]
+        return ring_shard_index(user, self._ring_keys, self._ring_shards)
+
+    def _shard_at(self, i: int) -> _Shard:
+        """The open shard journal, gated on lease ownership when the
+        directory is fleet-shared. Raises the lease layer's
+        ``ShardNotOwnedError`` (charge-free — nothing was touched)
+        when another replica owns shard ``i``."""
+        if self._lease is not None:
+            self._lease.ensure_owned(i)
+        s = self._shards[i]
+        if s is None:
+            with self._open_lock:
+                s = self._shards[i]
+                if s is None:
+                    s = self._mk(i)
+                    self._shards[i] = s
+        return s
+
+    def drop_shard(self, i: int) -> None:
+        """Close shard ``i``'s journal (lease lost/released): the next
+        owner replays the WAL; this process re-opens only after it
+        re-acquires."""
+        with self._open_lock:
+            s = self._shards[i]
+            self._shards[i] = None
+        if s is not None:
+            s.close()
 
     def _shard(self, user: str) -> _Shard:
-        return self._shards[self.shard_index(user)]
+        return self._shard_at(self.shard_index(user))
 
     # -- accounting --------------------------------------------------
 
     def charge(self, user: str, eps: float,
                trace_id: str | None = None,
-               charge_id: str | None = None) -> None:
+               charge_id: str | None = None) -> bool:
         """Charge one user leg; audit-recorded under the ``user/``
         principal after the WAL append is durable (the same
-        observe-after-persist ordering the party ledger keeps)."""
+        observe-after-persist ordering the party ledger keeps).
+        Returns whether the charge applied (False = the shard already
+        held ``charge_id`` and this call spent nothing)."""
         key = USER_PREFIX + user
         try:
             applied = self._shard(user).charge(user, eps,
@@ -603,6 +657,7 @@ class BudgetDirectory:
                 detail["dedup"] = True
             self.audit.record("charge", {key: eps}, trace_id=trace_id,
                               **detail)
+        return applied
 
     def refund(self, user: str, eps: float,
                trace_id: str | None = None,
@@ -632,6 +687,8 @@ class BudgetDirectory:
         totals: dict = {}
         resident = evicted = 0
         for s in self._shards:
+            if s is None:  # fleet mode: lease not held, journal closed
+                continue
             view = s.stats_locked_view()
             resident += view["resident"]
             evicted += view["evicted"]
@@ -653,8 +710,8 @@ class BudgetDirectory:
                 "counters": c}
 
     def close(self) -> None:
-        for s in self._shards:
-            s.close()
+        for i in range(self.n_shards):
+            self.drop_shard(i)
 
 
 def _leg_id(charge_id: str | None, key: str) -> str | None:
@@ -726,35 +783,43 @@ class CompositeLedger:
 
     def charge(self, charges: Mapping[str, float],
                trace_id: str | None = None,
-               charge_id: str | None = None) -> None:
+               charge_id: str | None = None) -> list[str]:
         """All-or-nothing across every level. User legs charge the
         directory first (idempotent per-leg charge_ids derived from
         ``charge_id``); the party+global legs then charge the wrapped
         ledger atomically. ANY in-process failure of a later leg — a
         budget refusal, but equally an OSError or corruption error
-        persisting the party snapshot — compensates the already-applied
-        directory legs and re-raises, so no exception path leaves a
-        user leg charged for a query that never executed (server
-        requests carry no ``charge_id``, so nothing else would ever
-        reverse it). Only a hard process death between the two stores
-        escapes compensation (``SimulatedCrash`` is a BaseException
-        for exactly this reason): that is recovered by the idempotent
-        re-charge when a ``charge_id`` is present, and otherwise errs
-        toward over-counting, the privacy-safe direction."""
+        persisting the party snapshot — compensates the directory legs
+        THIS call applied and re-raises, so no exception path leaves a
+        user leg charged for a query that never executed. A leg the
+        directory deduped (its derived charge_id already durable — a
+        retry of a charge a dying replica made) spent nothing here, so
+        compensation must not reverse it: the earlier charge stands
+        until the logical request succeeds (then the success dedups
+        too — exactly one spend) or is abandoned (over-count, the
+        privacy-safe direction). Only a hard process death between the
+        two stores escapes compensation (``SimulatedCrash`` is a
+        BaseException for exactly this reason): recovered the same way
+        when a ``charge_id`` is present. Returns the deduped user-leg
+        keys so callers can strip them from the dict they would later
+        refund."""
         aug = self.augment(charges)
         user_legs = [(k, v) for k, v in aug.items()
                      if k.startswith(USER_PREFIX)]
         rest = {k: v for k, v in aug.items()
                 if not k.startswith(USER_PREFIX)}
         done: list[tuple[str, float]] = []
+        deduped: list[str] = []
         try:
             if self.directory is not None:
                 for key, eps in user_legs:
-                    self.directory.charge(key[len(USER_PREFIX):], eps,
-                                          trace_id=trace_id,
-                                          charge_id=_leg_id(charge_id,
-                                                            key))
-                    done.append((key, eps))
+                    applied = self.directory.charge(
+                        key[len(USER_PREFIX):], eps, trace_id=trace_id,
+                        charge_id=_leg_id(charge_id, key))
+                    if applied:
+                        done.append((key, eps))
+                    else:
+                        deduped.append(key)
             self.ledger.charge(rest, trace_id=trace_id,
                                charge_id=charge_id)
         except Exception as e:
@@ -771,21 +836,29 @@ class CompositeLedger:
                                       charge_id=_leg_id(charge_id, key),
                                       reason=reason)
             raise
+        return deduped
 
     def charge_request(self, req, trace_id: str | None = None,
-                       ) -> dict[str, float]:
+                       charge_id: str | None = None) -> dict[str, float]:
         """Charge one request's spend across every level; returns the
         AUGMENTED charge dict — the server carries it through the
-        coalescer so a shed refund reverses every leg. Server requests
-        carry no ``charge_id`` (the serve idempotency cache dedups
-        retries before any charge), so an in-process failure of the
-        party leg relies on :meth:`charge`'s compensation, and a hard
-        kill between the stores can only over-count — privacy-safe."""
+        coalescer so a shed refund reverses every leg. ``charge_id``
+        (the request's durable retry identity) makes the user legs
+        idempotent fleet-wide: the directory is shared, so a retry
+        landing on a different replica dedups against the WAL-recovered
+        charge_id set instead of double-spending. Deduped legs are
+        stripped from the returned dict — this attempt did not make
+        that spend, so no shed-path refund of this attempt may reverse
+        it."""
         from dpcorr.serve.ledger import request_charges
 
         charges = self.augment(request_charges(req),
                                user=getattr(req, "user", None))
-        self.charge(charges, trace_id=trace_id)
+        deduped = self.charge(charges, trace_id=trace_id,
+                              charge_id=charge_id)
+        if deduped:
+            charges = {k: v for k, v in charges.items()
+                       if k not in deduped}
         return charges
 
     def refund(self, charges: Mapping[str, float],

@@ -35,6 +35,7 @@ import urllib.request
 from concurrent.futures import TimeoutError as _FuturesTimeout
 
 from dpcorr.serve.coalescer import ServerOverloadedError
+from dpcorr.serve.fleet.lease import ShardNotOwnedError
 from dpcorr.serve.ledger import BudgetExceededError
 from dpcorr.serve.overload import CircuitOpenError, DeadlineExpiredError
 from dpcorr.serve.request import EstimateRequest, EstimateResponse
@@ -47,9 +48,11 @@ class RetriableTransportError(Exception):
 
 
 #: refusals that can heal with time — what the client retries.
+#: ShardNotOwnedError heals too: leases move (TTL expiry, on-demand
+#: takeover), and the refusal was charge-free by construction.
 RETRIABLE = (ServerOverloadedError, CircuitOpenError,
              DeadlineExpiredError, RetriableTransportError,
-             _FuturesTimeout, TimeoutError)
+             ShardNotOwnedError, _FuturesTimeout, TimeoutError)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -249,6 +252,13 @@ class HttpEstimateClient:
             return CircuitOpenError(msg, retry_after_s=ra)
         if e.code == 429:
             return ServerOverloadedError(msg, retry_after_s=ra)
+        if e.code == 421:
+            # fleet routing miss: this replica does not own the user's
+            # budget shard (the front end normally forwards before a
+            # client ever sees this; a direct client just retries)
+            return ShardNotOwnedError(
+                int(body.get("shard", -1)), owner=body.get("owner"),
+                owner_url=body.get("owner_url"), retry_after_s=ra)
         if e.code >= 500:
             return RetriableTransportError(msg)
         return ValueError(msg)

@@ -62,13 +62,16 @@ import logging
 import threading
 import time
 from concurrent.futures import Future, InvalidStateError
+from typing import TYPE_CHECKING
 
 import numpy as np
 
 from dpcorr import chaos
 from dpcorr.obs import recorder as obs_recorder
 from dpcorr.obs import trace as obs_trace
-from dpcorr.serve.kernels import KernelCache
+if TYPE_CHECKING:  # annotation only: kernels imports jax, this
+    # module stays importable by the jax-free client/fleet layers
+    from dpcorr.serve.kernels import KernelCache
 from dpcorr.serve.overload import (
     BrownoutController,
     CircuitBreaker,
@@ -119,6 +122,9 @@ class _Pending:
     t_deadline: float | None = None
     #: what admission charged, so a pre-launch drop can refund exactly
     charges: dict | None = None
+    #: the charge's durable idempotency id (fleet retries): a refund
+    #: must forget it so a genuinely new attempt can charge again
+    charge_id: str | None = None
     #: the request's CostRecord (obs.cost), opened at admission and
     #: filled in here: queue wait at the claim boundary, compile wait
     #: and an even share of kernel time at launch, shed events + ε
@@ -167,14 +173,17 @@ class Coalescer:
     # -- admission -------------------------------------------------------
     def submit(self, req: EstimateRequest, key, seed: int,
                span=None, charges: dict | None = None,
-               cost=None) -> Future:
+               cost=None, charge_id: str | None = None) -> Future:
         """Enqueue one admitted request; resolves to EstimateResponse.
         ``span`` is the request's root span (or None/null when
         untraced); it rides the queue so the flush thread can parent
         its spans under the same trace ID. ``charges`` is what
         admission charged the ledger — carried so any pre-launch shed
-        can refund it. ``cost`` is the request's CostRecord, filled in
-        on the flush thread."""
+        can refund it (``charge_id`` rides along so the refund forgets
+        the durable retry id — without that, the NEXT attempt of the
+        shed request would dedup against a charge that was just
+        reversed and execute unpaid). ``cost`` is the request's
+        CostRecord, filled in on the flush thread."""
         fut: Future = Future()
         now = time.perf_counter()
         t_deadline = (now + req.deadline_s if req.deadline_s is not None
@@ -182,7 +191,7 @@ class Coalescer:
         p = _Pending(req, key, seed, fut, now,
                      span if span is not None else obs_trace._NULL_SPAN,
                      priority=req.priority, t_deadline=t_deadline,
-                     charges=charges, cost=cost)
+                     charges=charges, cost=cost, charge_id=charge_id)
         victim = None
         retry_after = None
         with self._cond:
@@ -260,7 +269,7 @@ class Coalescer:
         (ledger.refund contract)."""
         if self.ledger is not None and p.charges:
             self.ledger.refund(p.charges, trace_id=p.span.trace_id,
-                               reason=reason)
+                               charge_id=p.charge_id, reason=reason)
         if p.cost is not None:
             p.cost.event(reason)
             if p.charges:

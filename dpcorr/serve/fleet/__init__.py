@@ -1,0 +1,38 @@
+"""Horizontally scaled serve: front-end router, leased budget shards,
+process supervision.
+
+The fleet subsystem (ISSUE 20) turns the single-process serve node
+into N replicas behind one jax-free HTTP front end, without giving up
+a single exactness invariant:
+
+- :mod:`~dpcorr.serve.fleet.lease` — durable fsynced lease files grant
+  each :class:`~dpcorr.serve.budget_dir.BudgetDirectory` shard to
+  exactly one replica at a time (epoch-numbered, TTL + heartbeat),
+  so any replica can admit any user without double-spend.
+- :mod:`~dpcorr.serve.fleet.frontend` — health-checked routing with
+  per-replica circuit state, Retry-After passthrough, and
+  consistent-hash shard affinity keyed on the request's user.
+- :mod:`~dpcorr.serve.fleet.supervisor` — boots/monitors/restarts
+  replicas with identical argv, so a killed replica's shards are
+  re-leased and its WAL-recovered balances stay exact.
+
+Everything here is importable without jax: the front end and
+supervisor are deployment-plane processes.
+"""
+
+from dpcorr.serve.fleet.frontend import (FleetFrontend,
+                                         make_frontend_http_server)
+from dpcorr.serve.fleet.lease import (LeaseKeeper, LeaseManager,
+                                      ShardNotOwnedError, lease_table)
+from dpcorr.serve.fleet.supervisor import ReplicaSpec, Supervisor
+
+__all__ = [
+    "FleetFrontend",
+    "LeaseKeeper",
+    "make_frontend_http_server",
+    "LeaseManager",
+    "ReplicaSpec",
+    "ShardNotOwnedError",
+    "Supervisor",
+    "lease_table",
+]

@@ -282,10 +282,13 @@ class PrivacyLedger:
                                   **detail)
 
     def charge_request(self, req: EstimateRequest,
-                       trace_id: str | None = None) -> dict[str, float]:
-        """Charge one request's spend; returns what was charged."""
+                       trace_id: str | None = None,
+                       charge_id: str | None = None) -> dict[str, float]:
+        """Charge one request's spend; returns what was charged.
+        ``charge_id`` (the request's durable retry identity, when it
+        has one) makes the charge idempotent across a crash-retry."""
         charges = request_charges(req)
-        self.charge(charges, trace_id=trace_id)
+        self.charge(charges, trace_id=trace_id, charge_id=charge_id)
         return charges
 
     def refund(self, charges: Mapping[str, float],

@@ -23,7 +23,7 @@ from typing import NamedTuple
 
 import numpy as np
 
-from dpcorr.models.estimators.registry import FAMILIES
+from dpcorr.models.estimators.families import FAMILIES
 
 #: Smallest padded-n bucket — below this every n shares one bucket.
 MIN_N_BUCKET = 64

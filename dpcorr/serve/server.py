@@ -75,6 +75,7 @@ from dpcorr.serve.budget_dir import (
     party_view,
 )
 from dpcorr.serve.coalescer import Coalescer, ServerOverloadedError
+from dpcorr.serve.fleet.lease import ShardNotOwnedError
 from dpcorr.serve.kernels import KernelCache
 from dpcorr.serve.ledger import BudgetExceededError, PrivacyLedger
 from dpcorr.serve.overload import (
@@ -163,7 +164,11 @@ class DpcorrServer:
                  user_burst_cap: float = 0.0,
                  user_fsync: bool = True,
                  global_budget: float | None = None,
-                 instance: str | None = None):
+                 instance: str | None = None,
+                 lease_dir: str | None = None,
+                 lease_ttl_s: float = 3.0,
+                 lease_target: int | None = None,
+                 advertise_url: str | None = None):
         self.seed = seed
         #: fleet identity (ISSUE 11): label on /stats + /metrics so the
         #: fleet collector can cross-check its target map
@@ -192,9 +197,26 @@ class DpcorrServer:
         # global admission as one atomic charge with one refund path.
         # Drop-in: the coalescer's shed-refund and the overload refund
         # below reverse every leg through the same refund() call.
+        # fleet mode (ISSUE 20): with --lease-dir the budget directory
+        # is SHARED across replicas and this server only opens a shard
+        # journal while it holds that shard's lease — the keeper
+        # heartbeats renewals and picks up free/orphaned shards
+        self.leases = None
+        self._lease_keeper = None
+        if lease_dir is not None and user_dir is None:
+            raise ValueError("--lease-dir requires --user-dir: leases "
+                             "grant budget-directory shards")
         if user_dir is not None or global_budget is not None:
             directory = None
             if user_dir is not None:
+                if lease_dir is not None:
+                    from dpcorr.serve.fleet.lease import (LeaseKeeper,
+                                                          LeaseManager)
+                    self.leases = LeaseManager(
+                        lease_dir,
+                        owner=instance if instance is not None
+                        else f"serve-pid-{secrets.token_hex(4)}",
+                        url=advertise_url, ttl_s=lease_ttl_s)
                 directory = BudgetDirectory(
                     user_dir, shards=user_shards,
                     user_budget=user_budget,
@@ -202,7 +224,12 @@ class DpcorrServer:
                                           burst_cap=user_burst_cap),
                     max_resident=user_max_resident,
                     compact_every=user_compact_every,
-                    fsync=user_fsync, audit=self.audit)
+                    fsync=user_fsync, audit=self.audit,
+                    lease=self.leases)
+                if self.leases is not None:
+                    self._lease_keeper = LeaseKeeper(self.leases,
+                                                     target=lease_target)
+                    self._lease_keeper.start()
             self.ledger = CompositeLedger(self.ledger, directory,
                                           global_budget=global_budget)
         self.cache = KernelCache(stats=self.stats, shard=shard,
@@ -429,7 +456,7 @@ class DpcorrServer:
                 placeholder: Future = Future()
                 self._idem_inflight[idem] = placeholder
             try:
-                inner = self._admit(req)
+                inner = self._admit(req, idem=idem)
             except BaseException as e:
                 # refused admissions are not cached (a retry genuinely
                 # re-runs), but duplicates already attached must fail too
@@ -442,13 +469,21 @@ class DpcorrServer:
             return placeholder
         return self._admit(req)
 
-    def _admit(self, req: EstimateRequest) -> Future:
+    def _admit(self, req: EstimateRequest,
+               idem: str | None = None) -> Future:
         """Charge + enqueue (the pre-idempotency submit).
 
         The root ``serve.request`` span opens here and closes on the
         flush thread when the response lands; its trace ID stamps the
         ledger's audit events, so one ID joins the latency chain and
-        the budget decision (docs/OBSERVABILITY.md)."""
+        the budget decision (docs/OBSERVABILITY.md).
+
+        ``idem`` (the request's retry identity, when it has one)
+        doubles as the charge's durable charge_id: in a fleet the
+        budget directory is shared, so a retry of a dying replica's
+        request dedups against the WAL-recovered id on whichever
+        replica serves it — charged exactly once, fleet-wide."""
+        charge_id = None if idem is None else f"req:{idem}"
         seed = req.seed if req.seed is not None else next(self._req_counter)
         key = self._request_key(req, seed)
         # dpcorr-lint: ignore[span-no-finally] — request root span; closes on the flush thread when the response lands
@@ -481,11 +516,21 @@ class DpcorrServer:
                 try:
                     with self.tracer.span("serve.ledger.charge"):
                         charges = self.ledger.charge_request(
-                            req, trace_id=root.trace_id)
+                            req, trace_id=root.trace_id,
+                            charge_id=charge_id)
                     # cost attribution is party ε (what crossed into a
                     # kernel) — the directory's derived user/global
                     # legs are bookkeeping views of the same spend
                     cost.charge(party_view(charges))
+                except ShardNotOwnedError as e:
+                    # fleet routing miss: another replica holds the
+                    # user's budget shard. Charge-free by construction
+                    # (the lease gate runs before any leg applies) —
+                    # the front end forwards to the owner named in e.
+                    self.stats.refused("not_owner")
+                    root.set(refused="not_owner", shard=e.shard)
+                    cost.event("refused_not_owner")
+                    raise
                 except BudgetExceededError as e:
                     self.stats.refused_budget()
                     root.set(refused="budget", refused_level=e.level)
@@ -499,13 +544,16 @@ class DpcorrServer:
                         fut = self.coalescer.submit(req, key, seed,
                                                     span=root,
                                                     charges=charges,
-                                                    cost=cost)
+                                                    cost=cost,
+                                                    charge_id=charge_id)
                 except Exception:
                     # the enqueue refused (backpressure / closed): no
                     # kernel ran and nothing was released, so reversing
                     # the charge is safe — shed load must not consume ε
-                    # (ledger.refund)
+                    # (ledger.refund); the charge_id is forgotten with
+                    # it so the client's next attempt charges cleanly
                     self.ledger.refund(charges, trace_id=root.trace_id,
+                                       charge_id=charge_id,
                                        reason="overload")
                     cost.event("refused_overload")
                     cost.refund(party_view(charges), "overload")
@@ -562,6 +610,10 @@ class DpcorrServer:
                         if isinstance(self.ledger, CompositeLedger)
                         else None))
         snap["breaker"] = self.breaker.snapshot()
+        if self.leases is not None:
+            # fleet mode: which budget shards this replica owns, at
+            # which epochs — obs top --fleet renders the fold
+            snap["leases"] = self.leases.snapshot()
         return snap
 
     # -- flight recorder (ISSUE 9) ---------------------------------------
@@ -596,7 +648,13 @@ class DpcorrServer:
         if self._crash_hook is not None:
             chaos.remove_crash_hook(self._crash_hook)
             self._crash_hook = None
+        if self._lease_keeper is not None:
+            self._lease_keeper.stop()
         self.coalescer.close()
+        if self.leases is not None:
+            # graceful handback AFTER the drain: successors take over
+            # immediately instead of waiting out the TTL
+            self.leases.release_all()
         if isinstance(self.ledger, CompositeLedger):
             self.ledger.close()
         if self._warmup_manifest:
@@ -673,9 +731,12 @@ def _response_json(resp: EstimateResponse) -> dict:
 
 
 def make_http_server(server: DpcorrServer, host: str = "127.0.0.1",
-                     port: int = 8321):
+                     port: int = 8321, sock=None):
     """Build (not start) the threaded HTTP front end; the caller owns
-    ``serve_forever`` / ``shutdown`` so tests can run it on a thread."""
+    ``serve_forever`` / ``shutdown`` so tests can run it on a thread.
+    ``sock`` adopts a pre-bound listening socket: the CLI binds before
+    the (slow) server build so the port — and the instance name
+    derived from it — is known up front."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -767,6 +828,15 @@ def make_http_server(server: DpcorrServer, host: str = "127.0.0.1",
                 return
             try:
                 resp = server.estimate(req)
+            except ShardNotOwnedError as e:
+                # fleet routing miss (ISSUE 20): 421 Misdirected
+                # Request naming the owner so the front end forwards
+                # instead of failing — charge-free on this replica
+                self._send(421, {"error": str(e),
+                                 "refused": "not_owner",
+                                 "shard": e.shard, "owner": e.owner,
+                                 "owner_url": e.owner_url},
+                           headers=self._retry_after(e))
             except BudgetExceededError as e:
                 # enough detail for the client to reconstruct the typed
                 # refusal (serve.client.HttpEstimateClient) — a budget
@@ -792,7 +862,15 @@ def make_http_server(server: DpcorrServer, host: str = "127.0.0.1",
         def log_message(self, *args):  # quiet by default
             pass
 
-    return ThreadingHTTPServer((host, port), Handler)
+    if sock is None:
+        return ThreadingHTTPServer((host, port), Handler)
+    httpd = ThreadingHTTPServer((host, port), Handler,
+                                bind_and_activate=False)
+    httpd.socket.close()
+    httpd.socket = sock
+    httpd.server_address = sock.getsockname()[:2]
+    httpd.server_activate()
+    return httpd
 
 
 def serve_http(server: DpcorrServer, host: str = "127.0.0.1",

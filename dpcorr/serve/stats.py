@@ -40,7 +40,8 @@ from dpcorr.obs.metrics import LATENCY_BUCKETS, Registry
 #: discovers labels dynamically; the fixed JSON shape needs the list).
 SHED_REASONS = ("expired", "queue_evict", "cancelled", "closed",
                 "admission")
-REFUSED_REASONS = ("budget", "overload", "breaker", "brownout")
+REFUSED_REASONS = ("budget", "overload", "breaker", "brownout",
+                   "not_owner")
 ABANDONED_STAGES = ("cancelled", "detached")
 
 

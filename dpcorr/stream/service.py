@@ -110,12 +110,18 @@ class Releaser:
     body (the ``budget`` lint rules key on exactly this boundary)."""
 
     def __init__(self, seed: int, families, eps1: float, eps2: float,
-                 normalise: bool):
+                 normalise: bool, placement=None):
         self.master = master_key(seed)
         self.families = tuple(families)
         self.eps1 = float(eps1)
         self.eps2 = float(eps2)
         self.normalise = bool(normalise)
+        # a dpcorr.plan placement (or None = monolithic): finalize
+        # routes through sketch.placement_shards, so a MeshPlacement
+        # splits each pass's chunk set across devices and tree-merges
+        # the shard sketches — bitwise-equal to the monolith by the
+        # no-arithmetic-merge contract (pinned in tests/test_plan.py)
+        self.placement = placement
 
     def release(self, window: Window) -> dict:
         rows = np.asarray(window.rows, dtype=np.float32)
@@ -124,7 +130,8 @@ class Releaser:
         for family in self.families:
             params = sketch.ReleaseParams(
                 family, self.eps1, self.eps2, normalise=self.normalise)
-            out[family] = sketch.release_window(rows, params, wkey)
+            out[family] = sketch.release_window(
+                rows, params, wkey, placement=self.placement)
         return {"start": window.start, "end": window.end,
                 "rows": int(len(window.rows)), "releases": out}
 
@@ -145,6 +152,7 @@ class StreamService:
                  max_pending_rows: int = 1 << 20,
                  fsync: bool = True,
                  registry: Registry | None = None,
+                 placement=None,
                  clock=time.time):
         self.workdir = str(workdir)
         self.clock = clock
@@ -184,7 +192,8 @@ class StreamService:
         self.ledger = CompositeLedger(base, directory, user=user,
                                       global_budget=global_budget)
         self.releaser = Releaser(seed, self.families, self.eps1,
-                                 self.eps2, self.normalise)
+                                 self.eps2, self.normalise,
+                                 placement=placement)
         self._cobs = dpc_compile.CompileObserver(registry=self.registry)
         sketch.set_compile_observer(self._cobs)
 

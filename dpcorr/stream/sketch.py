@@ -64,7 +64,7 @@ from dpcorr.utils.rng import chunk_key, stream
 
 __all__ = [
     "ChunkGrid", "ReleaseParams", "SketchState", "grid_for",
-    "moments_for_window", "release_from_sketch", "release_window",
+    "moments_for_window", "placement_shards", "release_from_sketch", "release_window",
     "set_compile_observer", "sketch_window", "tree_merge", "window_key",
 ]
 
@@ -617,18 +617,42 @@ def _finish_int_subg(totals, params, grid, wkey):
     return res.rho_hat, res.ci_low, res.ci_high
 
 
+def placement_shards(placement, n_chunks: int) -> list[list[int]]:
+    """The chunk partition a plan placement induces: one shard per
+    device, chunks dealt round-robin (shard ``d`` gets every chunk
+    ``c`` with ``c % D == d``). A :class:`~dpcorr.plan.placement.
+    LocalPlacement` (one device) degenerates to the monolithic single
+    shard; a ``MeshPlacement`` over D devices yields the D-way split
+    whose :func:`tree_merge` is pinned bitwise-equal to the monolith.
+    Duck-typed on the ``device_count`` property so this module never
+    imports :mod:`dpcorr.plan`."""
+    d = max(1, int(placement.device_count))
+    shards = [[c for c in range(n_chunks) if c % d == i]
+              for i in range(d)]
+    return [s for s in shards if s]
+
+
 def release_window(xy, params: ReleaseParams, wkey: jax.Array,
-                   shards: Sequence[Sequence[int]] | None = None
-                   ) -> dict:
+                   shards: Sequence[Sequence[int]] | None = None,
+                   *, placement=None) -> dict:
     """Full window pipeline: (pass A → moments →) estimate sketch →
     fold → release. ``shards`` splits every pass's chunk set (e.g.
     ``[[0, 2], [1, 3]]``) and merges the shard sketches — the release
     is bitwise identical for every partition, which is exactly what the
-    associativity gate runs this function to prove."""
+    associativity gate runs this function to prove. ``placement``
+    (a :mod:`dpcorr.plan` placement; mutually exclusive with explicit
+    ``shards``) derives the partition from the execution plan via
+    :func:`placement_shards` — the mesh path the stream service routes
+    finalize through."""
     xy = np.ascontiguousarray(np.asarray(xy, dtype=np.float32))
     grid = grid_for(params, xy.shape[0])
     if shards is None:
-        shards = [list(range(grid.n_chunks))]
+        if placement is not None:
+            shards = placement_shards(placement, grid.n_chunks)
+        else:
+            shards = [list(range(grid.n_chunks))]
+    elif placement is not None:
+        raise ValueError("pass shards= or placement=, not both")
     moments = None
     if params.needs_moments:
         pass_a = _merged(xy, params, wkey, "pass_a", shards, None)
@@ -638,9 +662,10 @@ def release_window(xy, params: ReleaseParams, wkey: jax.Array,
 
 
 def _merged(xy, params, wkey, pass_name, shards, moments) -> SketchState:
-    merged: SketchState | None = None
-    for ids in shards:
-        sk = sketch_window(xy, params, wkey, pass_name, chunk_ids=ids,
-                           moments=moments)
-        merged = sk if merged is None else merged.merge(sk)
-    return merged
+    # tree reduction, not a left fold: the shape a mesh of workers
+    # produces. merge() is a no-arithmetic dict union, so this is
+    # bitwise-identical to any other order — pinned by test_plan.
+    return tree_merge([
+        sketch_window(xy, params, wkey, pass_name, chunk_ids=ids,
+                      moments=moments)
+        for ids in shards])

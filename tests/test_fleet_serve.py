@@ -1,0 +1,481 @@
+"""Unit tests for the serve fleet plane (ISSUE 20): durable shard
+leases under scripted clocks (grant / renew / expire / takeover,
+epoch fencing, crash-at-takeover via the ``fleet.pre_lease_commit``
+chaos point), the lease-gated :class:`BudgetDirectory` in fleet mode,
+the jax-free front-end router against canned in-thread HTTP replicas,
+and the replica supervisor against stub subprocesses. Everything here
+is stdlib-only and runs without jax."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dpcorr import chaos
+from dpcorr.chaos import ChaosPlan, SimulatedCrash
+from dpcorr.serve.budget_dir import BudgetDirectory
+from dpcorr.serve.fleet import (
+    FleetFrontend,
+    LeaseKeeper,
+    LeaseManager,
+    ReplicaSpec,
+    ShardNotOwnedError,
+    Supervisor,
+    lease_table,
+)
+
+
+class Clock:
+    """A scripted wall clock shared by every lease party in a test."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def mgr(tmp_path, owner: str, clock: Clock, *, ttl: float = 10.0,
+        n_shards: int | None = 4, **kw) -> LeaseManager:
+    return LeaseManager(str(tmp_path / "leases"), owner,
+                        n_shards=n_shards, ttl_s=ttl, clock=clock, **kw)
+
+
+# ---------------------------------------------------------------- lease --
+
+
+def test_acquire_free_shard_grants_epoch_one(tmp_path):
+    clock = Clock()
+    a = mgr(tmp_path, "rep-a", clock)
+    assert a.acquire(0)
+    rec = a.owner_of(0)
+    assert rec["owner"] == "rep-a"
+    assert rec["epoch"] == 1
+    assert rec["expires_at"] == clock.t + 10.0
+    assert a.owned() == [0]
+    # the claim file was consumed on commit
+    assert not [n for n in os.listdir(a.lease_dir) if ".claim." in n]
+
+
+def test_renew_extends_expiry_without_epoch_bump(tmp_path):
+    clock = Clock()
+    a = mgr(tmp_path, "rep-a", clock)
+    assert a.acquire(1)
+    clock.advance(6.0)
+    assert a.renew(1)
+    rec = a.owner_of(1)
+    assert rec["epoch"] == 1
+    assert rec["expires_at"] == clock.t + 10.0
+    # silent past expiry: the renew refuses instead of reviving
+    clock.advance(11.0)
+    assert not a.renew(1)
+    assert a.owned() == []
+
+
+def test_valid_lease_is_exclusive(tmp_path):
+    clock = Clock()
+    a = mgr(tmp_path, "rep-a", clock)
+    b = mgr(tmp_path, "rep-b", clock)
+    assert a.acquire(2)
+    assert not b.acquire(2)
+    rec = b.owner_of(2)
+    assert rec["owner"] == "rep-a" and rec["epoch"] == 1
+
+
+def test_expired_lease_taken_over_with_epoch_bump(tmp_path):
+    clock = Clock()
+    a = mgr(tmp_path, "rep-a", clock)
+    b = mgr(tmp_path, "rep-b", clock)
+    assert a.acquire(2)
+    clock.advance(10.5)  # past a's ttl, a never renewed
+    assert b.acquire(2)
+    rec = b.owner_of(2)
+    assert rec["owner"] == "rep-b"
+    assert rec["epoch"] == 2
+    assert b.snapshot()["counts"]["takeovers"] == 1
+
+
+def test_restart_reclaims_own_live_lease_same_epoch(tmp_path):
+    clock = Clock()
+    a = mgr(tmp_path, "rep-a", clock)
+    assert a.acquire(0)
+    # same instance name rebooting before expiry: no second writer is
+    # introduced, so the grant is adopted as-is
+    a2 = mgr(tmp_path, "rep-a", clock)
+    assert a2.acquire(0)
+    assert a2.owner_of(0)["epoch"] == 1
+    assert a2.snapshot()["counts"]["reclaimed"] == 1
+
+
+def test_release_hands_over_without_ttl_wait(tmp_path):
+    clock = Clock()
+    lost: list[int] = []
+    a = mgr(tmp_path, "rep-a", clock)
+    a.bind(4, on_lost=lost.append)
+    b = mgr(tmp_path, "rep-b", clock)
+    assert a.acquire(3)
+    a.release(3)
+    assert lost == [3]
+    # no clock advance at all — the released lease is already expired
+    assert b.acquire(3)
+    assert b.owner_of(3)["epoch"] == 2
+
+
+def test_ensure_owned_fences_stale_holder_charge_free(tmp_path):
+    clock = Clock()
+    lost: list[int] = []
+    a = mgr(tmp_path, "rep-a", clock)
+    a.bind(4, on_lost=lost.append)
+    b = mgr(tmp_path, "rep-b", clock, ttl=10.0)
+    b.url = "http://b:1"
+    assert a.acquire(1)
+    a.ensure_owned(1)  # comfortably live: no fence
+    clock.advance(10.5)
+    assert b.acquire(1)  # epoch 2, b's grant
+    with pytest.raises(ShardNotOwnedError) as ei:
+        a.ensure_owned(1)
+    assert ei.value.owner == "rep-b"
+    assert ei.value.owner_url == "http://b:1"
+    assert ei.value.retry_after_s is not None
+    assert lost == [1]  # the shard journal was told to close
+    assert a.owned() == []
+
+
+def test_ensure_owned_acquires_free_shard_on_demand(tmp_path):
+    clock = Clock()
+    a = mgr(tmp_path, "rep-a", clock)
+    a.ensure_owned(2)
+    assert a.owned() == [2]
+    with pytest.raises(ValueError):
+        a.ensure_owned(4)  # out of the bound ring
+
+
+def test_crash_at_pre_lease_commit_leaves_only_a_stale_claim(tmp_path):
+    clock = Clock()
+    a = mgr(tmp_path, "rep-a", clock)
+    chaos.install(ChaosPlan(point="fleet.pre_lease_commit", hit=1,
+                            mode="raise"))
+    with pytest.raises(SimulatedCrash):
+        a.acquire(0)
+    chaos.clear()
+    # the claim was won but no lease was ever committed — nothing is
+    # half-written
+    assert a.owner_of(0) is None
+    claims = [n for n in os.listdir(a.lease_dir) if ".claim." in n]
+    assert claims == ["shard-0000.claim.1"]
+    # a live claim blocks a rival for TTL...
+    b = mgr(tmp_path, "rep-b", clock)
+    assert not b.acquire(0)
+    # ...then is broken atomically and the shard is granted fresh
+    clock.advance(10.5)
+    assert b.acquire(0)
+    rec = b.owner_of(0)
+    assert rec["owner"] == "rep-b" and rec["epoch"] == 1
+    assert not [n for n in os.listdir(a.lease_dir) if ".claim." in n]
+
+
+def test_lease_table_scans_records(tmp_path):
+    clock = Clock()
+    a = mgr(tmp_path, "rep-a", clock)
+    b = mgr(tmp_path, "rep-b", clock)
+    assert a.acquire(0) and b.acquire(3)
+    table = lease_table(a.lease_dir)
+    assert sorted(table) == [0, 3]
+    assert table[0]["owner"] == "rep-a"
+    assert table[3]["owner"] == "rep-b"
+
+
+def test_keeper_respects_target_then_rescues_orphans(tmp_path):
+    clock = Clock()
+    a = mgr(tmp_path, "rep-a", clock)
+    b = mgr(tmp_path, "rep-b", clock)
+    ka = LeaseKeeper(a, target=2, rescue_after_s=20.0)
+    kb = LeaseKeeper(b, target=2, rescue_after_s=20.0)
+    ka.step()
+    assert len(a.owned()) == 2  # target, not the whole ring
+    kb.step()
+    assert len(b.owned()) == 2
+    # a goes silent; b keeps heartbeating in sub-TTL steps. Expired
+    # but not yet orphaned shards stay untouched (b is at target)...
+    for _ in range(4):
+        clock.advance(4.0)
+        kb.step()
+    assert len(b.owned()) == 2
+    # ...until the orphan deadline passes, then b rescues them all
+    for _ in range(4):
+        clock.advance(4.0)
+        kb.step()
+    assert len(b.owned()) == 4
+    table = lease_table(b.lease_dir)
+    assert sorted(table) == [0, 1, 2, 3]
+    assert all(rec["owner"] == "rep-b" for rec in table.values())
+    # exactly a's two shards changed hands (epoch 2); b kept its own
+    assert sorted(rec["epoch"] for rec in table.values()) == [1, 1, 2, 2]
+
+
+# ----------------------------------------------- lease-gated directory --
+
+
+def test_directory_charge_fenced_after_takeover(tmp_path):
+    clock = Clock()
+    root = str(tmp_path / "budget")
+    la = mgr(tmp_path, "rep-a", clock, n_shards=None)
+    da = BudgetDirectory(root, shards=4, user_budget=100.0,
+                         clock=clock, fsync=False, lease=la)
+    assert da.charge("u1", 1.0, charge_id="c1")
+    shard = da.shard_index("u1")
+    assert shard in la.owned()
+    before = da.spent("u1")
+    # a rival waits out the TTL and takes the shard over
+    lb = mgr(tmp_path, "rep-b", clock, n_shards=None)
+    db = BudgetDirectory(root, shards=4, user_budget=100.0,
+                         clock=clock, fsync=False, lease=lb)
+    clock.advance(10.5)
+    lb.ensure_owned(shard)
+    # the stale holder's late charge is refused charge-free, naming
+    # the real owner
+    with pytest.raises(ShardNotOwnedError) as ei:
+        da.charge("u1", 1.0, charge_id="c2")
+    assert ei.value.owner == "rep-b"
+    # the new owner replayed the WAL: balance exact, and the dying
+    # holder's charge_id dedups a retry instead of double-charging
+    assert db.spent("u1") == before == 1.0
+    # a retry of the already-applied charge dedups (False = spent
+    # nothing); the refused charge retries fresh and applies
+    assert db.charge("u1", 1.0, charge_id="c1") is False
+    assert db.spent("u1") == 1.0
+    assert db.charge("u1", 1.0, charge_id="c2") is True
+    assert db.spent("u1") == 2.0
+
+
+# -------------------------------------------------------------- frontend --
+
+
+class _StubReplica:
+    """A canned /estimate endpoint with scriptable status/headers."""
+
+    def __init__(self, status=200, body=None, headers=(), hook=None):
+        self.status = status
+        self.body = body if body is not None else {"ok": True}
+        self.headers = list(headers)
+        self.hook = hook
+        self.hits = 0
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                payload = self.rfile.read(n)
+                stub.hits += 1
+                status, body = stub.status, stub.body
+                if stub.hook is not None:
+                    status, body = stub.hook(payload)
+                blob = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                for k, v in stub.headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def test_frontend_passes_replica_response_through():
+    rep = _StubReplica(status=200, body={"estimate": 0.5})
+    try:
+        fe = FleetFrontend({"rep-0": rep.url})
+        status, headers, payload = fe.route(b'{"user": "u"}')
+        assert status == 200
+        assert json.loads(payload) == {"estimate": 0.5}
+        assert fe.stats()["counts"]["routed:rep-0"] == 1
+    finally:
+        rep.close()
+
+
+def test_frontend_injects_failover_idempotency_key():
+    seen: list[dict] = []
+
+    def hook(payload):
+        seen.append(json.loads(payload))
+        return 200, {"ok": True}
+
+    rep = _StubReplica(hook=hook)
+    try:
+        fe = FleetFrontend({"rep-0": rep.url})
+        fe.route(b'{"user": "u"}')
+        assert seen[0]["idempotency_key"].startswith("fe:")
+        # a client-chosen identity is never overwritten
+        fe.route(b'{"user": "u", "idempotency_key": "mine"}')
+        assert seen[1]["idempotency_key"] == "mine"
+    finally:
+        rep.close()
+
+
+def test_frontend_affinity_keeps_a_user_on_one_replica():
+    reps = [_StubReplica() for _ in range(3)]
+    try:
+        fe = FleetFrontend({f"rep-{i}": r.url
+                            for i, r in enumerate(reps)})
+        for _ in range(6):
+            status, _, _ = fe.route(b'{"user": "sticky-user"}')
+            assert status == 200
+        assert sorted(r.hits for r in reps) == [0, 0, 6]
+    finally:
+        for r in reps:
+            r.close()
+
+
+def test_frontend_forwards_421_and_learns_the_owner():
+    owner = _StubReplica(status=200, body={"estimate": 1.0})
+    refuser = _StubReplica(
+        status=421, body={"refused": "not-owner", "owner": "rep-owner",
+                          "owner_url": None})
+    refuser.body["owner_url"] = owner.url
+    try:
+        fe = FleetFrontend({"rep-0": refuser.url})  # owner unknown
+        status, _, payload = fe.route(b'{"user": "u"}')
+        assert status == 200
+        assert json.loads(payload) == {"estimate": 1.0}
+        assert refuser.hits == 1 and owner.hits == 1
+        s = fe.stats()
+        assert s["counts"]["forwards"] == 1
+        assert "rep-owner" in s["replicas"]
+    finally:
+        owner.close()
+        refuser.close()
+
+
+def test_frontend_passes_retry_after_through():
+    rep = _StubReplica(status=503, body={"refused": "queue_full"},
+                       headers=[("Retry-After", "7")])
+    try:
+        fe = FleetFrontend({"rep-0": rep.url})
+        status, headers, _ = fe.route(b'{"user": "u"}')
+        assert status == 503
+        assert ("Retry-After", "7") in headers
+    finally:
+        rep.close()
+
+
+def test_frontend_circuit_sidelines_a_dead_replica():
+    rep = _StubReplica()
+    try:
+        # rep-dead points at a port nothing listens on
+        fe = FleetFrontend({"rep-0": rep.url,
+                            "rep-dead": "http://127.0.0.1:9"},
+                           fail_threshold=2, cooldown_s=60.0)
+        for _ in range(8):
+            status, _, _ = fe.route(b"{}")
+            assert status == 200  # the hop loop always lands on rep-0
+        assert fe.stats()["counts"]["transport_errors"] == 2
+        # after the threshold the breaker keeps the dead name out of
+        # the candidate order entirely
+        assert "rep-dead" not in fe._candidates(None)
+    finally:
+        rep.close()
+
+
+def test_frontend_503s_when_no_replica_answers():
+    fe = FleetFrontend({"rep-dead": "http://127.0.0.1:9"})
+    status, headers, payload = fe.route(b'{"user": "u"}')
+    assert status == 503
+    assert json.loads(payload)["refused"] == "breaker"
+    assert any(k == "Retry-After" for k, _ in headers)
+
+
+# ------------------------------------------------------------ supervisor --
+
+_STUB_REPLICA_SRC = """\
+import json, sys, time
+print(json.dumps({"serving": {"host": "127.0.0.1", "port": 45678}}))
+sys.stdout.flush()
+time.sleep(120)
+"""
+
+
+@pytest.mark.slow
+def test_serve_instance_defaults_from_bound_port(tmp_path):
+    """`dpcorr serve --port 0` with no --instance: the identity is
+    derived from the bound ephemeral port (serve-<port>), so two
+    replicas of one fleet can share an argv template without
+    colliding names."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dpcorr", "serve", "--port", "0",
+         "--budget", "5", "--aot", "off",
+         "--ledger", str(tmp_path / "ledger.json")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env)
+    try:
+        deadline = time.monotonic() + 300
+        banner = None
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline().strip()
+            if not line:
+                assert proc.poll() is None, "server died before banner"
+                continue
+            try:
+                banner = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "serving" in banner:
+                break
+        assert banner is not None and "serving" in banner
+        srv = banner["serving"]
+        assert srv["instance"] == f"serve-{srv['port']}"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+
+
+@pytest.mark.slow
+def test_supervisor_restarts_dead_replica_with_identical_argv(tmp_path):
+    ups: list[tuple[str, str]] = []
+    downs: list[str] = []
+    spec = ReplicaSpec(name="stub",
+                       argv=[sys.executable, "-c", _STUB_REPLICA_SRC],
+                       stderr_path=str(tmp_path / "stub.log"))
+    argv_before = list(spec.argv)
+    sup = Supervisor([spec], poll_s=0.05, backoff_s=0.05,
+                     on_up=lambda n, url, b: ups.append((n, url)),
+                     on_down=lambda n, rc: downs.append(n))
+    sup.start()
+    try:
+        assert ups == [("stub", "http://127.0.0.1:45678")]
+        sup.kill("stub")
+        assert sup.wait_restarted("stub", 1, timeout_s=30.0)
+        assert sup.restarts["stub"] == 1
+        assert downs == ["stub"]
+        assert len(ups) == 2  # the reboot re-announced itself
+        assert sup.specs["stub"].argv == argv_before  # same argv, verbatim
+    finally:
+        sup.stop()

@@ -244,3 +244,43 @@ def test_sketch_tree_merge_rejects_empty():
 
     with pytest.raises(ValueError):
         sk.tree_merge([])
+
+
+@pytest.mark.parametrize("n_dev", [2, 4])
+def test_release_window_mesh_placement_bitwise_equals_monolithic(n_dev):
+    """The placement-routed finalize path (stream service under
+    ``--placement mesh``): release_window(placement=MeshPlacement)
+    splits chunks round-robin across devices and tree-merges — the
+    release record must equal the monolithic one bitwise."""
+    from dpcorr.stream import sketch as sk
+
+    params = sk.ReleaseParams(family="ni_sign", eps1=1.0, eps2=1.0,
+                              target_chunk=64)
+    xy = np.random.default_rng(3).normal(size=(300, 2)).astype(np.float32)
+    wkey = sk.window_key(rng.master_key(12), "w-place")
+    grid = sk.grid_for(params, xy.shape[0])
+    assert grid.n_chunks > n_dev // 2  # the split has real shape
+
+    mp = plan_mod.MeshPlacement(rep_mesh(n_dev))
+    shards = sk.placement_shards(mp, grid.n_chunks)
+    # a partition: disjoint, complete, one shard per device (capped
+    # by the chunk count), dealt round-robin
+    assert len(shards) == min(n_dev, grid.n_chunks)
+    assert sorted(c for s in shards for c in s) == \
+        list(range(grid.n_chunks))
+
+    meshed = sk.release_window(xy, params, wkey, placement=mp)
+    mono = sk.release_window(xy, params, wkey)
+    assert meshed == mono  # dict equality over floats == bitwise
+
+
+def test_release_window_rejects_shards_and_placement():
+    from dpcorr.stream import sketch as sk
+
+    params = sk.ReleaseParams(family="ni_sign", eps1=1.0, eps2=1.0,
+                              target_chunk=64)
+    xy = np.zeros((32, 2), dtype=np.float32)
+    wkey = sk.window_key(rng.master_key(12), "w-both")
+    with pytest.raises(ValueError, match="not both"):
+        sk.release_window(xy, params, wkey, shards=[[0]],
+                          placement=plan_mod.LocalPlacement())
